@@ -1,0 +1,114 @@
+"""Single-chip training benchmark — prints ONE JSON line for the driver.
+
+Metric: model FLOPs utilization (MFU) of a bf16 Llama-2-style training step
+(~470M params, seq 1024) on the local chip.
+
+Baseline (BASELINE.md): the reference's only published number is ~7.1k tok/s
+for Llama-2-7B on one 8x A100-80GB node (DP=2 TP=4, seq 1024). That implies
+    7.1e3 tok/s * 6 * 7e9 FLOP/tok / 8 GPUs / 312e12 peak  ~= 11.9% MFU.
+``vs_baseline`` is our MFU / 11.9% — an apples-to-apples utilization ratio
+across different hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak dense bf16 FLOP/s
+    "v5litepod": 197e12,
+    "v5lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so the script still runs off-TPU
+}
+BASELINE_MFU = 0.119  # reference 8xA100 node, see module docstring
+
+
+def peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower().replace(" ", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_BF16_FLOPS["cpu"]
+
+
+def main():
+    from megatron_llm_tpu.models import (
+        init_model_params,
+        make_config,
+        padded_vocab_size,
+    )
+    from megatron_llm_tpu.training_step import make_jitted_train_step
+    from megatron_llm_tpu.core.parallel_state import build_mesh
+
+    seq, mbs = 1024, 4
+    cfg = make_config(
+        "llama2",
+        num_layers=24,
+        hidden_size=1024,
+        num_attention_heads=16,
+        num_attention_heads_kv=16,
+        ffn_hidden_size=4096,
+        vocab_size=32000,
+        seq_length=seq,
+        max_position_embeddings=2048,
+        params_dtype="bfloat16",
+        micro_batch_size=mbs,
+        global_batch_size=mbs,
+        train_iters=100,
+        lr=1e-4,
+    )
+    mesh = build_mesh(devices=jax.devices()[:1])
+    with mesh:
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        step, _opt, sh = make_jitted_train_step(cfg, mesh, params)
+        opt_state = sh["opt_state_value"]
+
+        tok = jax.random.randint(jax.random.PRNGKey(1), (mbs, seq + 1), 0, 32000)
+        batch = {
+            "tokens": tok[:, :-1],
+            "labels": tok[:, 1:],
+            "loss_mask": jnp.ones((mbs, seq), jnp.float32),
+        }
+
+        # warmup / compile
+        params, opt_state, m = step(params, opt_state, batch, 0)
+        jax.block_until_ready(m["lm loss"])
+
+        iters = 10
+        t0 = time.perf_counter()
+        for i in range(1, iters + 1):
+            params, opt_state, m = step(params, opt_state, batch, i)
+        jax.block_until_ready(m["lm loss"])
+        dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = mbs * seq / dt
+    # 6*N*T for fwd+bwd matmul FLOPs + attention term 12*L*h*s^2-ish; use the
+    # standard 6*N approximation (reference FLOP estimate,
+    # language_model.py:370-384, uses the same family of formulas).
+    model_flops = 6.0 * n_params * mbs * seq
+    mfu = (model_flops / dt) / peak_flops()
+    print(json.dumps({
+        "metric": "train_mfu_llama_470m_seq1024_1chip",
+        "value": round(mfu * 100, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_s": round(dt, 4),
+        "n_params": n_params,
+        "loss": round(float(m["lm loss"]), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""TPU-native LLM training framework with the capabilities of Megatron-LLM.
+
+JAX/XLA SPMD over a (dp, pp, cp, tp) device mesh; Pallas kernels for the hot
+ops; functional models; orbax checkpoints. See SURVEY.md for the blueprint.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,546 @@
+"""Configuration system for the TPU-native Megatron-LLM rebuild.
+
+Replaces the reference's argparse flag system (``megatron/arguments.py`` — ~180
+underscore-style flags in 16 groups) with typed dataclass groups plus a CLI
+parser generated from the dataclass fields.  Flag names are kept identical to
+the reference wherever the concept survives the TPU redesign, so launch
+scripts translate one-to-one.
+
+Reference: /root/reference/megatron/arguments.py:15-1106.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from dataclasses import dataclass, field, fields
+from typing import Any, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Group dataclasses
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModelConfig:
+    """Network architecture (reference ``_add_network_size_args``)."""
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    ffn_hidden_size: Optional[int] = None  # default 4*h (or derived for GLU)
+    num_attention_heads: int = 4
+    # GQA / MQA: number of KV heads.  None => MHA (== num_attention_heads).
+    num_attention_heads_kv: Optional[int] = None
+    kv_channels: Optional[int] = None  # default hidden_size // num_heads
+    max_position_embeddings: int = 2048
+    # 'rotary' | 'absolute' | 'none'
+    position_embedding_type: str = "rotary"
+    rope_theta: float = 10000.0
+    # Linear position-interpolation scaling (CodeLlama 32K path):
+    # positions are divided by this factor (reference positional_embeddings.py:11).
+    rope_scaling_factor: float = 1.0
+    vocab_size: Optional[int] = None  # set from tokenizer
+    make_vocab_size_divisible_by: int = 128
+    layernorm_epsilon: float = 1e-5
+    use_rms_norm: bool = True
+    # GLU activation: None | 'swiglu' | 'geglu' | 'reglu' | 'liglu'
+    glu_activation: Optional[str] = "swiglu"
+    # plain activation when glu_activation is None: 'gelu' | 'relu' | 'squared_relu'
+    activation: str = "gelu"
+    use_bias: bool = False  # reference --no_bias inverted
+    # Falcon-style: attention and MLP computed in parallel from the same LN.
+    parallel_attn: bool = False
+    # Falcon-40B style: separate LN for the parallel MLP branch.
+    parallel_layernorm: bool = False
+    # Mistral sliding-window attention size (None = full causal).
+    sliding_window_size: Optional[int] = None
+    tie_embed_logits: bool = False  # share input embedding and output head
+    apply_query_key_layer_scaling: bool = False
+    attention_softmax_in_fp32: bool = True
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    init_method_std: float = 0.02
+    # scale output-layer init by 1/sqrt(2*num_layers) (reference use_scaled_init_method)
+    use_scaled_init_method: bool = True
+    # LIMA per-layer dropout: linearly ramp hidden_dropout from 0 to value.
+    lima_dropout: bool = False
+    # use learned absolute position embeddings in addition (bert/gpt legacy)
+    bert_binary_head: bool = False
+
+    def finalize(self) -> None:
+        if self.kv_channels is None:
+            assert self.hidden_size % self.num_attention_heads == 0, (
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_attention_heads {self.num_attention_heads}"
+            )
+            self.kv_channels = self.hidden_size // self.num_attention_heads
+        if self.num_attention_heads_kv is None:
+            self.num_attention_heads_kv = self.num_attention_heads
+        if self.ffn_hidden_size is None:
+            if self.glu_activation is not None:
+                # Llama convention: 2/3 * 4h rounded up to a multiple of 256.
+                ffn = int(4 * self.hidden_size * 2 / 3)
+                self.ffn_hidden_size = 256 * ((ffn + 255) // 256)
+            else:
+                self.ffn_hidden_size = 4 * self.hidden_size
+
+
+@dataclass
+class ParallelConfig:
+    """Device-mesh layout (reference TP/PP/DP world carving, parallel_state.py:51-205).
+
+    TPU-native: one JAX process sees all devices; parallelism is expressed as a
+    ``jax.sharding.Mesh`` over axes (dp, pp, tp) instead of NCCL subgroups.
+    """
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    # data parallel size; None = infer from device count / (tp*pp)
+    data_parallel_size: Optional[int] = None
+    # Megatron-style sequence parallelism: shard seq dim over tp in LN/dropout
+    # regions (activation memory / TP).
+    sequence_parallel: bool = False
+    # Context parallelism (ring attention) size — extension beyond reference.
+    context_parallel_size: int = 1
+    # Expert parallelism for MoE — extension beyond reference.
+    expert_parallel_size: int = 1
+    num_micro_batches: Optional[int] = None  # derived from batch sizes
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    # 'gpipe' (all-fwd-then-all-bwd, differentiable scan) or '1f1b'
+    pipeline_schedule: str = "1f1b"
+    # activation recompute: None | 'full' | 'selective'
+    recompute_granularity: Optional[str] = "selective"
+    # shard stacked-layer scan carries over tp when sequence_parallel
+    distribute_saved_activations: bool = False
+
+    def finalize(self, n_devices: Optional[int] = None) -> None:
+        if self.data_parallel_size is None and n_devices is not None:
+            mp = (
+                self.tensor_model_parallel_size
+                * self.pipeline_model_parallel_size
+                * self.context_parallel_size
+            )
+            assert n_devices % mp == 0, (
+                f"device count {n_devices} not divisible by model-parallel size {mp}"
+            )
+            self.data_parallel_size = n_devices // mp
+
+
+@dataclass
+class TrainingConfig:
+    """Training driver knobs (reference ``_add_training_args``)."""
+
+    micro_batch_size: int = 1
+    global_batch_size: Optional[int] = None
+    rampup_batch_size: Optional[Tuple[int, int, int]] = None  # start, incr, samples
+    train_iters: Optional[int] = None
+    train_samples: Optional[int] = None
+    eval_iters: int = 10
+    eval_interval: int = 1000
+    exit_interval: Optional[int] = None
+    exit_duration_in_mins: Optional[int] = None
+    exit_signal_handler: bool = False
+    seed: int = 1234
+    data_parallel_random_init: bool = False
+    # numerics
+    params_dtype: str = "bfloat16"  # 'float32' | 'bfloat16' | 'float16'
+    fp32_residual_connection: bool = False
+    accumulate_allreduce_grads_in_fp32: bool = True
+    # loss scaling (fp16 only)
+    loss_scale: Optional[float] = None  # None => dynamic
+    initial_loss_scale: float = 2.0 ** 32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    # perf switches
+    use_flash_attn: bool = True
+    scan_layers: bool = True  # lax.scan over stacked layers (compile time)
+    remat_policy: str = "save_dots_except_logits"
+    skip_train: bool = False
+    skip_iters: List[int] = field(default_factory=list)
+
+
+@dataclass
+class OptimizerConfig:
+    """Reference ``_add_learning_rate_args`` + ``_add_regularization_args``."""
+
+    optimizer: str = "adam"  # 'adam' | 'sgd'
+    lr: float = 3e-4
+    min_lr: float = 0.0
+    lr_decay_style: str = "cosine"  # constant|linear|cosine|inverse-square-root
+    lr_decay_iters: Optional[int] = None
+    lr_decay_samples: Optional[int] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_samples: int = 0
+    lr_warmup_fraction: Optional[float] = None
+    override_opt_param_scheduler: bool = False
+    use_checkpoint_opt_param_scheduler: bool = False
+    weight_decay: float = 0.01
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"  # constant|linear|cosine
+    clip_grad: float = 1.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    # ZeRO-1: shard fp32 optimizer state over dp (reference distrib_optimizer.py)
+    use_distributed_optimizer: bool = False
+
+
+@dataclass
+class DataConfig:
+    """Reference ``_add_data_args``."""
+
+    data_path: List[str] = field(default_factory=list)  # weight path pairs ok
+    split: str = "969, 30, 1"
+    train_data_path: List[str] = field(default_factory=list)
+    valid_data_path: List[str] = field(default_factory=list)
+    test_data_path: List[str] = field(default_factory=list)
+    seq_length: int = 2048
+    num_workers: int = 2
+    tokenizer_type: str = "SentencePieceTokenizer"
+    vocab_file: Optional[str] = None
+    merge_file: Optional[str] = None
+    tokenizer_model: Optional[str] = None  # sentencepiece model path
+    vocab_extra_ids: int = 0
+    vocab_extra_ids_list: Optional[str] = None
+    no_new_tokens: bool = False
+    data_impl: str = "mmap"  # 'mmap' | 'infer'
+    mmap_warmup: bool = False
+    dataloader_type: str = "single"  # 'single' | 'cyclic'
+    reset_position_ids: bool = False
+    reset_attention_mask: bool = False
+    eod_mask_loss: bool = False
+    # instruction tuning
+    data_type: str = "gpt"  # 'gpt' | 'instruction'
+    variable_seq_lengths: bool = False
+    scalar_loss_mask: float = 0.0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference ``_add_checkpointing_args`` + checkpointing.py behavior."""
+
+    save: Optional[str] = None
+    save_interval: Optional[int] = None
+    load: Optional[str] = None
+    no_load_optim: bool = False
+    no_load_rng: bool = False
+    no_save_optim: bool = False
+    no_save_rng: bool = False
+    finetune: bool = False
+    use_checkpoint_args: bool = False
+    exit_on_missing_checkpoint: bool = False
+    async_save: bool = False
+    keep_last_n_checkpoints: Optional[int] = None
+
+
+@dataclass
+class LoggingConfig:
+    """Reference ``_add_logging_args`` + wandb shim."""
+
+    log_interval: int = 100
+    timing_log_level: int = 0
+    timing_log_option: str = "minmax"  # max|minmax|all
+    tensorboard_dir: Optional[str] = None
+    tensorboard_log_interval: int = 1
+    tensorboard_queue_size: int = 1000
+    log_timers_to_tensorboard: bool = False
+    log_learning_rate_to_tensorboard: bool = True
+    log_loss_scale_to_tensorboard: bool = True
+    log_memory_to_tensorboard: bool = False
+    log_params_norm: bool = False
+    log_num_zeros_in_grad: bool = False
+    wandb_logger: bool = False
+    wandb_project: str = ""
+    wandb_entity: str = ""
+    wandb_name: Optional[str] = None
+    wandb_id: Optional[str] = None
+    wandb_resume: bool = False
+    wandb_api_key: Optional[str] = None
+    metrics: List[str] = field(default_factory=list)
+
+
+@dataclass
+class InferenceConfig:
+    """Text-generation server/sampling defaults."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    max_tokens_to_oom: int = 12000
+    port: int = 5000
+
+
+@dataclass
+class Config:
+    """Aggregate configuration (analog of the reference's global ``args``)."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
+    # architecture family: 'gpt' | 'llama' | 'llama2' | 'codellama' | 'falcon' | 'mistral'
+    model_name: str = "llama2"
+
+    def finalize(self, n_devices: Optional[int] = None) -> "Config":
+        """Derive defaults and enforce cross-flag invariants.
+
+        Mirrors the reference's ``validate_args`` (arguments.py:53-350).
+        """
+        self.model.finalize()
+        self.parallel.finalize(n_devices)
+        t = self.training
+        if t.global_batch_size is None:
+            dp = self.parallel.data_parallel_size or 1
+            t.global_batch_size = t.micro_batch_size * dp
+        if self.parallel.num_micro_batches is None:
+            dp = self.parallel.data_parallel_size or 1
+            denom = t.micro_batch_size * dp
+            assert t.global_batch_size % denom == 0, (
+                f"global_batch_size {t.global_batch_size} not divisible by "
+                f"micro_batch_size*dp {denom}"
+            )
+            self.parallel.num_micro_batches = t.global_batch_size // denom
+        # sequence parallelism requires TP>1 to do anything
+        if self.parallel.tensor_model_parallel_size == 1:
+            self.parallel.sequence_parallel = False
+        # bf16 training accumulates grads in fp32 (reference validate_args:139-148)
+        if t.params_dtype in ("bfloat16", "float16"):
+            t.accumulate_allreduce_grads_in_fp32 = True
+        if self.model.num_attention_heads_kv is not None:
+            assert (
+                self.model.num_attention_heads % self.model.num_attention_heads_kv == 0
+            ), "num_attention_heads must be divisible by num_attention_heads_kv"
+        if self.parallel.pipeline_model_parallel_size > 1:
+            assert (
+                self.model.num_layers % self.parallel.pipeline_model_parallel_size == 0
+            ), "num_layers must be divisible by pipeline_model_parallel_size"
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Architecture presets (reference model/llama_model.py, falcon_model.py,
+# mistral_model.py flag bundles)
+# ---------------------------------------------------------------------------
+
+ARCH_DEFAULTS = {
+    "gpt": dict(
+        use_rms_norm=False,
+        glu_activation=None,
+        use_bias=True,
+        tie_embed_logits=True,
+        position_embedding_type="absolute",
+    ),
+    # llama_model.py:22-30: rotary + swiglu + RMSNorm + no bias + untied embeddings
+    "llama": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-6,
+    ),
+    "llama2": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-5,
+    ),
+    # CodeLlama: llama2 + rope_theta=1e6 (arguments.py:467-468)
+    "codellama": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-5,
+        rope_theta=1_000_000.0,
+    ),
+    # falcon_model.py:18-29: MQA/GQA + parallel attention (+ parallel layernorm for 40B)
+    "falcon": dict(
+        use_rms_norm=False,
+        glu_activation=None,
+        use_bias=False,
+        tie_embed_logits=True,
+        position_embedding_type="rotary",
+        parallel_attn=True,
+    ),
+    # mistral_model.py:30: llama2 bundle + sliding window 4096
+    "mistral": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-5,
+        sliding_window_size=4096,
+    ),
+}
+
+# Canonical model sizes (hidden/layers/heads/kv-heads/ffn) for convenience.
+MODEL_SIZES = {
+    "llama2-7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                      num_attention_heads_kv=32, ffn_hidden_size=11008,
+                      max_position_embeddings=4096),
+    "llama2-13b": dict(num_layers=40, hidden_size=5120, num_attention_heads=40,
+                       num_attention_heads_kv=40, ffn_hidden_size=13824,
+                       max_position_embeddings=4096),
+    "llama2-70b": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
+                       num_attention_heads_kv=8, ffn_hidden_size=28672,
+                       max_position_embeddings=4096),
+    "codellama-34b": dict(num_layers=48, hidden_size=8192, num_attention_heads=64,
+                          num_attention_heads_kv=8, ffn_hidden_size=22016,
+                          max_position_embeddings=16384),
+    "falcon-7b": dict(num_layers=32, hidden_size=4544, num_attention_heads=71,
+                      num_attention_heads_kv=1, max_position_embeddings=2048),
+    "falcon-40b": dict(num_layers=60, hidden_size=8192, num_attention_heads=128,
+                       num_attention_heads_kv=8, max_position_embeddings=2048,
+                       parallel_layernorm=True),
+    "mistral-7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                       num_attention_heads_kv=8, ffn_hidden_size=14336,
+                       max_position_embeddings=32768),
+}
+
+
+def apply_architecture(cfg: Config, model_name: str, size: Optional[str] = None) -> Config:
+    """Apply an architecture flag bundle (and optionally a canonical size)."""
+    family = model_name.split("-")[0] if model_name not in ARCH_DEFAULTS else model_name
+    if model_name in MODEL_SIZES and size is None:
+        size = model_name
+    assert family in ARCH_DEFAULTS, f"unknown model family {family}"
+    cfg.model_name = family
+    for k, v in ARCH_DEFAULTS[family].items():
+        setattr(cfg.model, k, v)
+    if size is not None:
+        assert size in MODEL_SIZES, f"unknown model size {size}"
+        for k, v in MODEL_SIZES[size].items():
+            setattr(cfg.model, k, v)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# CLI generation
+# ---------------------------------------------------------------------------
+
+_GROUPS = {
+    "model": ModelConfig,
+    "parallel": ParallelConfig,
+    "training": TrainingConfig,
+    "optimizer": OptimizerConfig,
+    "data": DataConfig,
+    "checkpoint": CheckpointConfig,
+    "logging": LoggingConfig,
+    "inference": InferenceConfig,
+}
+
+
+def _add_field_arg(parser: argparse.ArgumentParser, f: dataclasses.Field) -> None:
+    # Note: `from __future__ import annotations` makes f.type a *string*
+    # (e.g. "Optional[Tuple[int, int, int]]"), so dispatch is textual.
+    name = "--" + f.name
+    tstr = f.type if isinstance(f.type, str) else str(f.type)
+    if "bool" in tstr:
+        parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                            nargs="?", const=True, default=None)
+    elif "List[int]" in tstr or "Tuple" in tstr:
+        parser.add_argument(name, nargs="*", type=int, default=None)
+    elif "List" in tstr or "list" in tstr:
+        parser.add_argument(name, nargs="*", default=None)
+    else:
+        # int/float/str and Optional[...] thereof: coerced at assign time
+        parser.add_argument(name, type=str, default=None)
+
+
+def _coerce(value: Any, default: Any) -> Any:
+    if value is None:
+        return None
+    if isinstance(value, list):
+        return tuple(value) if isinstance(default, tuple) else value
+    if isinstance(value, (tuple, bool)):
+        return value
+    if value == "None":
+        return None
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    # defaults of None: try int, float, then str
+    if default is None:
+        for cast in (int, float):
+            try:
+                return cast(value)
+            except (TypeError, ValueError):
+                pass
+    return value
+
+
+def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="TPU-native Megatron-LLM", allow_abbrev=False
+    )
+    parser.add_argument("--model_name", type=str, default=None,
+                        help="gpt|llama|llama2|codellama|falcon|mistral or a "
+                             "canonical size like llama2-7b")
+    seen = set()
+    for group_name, group_cls in _GROUPS.items():
+        group = parser.add_argument_group(group_name)
+        for f in fields(group_cls):
+            if f.name in seen:
+                continue
+            seen.add(f.name)
+            _add_field_arg(group, f)
+    if extra_args_provider is not None:
+        extra_args_provider(parser)
+    return parser
+
+
+def parse_args(argv: Optional[List[str]] = None, extra_args_provider=None,
+               args_defaults: Optional[dict] = None,
+               n_devices: Optional[int] = None, finalize: bool = True) -> Config:
+    """Parse CLI flags into a finalized :class:`Config`.
+
+    ``args_defaults`` mirrors the reference's programmatic defaults injection
+    (initialize.py:39): values applied before CLI overrides.
+    """
+    parser = build_parser(extra_args_provider)
+    ns, _unknown = parser.parse_known_args(sys.argv[1:] if argv is None else argv)
+    cfg = Config()
+    if ns.model_name:
+        apply_architecture(cfg, ns.model_name)
+    if args_defaults:
+        for k, v in args_defaults.items():
+            _set_flag(cfg, k, v)
+    for group_name, group_cls in _GROUPS.items():
+        sub = getattr(cfg, group_name)
+        for f in fields(group_cls):
+            val = getattr(ns, f.name, None)
+            if val is not None:
+                default = getattr(sub, f.name)
+                setattr(sub, f.name, _coerce(val, default))
+    if finalize:
+        cfg.finalize(n_devices=n_devices)
+    return cfg
+
+
+def _set_flag(cfg: Config, name: str, value: Any) -> None:
+    """Set a flat flag name on whichever group owns it."""
+    for group_name, group_cls in _GROUPS.items():
+        if name in {f.name for f in fields(group_cls)}:
+            setattr(getattr(cfg, group_name), name, value)
+            return
+    if hasattr(cfg, name):
+        setattr(cfg, name, value)
+        return
+    raise KeyError(f"unknown flag {name}")

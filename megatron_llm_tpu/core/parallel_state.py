@@ -1,0 +1,166 @@
+"""Device-mesh topology — TPU-native replacement of the reference "mpu".
+
+The reference (megatron/core/parallel_state.py:51-205) carves the NCCL world
+into data/tensor/pipeline/embedding process subgroups, one process per GPU.
+On TPU we run single-program SPMD: one JAX process sees every chip, and
+parallelism is a named ``jax.sharding.Mesh`` over axes ``(dp, pp, tp)``.
+Collectives that the reference issues explicitly (all-reduce over the TP
+group, isend/irecv over the PP group, ...) become either XLA-inserted
+collectives (via ``NamedSharding`` constraints) or explicit ``psum`` /
+``ppermute`` over mesh axis names inside ``shard_map``.
+
+Axis order is (dp, pp, tp) so that tp is innermost — adjacent devices on the
+ICI ring carry the highest-bandwidth collectives (TP all-reduce), matching
+the reference's guidance that TP ranks be intra-node (NVLink there, ICI here).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names.
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+TP_AXIS = "tp"
+CP_AXIS = "cp"  # context (sequence/ring-attention) parallelism
+EP_AXIS = "ep"  # expert parallelism (MoE)
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """Logical parallel layout; mirrors reference initialize_model_parallel args."""
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    data_parallel_size: Optional[int] = None
+    context_parallel_size: int = 1
+
+
+def build_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    data_parallel_size: Optional[int] = None,
+    context_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (dp, pp, cp, tp) device mesh.
+
+    Analog of ``initialize_model_parallel`` (parallel_state.py:51-205): instead
+    of enumerating rank lists per subgroup, the reshaped device array defines
+    every "group" implicitly — a TP group is a row of the tp axis, etc.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    tp = tensor_model_parallel_size
+    pp = pipeline_model_parallel_size
+    cp = context_parallel_size
+    if data_parallel_size is None:
+        assert n % (tp * pp * cp) == 0, (
+            f"{n} devices not divisible by tp*pp*cp = {tp * pp * cp}"
+        )
+        dp = n // (tp * pp * cp)
+    else:
+        dp = data_parallel_size
+    assert dp * pp * cp * tp == n, (
+        f"dp*pp*cp*tp = {dp * pp * cp * tp} != device count {n}"
+    )
+    dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+    return Mesh(dev_array, (DP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS))
+
+
+def build_mesh_from_config(cfg, devices=None) -> Mesh:
+    p = cfg.parallel
+    return build_mesh(
+        tensor_model_parallel_size=p.tensor_model_parallel_size,
+        pipeline_model_parallel_size=p.pipeline_model_parallel_size,
+        data_parallel_size=p.data_parallel_size,
+        context_parallel_size=p.context_parallel_size,
+        devices=devices,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Global mesh management (analog of the reference's module-level group
+# singletons + get_*_group accessors, parallel_state.py:217-481)
+# ---------------------------------------------------------------------------
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Mesh:
+    assert _GLOBAL_MESH is not None, "mesh is not initialized (call set_global_mesh)"
+    return _GLOBAL_MESH
+
+
+def mesh_is_initialized() -> bool:
+    return _GLOBAL_MESH is not None
+
+
+def destroy_global_mesh() -> None:
+    """Analog of destroy_model_parallel (parallel_state.py:497-524)."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = None
+
+
+@contextlib.contextmanager
+def global_mesh(mesh: Mesh):
+    global _GLOBAL_MESH
+    prev = _GLOBAL_MESH
+    set_global_mesh(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _GLOBAL_MESH = prev
+
+
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def get_tensor_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh or get_global_mesh(), TP_AXIS)
+
+
+def get_pipeline_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh or get_global_mesh(), PP_AXIS)
+
+
+def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh or get_global_mesh(), DP_AXIS)
+
+
+def get_context_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh or get_global_mesh(), CP_AXIS)
+
+
+def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_global_mesh(), P(*spec))
+
+
+# Inside shard_map, pipeline stage index is the device's coordinate on the pp
+# axis (analog of get_pipeline_model_parallel_rank, parallel_state.py:311-320).
+
+def pipeline_stage_index() -> jax.Array:
+    """Current pp-stage index; only valid inside shard_map over PP_AXIS."""
+    return jax.lax.axis_index(PP_AXIS)
+
+
+def is_pipeline_first_stage() -> jax.Array:
+    return pipeline_stage_index() == 0
+
+
+def is_pipeline_last_stage() -> jax.Array:
+    return pipeline_stage_index() == jax.lax.axis_size(PP_AXIS) - 1

@@ -1,0 +1,61 @@
+"""Batch post-processing: causal-LM shift, loss masks, EOD resets.
+
+Reference: megatron/utils.py:137-194 ``get_ltor_masks_and_position_ids`` —
+but instead of materializing a [b, 1, s, s] attention-mask tensor, document
+boundaries are expressed as **segment ids** (packed-sequence form) which the
+attention op (ops/attention.py) turns into block-diagonal masking; this is
+both O(s) host-side and what the flash kernel consumes directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def get_ltor_batch(
+    tokens_full: np.ndarray,  # [b, s+1] int
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Build {tokens, labels, loss_mask, position_ids[, segment_ids]}."""
+    tokens = tokens_full[:, :-1]
+    labels = tokens_full[:, 1:]
+    b, s = tokens.shape
+
+    loss_mask = np.ones((b, s), np.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask[labels == eod_token] = 0.0
+
+    position_ids = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    out: Dict[str, np.ndarray] = {
+        "tokens": tokens.astype(np.int32),
+        "labels": labels.astype(np.int32),
+        "loss_mask": loss_mask,
+    }
+
+    if (reset_position_ids or reset_attention_mask) and eod_token is not None:
+        is_eod = tokens == eod_token
+        # segment id = number of EODs strictly before this position
+        seg = np.cumsum(is_eod, axis=1) - is_eod.astype(np.int64)
+        if reset_attention_mask:
+            out["segment_ids"] = seg.astype(np.int32)
+        if reset_position_ids:
+            # position within the current segment
+            doc_start = np.zeros((b, s), np.int64)
+            idx = np.arange(s)
+            for row in range(b):
+                starts = np.flatnonzero(is_eod[row]) + 1
+                prev = np.zeros(s, np.int64)
+                if starts.size:
+                    prev = starts[
+                        np.clip(np.searchsorted(starts, idx, side="right") - 1, 0, None)
+                    ] * (np.searchsorted(starts, idx, side="right") > 0)
+                doc_start[row] = prev
+            position_ids = (idx[None, :] - doc_start).astype(np.int32)
+
+    out["position_ids"] = position_ids.astype(np.int32)
+    return out

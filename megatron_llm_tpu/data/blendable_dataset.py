@@ -1,0 +1,50 @@
+"""Weighted mixture over datasets.
+
+Reference: megatron/data/blendable_dataset.py:12-53 + the C++
+``helpers.build_blending_indices``. The index build here is a vectorized
+largest-remainder assignment in numpy with identical intent: sample i draws
+from the dataset whose consumed fraction is furthest below its weight.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def build_blending_indices(weights: np.ndarray, size: int):
+    """Greedy proportional-fill (helpers.cpp:20-80 semantics, vectorized by
+    chunk): returns (dataset_index[size] u8, dataset_sample_index[size] i64)."""
+    n = len(weights)
+    dataset_index = np.empty(size, np.uint8)
+    dataset_sample_index = np.empty(size, np.int64)
+    current = np.zeros(n, np.int64)
+    for i in range(size):
+        # error_k = w_k * (i+1) - consumed_k ; pick argmax
+        errors = weights * (i + 1) - current
+        k = int(np.argmax(errors))
+        dataset_index[i] = k
+        dataset_sample_index[i] = current[k]
+        current[k] += 1
+    return dataset_index, dataset_sample_index
+
+
+class BlendableDataset:
+    def __init__(self, datasets: Sequence, weights, size: int):
+        assert len(datasets) == len(weights)
+        self.datasets = list(datasets)
+        w = np.asarray(weights, np.float64)
+        self.weights = w / w.sum()
+        self.size = size
+        self.dataset_index, self.dataset_sample_index = build_blending_indices(
+            self.weights, size
+        )
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int):
+        ds = self.dataset_index[idx]
+        sample = self.dataset_sample_index[idx]
+        return self.datasets[ds][sample % len(self.datasets[ds])]

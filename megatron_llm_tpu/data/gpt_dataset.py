@@ -1,0 +1,255 @@
+"""GPT pretraining dataset: sample assembly over the indexed token store.
+
+Reference: megatron/data/gpt_dataset.py — the (doc_idx, sample_idx,
+shuffle_idx) triple built at :272-379 (with the C++ ``helpers.build_sample_idx``
+at :354-358) and cross-document sample assembly at :243-269.
+
+TPU-native notes: index building is vectorized numpy (prefix sums) instead of
+a C++ loop — same output arrays, cached as ``.npy`` next to the data with the
+same naming scheme, so caches interoperate conceptually (not byte-identical
+filenames: we hash differently). There is no rank-0-builds-then-broadcast
+dance (gpt_dataset.py:280-299): one host process builds, and multi-host
+launches coordinate via the filesystem cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset, make_dataset
+
+
+def get_train_valid_test_split_(splits_string: str, size: int) -> List[int]:
+    """Parse "969, 30, 1"-style weights into index boundaries
+    (reference dataset_utils.py:616-637 semantics)."""
+    splits = []
+    if splits_string.find(",") != -1:
+        splits = [float(s) for s in splits_string.split(",")]
+    elif splits_string.find("/") != -1:
+        splits = [float(s) for s in splits_string.split("/")]
+    else:
+        splits = [float(splits_string)]
+    while len(splits) < 3:
+        splits.append(0.0)
+    splits = splits[:3]
+    total = sum(splits)
+    assert total > 0.0
+    splits = [s / total for s in splits]
+    index = [0]
+    for s in splits:
+        index.append(index[-1] + int(round(s * float(size))))
+    diff = index[-1] - size
+    for i in range(1, len(index)):
+        index[i] -= diff
+    assert len(index) == 4 and index[-1] == size
+    return index
+
+
+def _build_doc_idx(documents: np.ndarray, num_epochs: int, rng: np.random.RandomState,
+                   separate_last_epoch: bool) -> np.ndarray:
+    """Shuffled concatenation of the document list over epochs
+    (gpt_dataset.py:399-421 semantics)."""
+    if not separate_last_epoch or num_epochs == 1:
+        doc_idx = np.tile(documents, num_epochs)
+        rng.shuffle(doc_idx)
+        return doc_idx.astype(np.int32)
+    first = _build_doc_idx(documents, num_epochs - 1, rng, False)
+    last = _build_doc_idx(documents, 1, rng, False)
+    return np.concatenate((first, last))
+
+
+def _build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int,
+                      num_samples: int) -> np.ndarray:
+    """Vectorized replacement of helpers.cpp::build_sample_idx (:83-185).
+
+    Returns [num_samples+1, 2] int32: for each sample boundary, (index into
+    doc_idx, token offset within that document). Sample i spans tokens
+    [boundary_i, boundary_{i+1}] with one extra token for the label shift.
+    """
+    doc_lens = sizes[doc_idx].astype(np.int64)
+    cum = np.concatenate(([0], np.cumsum(doc_lens)))
+    total_tokens = int(cum[-1])
+    # each sample consumes seq_length tokens (+1 readahead shared across
+    # boundaries, matching the reference's one-token overlap)
+    starts = np.arange(num_samples + 1, dtype=np.int64) * seq_length
+    assert starts[-1] <= total_tokens - 1, (
+        f"not enough tokens ({total_tokens}) for {num_samples} samples "
+        f"of seq_length {seq_length}"
+    )
+    # docs are [cum[k], cum[k+1]); find k and offset for each boundary
+    doc_of_start = np.searchsorted(cum, starts, side="right") - 1
+    offsets = starts - cum[doc_of_start]
+    out = np.empty((num_samples + 1, 2), np.int32)
+    out[:, 0] = doc_of_start
+    out[:, 1] = offsets
+    return out
+
+
+def _build_shuffle_idx(num_samples: int, total_size: int,
+                       rng: np.random.RandomState) -> np.ndarray:
+    """Two-region shuffle (gpt_dataset.py:481-513): shuffle the first
+    num_samples and the tail separately."""
+    dtype = np.uint32 if total_size < (np.iinfo(np.uint32).max - 1) else np.int64
+    first = np.arange(num_samples, dtype=dtype)
+    rng.shuffle(first)
+    if num_samples == total_size:
+        return first
+    last = np.arange(num_samples, total_size, dtype=dtype)
+    rng.shuffle(last)
+    return np.concatenate((first, last))
+
+
+class GPTDataset:
+    """Map-style dataset yielding {'text': [seq_length+1] int64} samples."""
+
+    def __init__(
+        self,
+        name: str,
+        indexed: MMapIndexedDataset,
+        documents: np.ndarray,
+        num_samples: int,
+        seq_length: int,
+        seed: int,
+        cache_dir: Optional[str] = None,
+        data_prefix: str = "",
+    ):
+        self.name = name
+        self.indexed = indexed
+        self.seq_length = seq_length
+
+        doc_lens = indexed.sizes[documents].astype(np.int64)
+        tokens_per_epoch = int(doc_lens.sum())
+        assert tokens_per_epoch > seq_length, "dataset smaller than one sample"
+        samples_per_epoch = (tokens_per_epoch - 1) // seq_length
+        num_epochs = max(1, -(-(num_samples * seq_length + 1) // tokens_per_epoch))
+        total_samples = (num_epochs * tokens_per_epoch - 1) // seq_length
+        # separate-last-epoch heuristic (gpt_dataset.py:320-337): avoid the
+        # last partial epoch leaking shuffled duplicates into early samples.
+        separate_last = (
+            num_epochs > 1
+            and (total_samples - num_samples) / max(samples_per_epoch, 1) < 0.80
+        )
+
+        cache_key = None
+        if cache_dir or data_prefix:
+            base = cache_dir or (os.path.dirname(data_prefix) or ".")
+            desc = f"{name}-{len(documents)}-{num_samples}-{seq_length}-{seed}-{num_epochs}"
+            h = hashlib.md5(desc.encode()).hexdigest()[:16]
+            cache_key = os.path.join(base, f"index-cache-{h}")
+
+        if cache_key and os.path.exists(cache_key + "-sample.npy"):
+            self.doc_idx = np.load(cache_key + "-doc.npy", mmap_mode="r")
+            self.sample_idx = np.load(cache_key + "-sample.npy", mmap_mode="r")
+            self.shuffle_idx = np.load(cache_key + "-shuffle.npy", mmap_mode="r")
+        else:
+            rng = np.random.RandomState(seed)
+            self.doc_idx = _build_doc_idx(documents, num_epochs, rng, separate_last)
+            self.sample_idx = _build_sample_idx(
+                indexed.sizes, self.doc_idx, seq_length, total_samples
+            )
+            self.shuffle_idx = _build_shuffle_idx(
+                num_samples if separate_last else total_samples,
+                total_samples, rng,
+            )
+            if cache_key:
+                try:
+                    np.save(cache_key + "-doc.npy", self.doc_idx)
+                    np.save(cache_key + "-sample.npy", self.sample_idx)
+                    np.save(cache_key + "-shuffle.npy", self.shuffle_idx)
+                except OSError:
+                    pass  # read-only data dir: build in memory every time
+
+    def __len__(self) -> int:
+        return self.shuffle_idx.shape[0]
+
+    def __getitem__(self, idx: int) -> dict:
+        idx = int(self.shuffle_idx[idx % len(self)])
+        doc_f, off_f = self.sample_idx[idx]
+        doc_l, off_l = self.sample_idx[idx + 1]
+        if doc_f == doc_l:
+            sample = self.indexed.get(
+                int(self.doc_idx[doc_f]), int(off_f), int(off_l - off_f) + 1
+            )
+        else:
+            parts = [self.indexed.get(int(self.doc_idx[doc_f]), int(off_f))]
+            for d in range(int(doc_f) + 1, int(doc_l)):
+                parts.append(self.indexed.get(int(self.doc_idx[d])))
+            parts.append(self.indexed.get(int(self.doc_idx[doc_l]), 0, int(off_l) + 1))
+            sample = np.concatenate(parts)
+        assert sample.shape[0] == self.seq_length + 1, (
+            f"sample {idx}: got {sample.shape[0]} tokens"
+        )
+        return {"text": sample.astype(np.int64)}
+
+
+def build_train_valid_test_datasets(
+    data_prefix: Sequence[str],
+    splits_string: str,
+    train_valid_test_num_samples: Tuple[int, int, int],
+    seq_length: int,
+    seed: int,
+    data_impl: str = "mmap",
+    skip_warmup: bool = True,
+):
+    """Reference build_train_valid_test_datasets (gpt_dataset.py:20) analog.
+
+    ``data_prefix``: single path, or weighted list [w0, p0, w1, p1, ...].
+    """
+    if len(data_prefix) == 1:
+        return _build_single(
+            data_prefix[0], splits_string, train_valid_test_num_samples,
+            seq_length, seed, data_impl, skip_warmup,
+        )
+    from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+
+    prefixes, weights, per_ds = _normalize_blend(
+        data_prefix, train_valid_test_num_samples
+    )
+    train, valid, test = [], [], []
+    for i, p in enumerate(prefixes):
+        t, v, te = _build_single(
+            p, splits_string, per_ds[i], seq_length, seed, data_impl, skip_warmup
+        )
+        train.append(t), valid.append(v), test.append(te)
+
+    def blend(parts, n):
+        parts = [p for p in parts if p is not None]
+        return BlendableDataset(parts, weights, n) if parts else None
+
+    return (
+        blend(train, train_valid_test_num_samples[0]),
+        blend(valid, train_valid_test_num_samples[1]),
+        blend(test, train_valid_test_num_samples[2]),
+    )
+
+
+def _normalize_blend(data_prefix, nums):
+    assert len(data_prefix) % 2 == 0, "blend list must be [w, path, w, path, ...]"
+    weights = np.array([float(w) for w in data_prefix[::2]])
+    prefixes = list(data_prefix[1::2])
+    weights = weights / weights.sum()
+    per_ds = []
+    for w in weights:
+        per_ds.append(tuple(int(np.ceil(w * n * 1.005)) for n in nums))
+    return prefixes, weights, per_ds
+
+
+def _build_single(prefix, splits_string, nums, seq_length, seed, data_impl,
+                  skip_warmup):
+    indexed = make_dataset(prefix, data_impl, skip_warmup)
+    total_docs = indexed.doc_idx.shape[0] - 1
+    splits = get_train_valid_test_split_(splits_string, total_docs)
+    out = []
+    for i, name in enumerate(("train", "valid", "test")):
+        if splits[i + 1] > splits[i] and nums[i] > 0:
+            documents = np.arange(splits[i], splits[i + 1], dtype=np.int32)
+            out.append(GPTDataset(name, indexed, documents, nums[i], seq_length,
+                                  seed, data_prefix=prefix))
+        else:
+            out.append(None)
+    return tuple(out)

@@ -1,0 +1,183 @@
+"""Memory-mapped token storage — the Megatron ``.bin``/``.idx`` format.
+
+Binary-compatible with the reference's MMapIndexedDataset
+(megatron/data/indexed_dataset.py:341-528; index header written at :346-389)
+so corpora preprocessed with the reference's tools load directly, and vice
+versa. Implementation is fresh numpy (zero torch): the index is parsed with
+``np.frombuffer`` over one mmap; token reads are zero-copy ``np.memmap``
+slices.
+
+Format (little-endian):
+  .idx: magic ``MMIDIDX\\x00\\x00`` | u64 version=1 | u8 dtype_code |
+        u64 n_sequences | u64 n_documents |
+        i32 sizes[n_sequences] | i64 pointers[n_sequences] (byte offsets) |
+        i64 doc_idx[n_documents] (sequence index at each document start)
+  .bin: raw token array, concatenated sequences.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+from functools import lru_cache
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_INDEX_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+# dtype codes shared with the reference (indexed_dataset.py:100-110)
+DTYPES = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.float64,
+    7: np.float32,
+    8: np.uint16,
+}
+DTYPE_CODES = {np.dtype(v): k for k, v in DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+def infer_dataset_impl(path: str) -> Optional[str]:
+    """Peek at the index magic (reference make_dataset 'infer' mode)."""
+    with open(index_file_path(path), "rb") as f:
+        magic = f.read(9)
+    return "mmap" if magic == _INDEX_MAGIC else None
+
+
+def best_fitting_dtype(vocab_size: Optional[int]) -> np.dtype:
+    if vocab_size is not None and vocab_size < 65500:
+        return np.dtype(np.uint16)
+    return np.dtype(np.int32)
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader. ``ds[i]`` -> 1-D token array for sequence i;
+    ``ds.get(i, offset, length)`` for partial reads (gpt_dataset sample
+    assembly); ``doc_idx`` maps documents to sequence ranges."""
+
+    def __init__(self, path: str, warmup: bool = False):
+        self._path = path
+        with open(index_file_path(path), "rb") as f:
+            magic = f.read(9)
+            assert magic == _INDEX_MAGIC, (
+                f"{index_file_path(path)}: bad magic; not an MMIDIDX index"
+            )
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == _VERSION, f"unsupported index version {version}"
+            (code,) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(DTYPES[code])
+            (self._len,) = struct.unpack("<Q", f.read(8))
+            (self._doc_count,) = struct.unpack("<Q", f.read(8))
+            header_size = f.tell()
+
+        self._index_buf = np.memmap(index_file_path(path), mode="r", order="C")
+        off = header_size
+        self.sizes = np.frombuffer(self._index_buf, np.int32, self._len, off)
+        off += self.sizes.nbytes
+        self._pointers = np.frombuffer(self._index_buf, np.int64, self._len, off)
+        off += self._pointers.nbytes
+        self.doc_idx = np.frombuffer(self._index_buf, np.int64, self._doc_count, off)
+
+        self._bin_buf = np.memmap(data_file_path(path), mode="r", order="C")
+        if warmup:
+            # touch pages sequentially (reference _warmup_mmap_file)
+            np.sum(self._bin_buf[:: 4096 * 64])
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        return self.get(idx)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        size = int(self.sizes[idx])
+        if length is None:
+            length = size - offset
+        ptr = int(self._pointers[idx]) + offset * self._dtype.itemsize
+        return np.frombuffer(self._bin_buf, self._dtype, length, ptr)
+
+    @staticmethod
+    def exists(path: str) -> bool:
+        return os.path.exists(index_file_path(path)) and os.path.exists(
+            data_file_path(path)
+        )
+
+
+class MMapIndexedDatasetBuilder:
+    """Writer (reference MMapIndexedDatasetBuilder + Index.writer)."""
+
+    def __init__(self, out_file: str, dtype=np.int32):
+        self._bin = open(out_file, "wb")
+        self._dtype = np.dtype(dtype)
+        self.sizes: List[int] = []
+        self.doc_idx: List[int] = [0]
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.sizes.append(len(arr))
+
+    def end_document(self) -> None:
+        self.doc_idx.append(len(self.sizes))
+
+    def add_doc(self, tokens: Sequence[int]) -> None:
+        self.add_item(tokens)
+        self.end_document()
+
+    def merge_file_(self, another_prefix: str) -> None:
+        """Append another dataset (tools/merge_datasets.py support)."""
+        other = MMapIndexedDataset(another_prefix)
+        assert other.dtype == self._dtype
+        base = len(self.sizes)
+        self.sizes.extend(int(s) for s in other.sizes)
+        self.doc_idx.extend(base + int(d) for d in other.doc_idx[1:])
+        with open(data_file_path(another_prefix), "rb") as f:
+            shutil.copyfileobj(f, self._bin)
+
+    def finalize(self, index_file: str) -> None:
+        self._bin.close()
+        sizes = np.asarray(self.sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+        with open(index_file, "wb") as f:
+            f.write(_INDEX_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self.doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self.doc_idx, np.int64).tobytes(order="C"))
+
+
+def make_builder(out_file: str, impl: str = "mmap", vocab_size: Optional[int] = None):
+    assert impl == "mmap", f"only mmap impl is supported (got {impl})"
+    return MMapIndexedDatasetBuilder(out_file, dtype=best_fitting_dtype(vocab_size))
+
+
+def make_dataset(path: str, impl: str = "mmap", skip_warmup: bool = True):
+    """Reference make_dataset analog (indexed_dataset.py:58)."""
+    if impl == "infer":
+        impl = infer_dataset_impl(path) or "mmap"
+    assert impl == "mmap", f"only mmap impl is supported (got {impl})"
+    assert MMapIndexedDataset.exists(path), f"dataset not found at {path}(.bin/.idx)"
+    return MMapIndexedDataset(path, warmup=not skip_warmup)

@@ -1,0 +1,138 @@
+"""Batch samplers + the host-side data loader.
+
+Reference: megatron/data/data_samplers.py (MegatronPretrainingSampler:49 with
+consumed_samples resume + DP-rank slicing; MegatronPretrainingRandomSampler
+cyclic). TPU-native difference: there is ONE host process feeding the whole
+mesh, so the sampler yields *global* batches and jit shards them over dp —
+there is no per-rank slicing or TP-rank-0 broadcast (data.py:22-105); those
+collectives disappear by construction.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class MegatronPretrainingSampler:
+    """Sequential sampler with resume: yields lists of global-batch indices
+    starting at consumed_samples."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 global_batch_size: int, drop_last: bool = True):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.global_batch_size = global_batch_size
+        self.drop_last = drop_last
+        assert self.total_samples > 0
+        assert self.consumed_samples < self.total_samples
+
+    def __len__(self):
+        return (self.total_samples - self.consumed_samples) // self.global_batch_size
+
+    def __iter__(self):
+        batch = []
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.global_batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+
+class MegatronPretrainingRandomSampler:
+    """Cyclic shuffled sampler (data_samplers.py:120-187): epoch-seeded
+    permutation, resume lands mid-epoch at the right offset."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 global_batch_size: int, seed: int = 1234):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.global_batch_size = global_batch_size
+        self.seed = seed
+
+    def __iter__(self):
+        while True:
+            epoch = self.consumed_samples // self.total_samples
+            offset = self.consumed_samples % self.total_samples
+            g = np.random.RandomState(self.seed + epoch)
+            perm = g.permutation(self.total_samples)
+            idx = offset
+            while idx + self.global_batch_size <= self.total_samples:
+                yield list(perm[idx: idx + self.global_batch_size])
+                idx += self.global_batch_size
+                self.consumed_samples += self.global_batch_size
+            # drop the ragged tail, advance epoch
+            self.consumed_samples += self.total_samples - idx
+
+
+def _collate(samples) -> Dict[str, np.ndarray]:
+    """Stack a list of sample dicts into arrays."""
+    keys = samples[0].keys()
+    return {k: np.stack([s[k] for s in samples]) for k in keys}
+
+
+class DataIterator:
+    """Background-threaded prefetching iterator over (dataset, sampler).
+
+    Replaces torch DataLoader(num_workers=N): token assembly is mmap reads +
+    numpy stacking, so one prefetch thread hides host latency behind device
+    steps (the TPU analog of the reference's pin_memory+workers pipeline).
+    """
+
+    def __init__(self, dataset, sampler, collate_fn=_collate, prefetch: int = 4):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fn = collate_fn
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch_indices in self.sampler:
+                batch = self.collate_fn([self.dataset[i] for i in batch_indices])
+                self._q.put(batch)
+        except Exception as e:  # surface worker errors to the consumer
+            self._q.put(e)
+        self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def build_pretraining_data_loader(
+    dataset,
+    consumed_samples: int,
+    global_batch_size: int,
+    dataloader_type: str = "single",
+    seed: int = 1234,
+    num_workers: int = 1,
+    collate_fn=_collate,
+) -> Optional[DataIterator]:
+    """Reference build_pretraining_data_loader (data_samplers.py:14) analog."""
+    if dataset is None:
+        return None
+    if dataloader_type == "single":
+        sampler = MegatronPretrainingSampler(
+            len(dataset), consumed_samples, global_batch_size
+        )
+    elif dataloader_type == "cyclic":
+        sampler = MegatronPretrainingRandomSampler(
+            len(dataset), consumed_samples, global_batch_size, seed
+        )
+    else:
+        raise ValueError(f"unknown dataloader_type {dataloader_type}")
+    return DataIterator(dataset, sampler, collate_fn=collate_fn)

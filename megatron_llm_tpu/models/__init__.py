@@ -1,0 +1,8 @@
+from megatron_llm_tpu.models.families import make_config, validate_family
+from megatron_llm_tpu.models.language_model import (
+    init_model_params,
+    make_rope_cache,
+    model_forward,
+    loss_from_batch,
+    padded_vocab_size,
+)

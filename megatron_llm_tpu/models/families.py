@@ -1,0 +1,50 @@
+"""Model families — flag-bundle wrappers over the shared language model.
+
+The reference implements these as thin subclasses of GPTModel that assert the
+architecture's flag bundle (model/llama_model.py:22-30, falcon_model.py:18-29,
+mistral_model.py:30). Here a family is a validated Config plus the shared
+functional model; construction helpers below mirror those assertions.
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.config.arguments import Config, apply_architecture
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+def validate_family(cfg: Config) -> Config:
+    m = cfg.model
+    name = cfg.model_name
+    if name in ("llama", "llama2", "codellama"):
+        # llama_model.py:22-30
+        _check(m.position_embedding_type == "rotary", "llama requires rotary embeddings")
+        _check(m.glu_activation == "swiglu", "llama requires swiglu")
+        _check(m.use_rms_norm, "llama requires RMSNorm")
+        _check(not m.use_bias, "llama has no biases")
+        _check(not m.tie_embed_logits, "llama uses untied embeddings")
+    elif name == "falcon":
+        # falcon_model.py:18-29
+        _check(m.parallel_attn, "falcon requires parallel_attn")
+        _check(m.position_embedding_type == "rotary", "falcon requires rotary embeddings")
+        _check(not m.use_rms_norm, "falcon uses LayerNorm, not RMSNorm")
+    elif name == "mistral":
+        # mistral_model.py:30
+        _check(m.sliding_window_size == 4096, "mistral requires sliding_window_size=4096")
+        _check(m.use_rms_norm and m.glu_activation == "swiglu", "mistral uses llama block")
+    return cfg
+
+
+def make_config(model_name: str, **overrides) -> Config:
+    """Build a finalized family Config; overrides are flat flag names."""
+    from megatron_llm_tpu.config.arguments import _set_flag
+
+    cfg = Config()
+    apply_architecture(cfg, model_name)
+    for k, v in overrides.items():
+        _set_flag(cfg, k, v)
+    cfg.finalize()
+    return validate_family(cfg)

@@ -1,0 +1,184 @@
+"""Full language model: embedding + transformer + output head + loss.
+
+Replaces megatron/model/language_model.py (Embedding:133,
+TransformerLanguageModel:329, parallel_lm_logits:24) and
+megatron/model/gpt_model.py (post_language_model_processing:18).
+
+Under pjit the vocab dimension of the embedding table / LM head carries a
+``tp`` sharding (vocab-parallel, VocabParallelEmbedding semantics) and XLA
+inserts the all-reduces the reference issues by hand (layers.py:187-210).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.models.transformer import (
+    init_stacked_layers,
+    transformer_forward,
+)
+from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.norms import init_norm_params, norm
+from megatron_llm_tpu.ops.rope import precompute_freqs
+
+Params = Dict[str, Any]
+
+
+def padded_vocab_size(vocab_size: int, cfg) -> int:
+    """Pad vocab to a multiple of make_vocab_size_divisible_by * tp
+    (reference tokenizer.py:_vocab_size_with_padding:49-62)."""
+    multiple = (
+        cfg.model.make_vocab_size_divisible_by
+        * cfg.parallel.tensor_model_parallel_size
+    )
+    return multiple * ((vocab_size + multiple - 1) // multiple)
+
+
+def init_model_params(cfg, key: jax.Array) -> Params:
+    m = cfg.model
+    assert m.vocab_size is not None, "cfg.model.vocab_size must be set"
+    v = padded_vocab_size(m.vocab_size, cfg)
+    h = m.hidden_size
+    k_emb, k_layers, k_head, k_pos = jax.random.split(key, 4)
+    params: Params = {
+        "embedding": {
+            "word_embeddings": m.init_method_std
+            * jax.random.normal(k_emb, (v, h), jnp.float32)
+        },
+        "layers": init_stacked_layers(cfg, k_layers),
+        "final_norm": init_norm_params(h, m.use_rms_norm),
+    }
+    if m.position_embedding_type == "absolute":
+        params["embedding"]["position_embeddings"] = m.init_method_std * (
+            jax.random.normal(k_pos, (m.max_position_embeddings, h), jnp.float32)
+        )
+    if not m.tie_embed_logits:
+        # untied lm_head (language_model.py:436-457)
+        params["lm_head"] = {
+            "kernel": m.init_method_std
+            * jax.random.normal(k_head, (h, v), jnp.float32)
+        }
+    return params
+
+
+def make_rope_cache(cfg) -> Optional[Tuple[jax.Array, jax.Array]]:
+    m = cfg.model
+    if m.position_embedding_type != "rotary":
+        return None
+    return precompute_freqs(
+        m.kv_channels,
+        m.max_position_embeddings,
+        theta=m.rope_theta,
+        scaling_factor=m.rope_scaling_factor,
+    )
+
+
+def embed_tokens(
+    cfg, params: Params, tokens: jax.Array, position_ids: Optional[jax.Array]
+) -> jax.Array:
+    emb = params["embedding"]["word_embeddings"]
+    hidden = jnp.take(emb, tokens, axis=0)
+    if cfg.model.position_embedding_type == "absolute":
+        pos = position_ids if position_ids is not None else jnp.arange(tokens.shape[1])[None]
+        hidden = hidden + jnp.take(params["embedding"]["position_embeddings"], pos, axis=0)
+    return hidden.astype(_compute_dtype(cfg))
+
+
+def compute_logits(cfg, params: Params, hidden: jax.Array) -> jax.Array:
+    """parallel_lm_logits analog (language_model.py:24-53): tied or untied head."""
+    if cfg.model.tie_embed_logits:
+        w = params["embedding"]["word_embeddings"].astype(hidden.dtype)
+        return hidden @ w.T
+    return hidden @ params["lm_head"]["kernel"].astype(hidden.dtype)
+
+
+def _compute_dtype(cfg):
+    return {
+        "float32": jnp.float32,
+        "bfloat16": jnp.bfloat16,
+        "float16": jnp.float16,
+    }[cfg.training.params_dtype]
+
+
+def model_forward(
+    cfg,
+    params: Params,
+    tokens: jax.Array,  # [b, s] int32
+    *,
+    position_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    labels: Optional[jax.Array] = None,
+    loss_mask: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    rope_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+    kv_caches=None,
+    cache_index=None,
+    sp_constraint=None,
+    logits_postprocess=True,
+):
+    """GPTModel.forward analog (gpt_model.py:45-124).
+
+    With ``labels``: returns per-token fp32 loss [b, s] (masked mean is the
+    caller's job, matching the reference loss_func split). Without: logits.
+    Returns (output, new_kv_caches).
+    """
+    hidden = embed_tokens(cfg, params, tokens, position_ids)
+    if dropout_key is not None and not deterministic:
+        k_embed, dropout_key = jax.random.split(dropout_key)
+        hidden = rng_mod.dropout(k_embed, cfg.model.hidden_dropout, hidden)
+    if sp_constraint is not None:
+        hidden = sp_constraint(hidden)
+
+    if rope_cache is None:
+        rope_cache = make_rope_cache(cfg)
+
+    hidden, new_caches = transformer_forward(
+        cfg, params["layers"], hidden,
+        rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
+        dropout_key=dropout_key, deterministic=deterministic,
+        kv_caches=kv_caches, cache_index=cache_index,
+        sp_constraint=sp_constraint,
+    )
+
+    hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,
+                  cfg.model.use_rms_norm)
+
+    if not logits_postprocess:
+        return hidden, new_caches
+
+    logits = compute_logits(cfg, params, hidden)
+    if labels is None:
+        return logits, new_caches
+
+    loss = softmax_cross_entropy(logits, labels)  # fp32 per-token
+    return loss, new_caches
+
+
+def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
+                    dropout_key=None, deterministic=True, rope_cache=None,
+                    sp_constraint=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Standard LM loss over a batch dict with keys
+    tokens/labels/loss_mask[/position_ids/segment_ids].
+
+    Mirrors the reference loss_func (finetune.py:139-190): masked mean of the
+    per-token CE.
+    """
+    per_token, _ = model_forward(
+        cfg, params, batch["tokens"],
+        position_ids=batch.get("position_ids"),
+        segment_ids=batch.get("segment_ids"),
+        labels=batch["labels"],
+        dropout_key=dropout_key,
+        deterministic=deterministic,
+        rope_cache=rope_cache,
+        sp_constraint=sp_constraint,
+    )
+    mask = batch["loss_mask"].astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_token * mask).sum() / denom
+    return loss, {"lm loss": loss}

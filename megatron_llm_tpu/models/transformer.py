@@ -1,0 +1,360 @@
+"""The transformer stack — TPU-native redesign of megatron/model/transformer.py.
+
+Differences from the reference (transformer.py:77-1347), by design:
+
+* **Functional, not stateful**: parameters are a nested-dict pytree; the
+  forward is a pure function — required for jit/pjit/shard_map/checkpoint.
+* **Layers are stacked and scanned** (``lax.scan``) instead of a Python
+  module list (transformer.py:1331-1337): one compiled block regardless of
+  depth, which keeps XLA compile time flat at 80 layers.
+* **GQA without K/V expansion**: the reference broadcast-expands K/V heads
+  (transformer.py:459-466); we keep K/V at n_kv_heads and group queries.
+* **Fused QKV projection** sized ``kv_channels * (n_heads + 2*n_kv_heads)``
+  with *group-major* layout — for each KV head: its G query heads, then K,
+  then V.  This matches the reference's interleaved qkv convention
+  (transformer.py:325-343, weights_conversion/utils/permute_qkv.py) and makes
+  TP sharding a clean split over KV groups.
+* **Activation recompute** is ``jax.checkpoint`` with a policy, not an RNG
+  state-juggling reimplementation (random.py:175-245): functional PRNG makes
+  recompute-identical dropout automatic.
+
+Layer params schema (one layer; stacked on axis 0 when scanned):
+
+    {'input_norm':  {'scale': [h], 'bias'?: [h]},
+     'attention':   {'qkv':   {'kernel': [h, (n+2*nkv)*d], 'bias'?},
+                     'dense': {'kernel': [n*d, h],          'bias'?}},
+     'post_norm':   {...},    # absent when parallel_attn
+     'mlp_norm':    {...},    # Falcon-40B parallel_layernorm only
+     'mlp':         {'fc1': {'kernel': [h, ffn*(2 if glu else 1)], 'bias'?},
+                     'fc2': {'kernel': [ffn, h],                   'bias'?}}}
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.ops import attention as attn_ops
+from megatron_llm_tpu.ops.activations import GLU_BASE_ACTIVATIONS, get_mlp_activation
+from megatron_llm_tpu.ops.norms import init_norm_params, norm
+from megatron_llm_tpu.ops.rope import apply_rotary_emb
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _normal(key, shape, std, dtype=jnp.float32):
+    return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+def init_layer_params(cfg, key: jax.Array) -> Params:
+    m = cfg.model
+    h = m.hidden_size
+    d = m.kv_channels
+    n, nkv = m.num_attention_heads, m.num_attention_heads_kv
+    ffn = m.ffn_hidden_size
+    glu = m.glu_activation is not None
+    std = m.init_method_std
+    # scaled init for output projections: std / sqrt(2 * num_layers)
+    # (reference model/utils.py scaled_init_method_normal)
+    out_std = std / (2.0 * m.num_layers) ** 0.5 if m.use_scaled_init_method else std
+
+    k = jax.random.split(key, 4)
+    p: Params = {
+        "input_norm": init_norm_params(h, m.use_rms_norm),
+        "attention": {
+            "qkv": {"kernel": _normal(k[0], (h, (n + 2 * nkv) * d), std)},
+            "dense": {"kernel": _normal(k[1], (n * d, h), out_std)},
+        },
+        "mlp": {
+            # GLU fc1 is [h, 2, ffn] (value half at [:,0,:], gated half at
+            # [:,1,:]) so a tp sharding on the ffn axis never splits across
+            # the gate/value boundary — the flat reference layout would force
+            # a resharding at the chunk-2 split under GSPMD.
+            "fc1": {"kernel": _normal(k[2], (h, 2, ffn) if glu else (h, ffn), std)},
+            "fc2": {"kernel": _normal(k[3], (ffn, h), out_std)},
+        },
+    }
+    if not m.parallel_attn:
+        p["post_norm"] = init_norm_params(h, m.use_rms_norm)
+    if m.parallel_layernorm:
+        p["mlp_norm"] = init_norm_params(h, m.use_rms_norm)
+    if m.use_bias:
+        p["attention"]["qkv"]["bias"] = jnp.zeros(((n + 2 * nkv) * d,), jnp.float32)
+        p["attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
+        p["mlp"]["fc1"]["bias"] = jnp.zeros((2, ffn) if glu else (ffn,), jnp.float32)
+        p["mlp"]["fc2"]["bias"] = jnp.zeros((h,), jnp.float32)
+    return p
+
+
+def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None) -> Params:
+    """Stack per-layer params on axis 0 (for lax.scan / per-stage pipelines)."""
+    L = num_layers if num_layers is not None else cfg.model.num_layers
+    keys = jax.random.split(key, L)
+    return jax.vmap(lambda kk: init_layer_params(cfg, kk))(keys)
+
+
+# ---------------------------------------------------------------------------
+# Sublayers
+# ---------------------------------------------------------------------------
+
+
+def _linear(p: Params, x: jax.Array) -> jax.Array:
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def split_qkv(
+    qkv: jax.Array, n_heads: int, n_kv_heads: int, head_dim: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Split group-major fused QKV [..., (n+2*nkv)*d] into q/k/v head tensors."""
+    g = n_heads // n_kv_heads
+    *lead, _ = qkv.shape
+    grouped = qkv.reshape(*lead, n_kv_heads, g + 2, head_dim)
+    q = grouped[..., :g, :].reshape(*lead, n_heads, head_dim)
+    k = grouped[..., g, :]
+    v = grouped[..., g + 1, :]
+    return q, k, v
+
+
+def attention_sublayer(
+    cfg,
+    p: Params,
+    x: jax.Array,  # [b, s, h] (post input-norm)
+    rope: Optional[Tuple[jax.Array, jax.Array]],
+    position_ids: Optional[jax.Array],
+    segment_ids: Optional[jax.Array],
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+    kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+    cache_index: Optional[jax.Array] = None,
+):
+    """ParallelAttention analog (transformer.py:280-657).
+
+    Returns (output [b, s, h], new_kv_cache).
+    """
+    m = cfg.model
+    b, s, _ = x.shape
+    n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
+
+    qkv = _linear(p["qkv"], x)
+    q, k, v = split_qkv(qkv, n, nkv, d)
+
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rotary_emb(q, cos, sin, position_ids)
+        k = apply_rotary_emb(k, cos, sin, position_ids)
+
+    # apply_query_key_layer_scaling (reference CoreAttention:158-176) divides
+    # QK^T by layer_number and multiplies back inside an fp32 softmax purely to
+    # avoid fp16 overflow — a mathematical identity. Our softmax is always
+    # computed in fp32 (attention.py softmax_fp32), so the flag needs no code.
+    scale = 1.0 / (d ** 0.5)
+
+    new_cache = None
+    if kv_cache is not None:
+        # Incremental decode: write current k/v at cache_index, attend to the
+        # full cache prefix (InferenceParams semantics, text_generation/
+        # forward_step.py:17 + transformer.py:413-506).
+        ck, cv = kv_cache
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        new_cache = (ck, cv)
+        kv_len = ck.shape[1]
+        q_pos = cache_index + jnp.arange(s)[:, None]
+        kv_pos = jnp.arange(kv_len)[None, :]
+        allowed = q_pos >= kv_pos
+        if m.sliding_window_size is not None:
+            allowed &= q_pos - kv_pos < m.sliding_window_size
+        bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)[None, None]
+        ctx = attn_ops.xla_attention(q, ck, cv, bias=bias, scale=scale)
+    else:
+        ctx = attn_ops.attention(
+            q, k, v,
+            causal=True,
+            sliding_window=m.sliding_window_size,
+            segment_ids=segment_ids,
+            scale=scale,
+            use_flash=cfg.training.use_flash_attn,
+            dropout_rate=0.0 if deterministic else m.attention_dropout,
+            dropout_key=dropout_key,
+        )
+
+    out = _linear(p["dense"], ctx.reshape(b, s, n * d))
+    return out, new_cache
+
+
+def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
+    """ParallelMLP analog (transformer.py:77-142): fc1 -> activation -> fc2.
+
+    GLU path: fc1 kernel is [h, 2, ffn]; one GEMM computes both halves, the
+    gate is x1 * act(x2) matching the reference chunk-2 convention
+    (glu_activations.py:14-16).
+    """
+    m = cfg.model
+    if m.glu_activation is not None:
+        act = GLU_BASE_ACTIVATIONS[m.glu_activation]
+        fc1 = p["fc1"]
+        y = jnp.einsum("...h,hcf->...cf", x, fc1["kernel"].astype(x.dtype))
+        if "bias" in fc1:
+            y = y + fc1["bias"].astype(x.dtype)
+        gated = y[..., 0, :] * act(y[..., 1, :])
+        return _linear(p["fc2"], gated)
+    act = get_mlp_activation(None, m.activation)
+    return _linear(p["fc2"], act(_linear(p["fc1"], x)))
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+
+def block_forward(
+    cfg,
+    p: Params,
+    hidden: jax.Array,  # [b, s, h]
+    *,
+    rope=None,
+    position_ids=None,
+    segment_ids=None,
+    dropout_key=None,
+    deterministic: bool = True,
+    hidden_dropout_rate: Optional[float] = None,
+    kv_cache=None,
+    cache_index=None,
+    sp_constraint=None,
+):
+    """One transformer layer (ParallelTransformerLayer, transformer.py:659-894).
+
+    Pre-LN residual block; ``parallel_attn`` runs attention and MLP from the
+    same normed input and sums both into the residual (Falcon,
+    transformer.py:851-886). ``sp_constraint`` is an optional callable applying
+    the sequence-parallel sharding constraint to residual-stream tensors.
+    """
+    m = cfg.model
+    eps = m.layernorm_epsilon
+    rate = m.hidden_dropout if hidden_dropout_rate is None else hidden_dropout_rate
+    if dropout_key is not None:
+        dk_attn, dk_h1, dk_h2 = jax.random.split(dropout_key, 3)
+    else:
+        dk_attn = dk_h1 = dk_h2 = None
+    _sp = sp_constraint if sp_constraint is not None else (lambda t: t)
+
+    ln1 = norm(hidden, p["input_norm"], eps, m.use_rms_norm)
+    attn_out, new_cache = attention_sublayer(
+        cfg, p["attention"], ln1, rope, position_ids, segment_ids,
+        dk_attn, deterministic, kv_cache, cache_index,
+    )
+
+    if m.parallel_attn:
+        mlp_in = norm(hidden, p["mlp_norm"], eps, m.use_rms_norm) if m.parallel_layernorm else ln1
+        mlp_out = mlp_sublayer(cfg, p["mlp"], mlp_in)
+        out = hidden + rng_mod.dropout(dk_h1, rate, attn_out, deterministic or dk_h1 is None) \
+            + rng_mod.dropout(dk_h2, rate, mlp_out, deterministic or dk_h2 is None)
+        out = _sp(out)
+    else:
+        resid = hidden + rng_mod.dropout(dk_h1, rate, attn_out, deterministic or dk_h1 is None)
+        resid = _sp(resid)
+        ln2 = norm(resid, p["post_norm"], eps, m.use_rms_norm)
+        mlp_out = mlp_sublayer(cfg, p["mlp"], ln2)
+        out = resid + rng_mod.dropout(dk_h2, rate, mlp_out, deterministic or dk_h2 is None)
+        out = _sp(out)
+    return out, new_cache
+
+
+def _lima_rates(cfg, num_layers: int) -> jax.Array:
+    """LIMA per-layer dropout ramp 0 -> hidden_dropout (transformer.py:1041-1048)."""
+    m = cfg.model
+    if not m.lima_dropout or num_layers <= 1:
+        return jnp.full((num_layers,), m.hidden_dropout, jnp.float32)
+    return jnp.linspace(0.0, m.hidden_dropout, num_layers)
+
+
+def _remat_policy(name: str):
+    policies = {
+        "none": None,
+        "full": jax.checkpoint_policies.nothing_saveable,
+        "save_dots_except_logits": jax.checkpoint_policies.checkpoint_dots,
+        # 'selective' ~ reference selective recompute: save everything except
+        # the attention internals (we approximate with save-only-dot-products).
+        "selective": jax.checkpoint_policies.dots_saveable,
+    }
+    return policies.get(name, jax.checkpoint_policies.checkpoint_dots)
+
+
+def transformer_forward(
+    cfg,
+    stacked_layers: Params,
+    hidden: jax.Array,
+    *,
+    rope=None,
+    position_ids=None,
+    segment_ids=None,
+    dropout_key=None,
+    deterministic: bool = True,
+    kv_caches=None,        # stacked [L, ...] pair, or None
+    cache_index=None,
+    sp_constraint=None,
+    layer_offset: int = 0,
+):
+    """Run the stacked layers (ParallelTransformer, transformer.py:974-1347).
+
+    When ``cfg.training.scan_layers`` (default), layers are scanned with an
+    optional remat policy; otherwise a Python loop (useful for debugging and
+    per-layer inspection).
+    Returns (hidden, new_kv_caches).
+    """
+    num_layers = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+    rates = _lima_rates(cfg, cfg.model.num_layers)
+
+    def one_layer(carry_hidden, xs):
+        layer_params, layer_idx, cache = xs
+        dk = None if dropout_key is None else rng_mod.fold_layer(dropout_key, layer_idx)
+        rate = rates[layer_idx]
+        out, new_cache = block_forward(
+            cfg, layer_params, carry_hidden,
+            rope=rope, position_ids=position_ids, segment_ids=segment_ids,
+            dropout_key=dk, deterministic=deterministic,
+            hidden_dropout_rate=rate,
+            kv_cache=cache, cache_index=cache_index,
+            sp_constraint=sp_constraint,
+        )
+        return out, new_cache
+
+    layer_ids = jnp.arange(num_layers) + layer_offset
+
+    if cfg.training.scan_layers:
+        granularity = cfg.parallel.recompute_granularity
+        policy = _remat_policy(
+            "full" if granularity == "full" else cfg.training.remat_policy
+            if granularity else "none"
+        )
+        body = one_layer
+        if granularity is not None:
+            body = jax.checkpoint(one_layer, policy=policy, prevent_cse=False)
+        hidden, new_caches = jax.lax.scan(
+            body, hidden, (stacked_layers, layer_ids, kv_caches)
+        )
+        return hidden, new_caches
+    else:
+        new_caches = []
+        for i in range(num_layers):
+            layer_p = jax.tree.map(lambda a: a[i], stacked_layers)
+            cache = None if kv_caches is None else jax.tree.map(lambda a: a[i], kv_caches)
+            hidden, nc = one_layer(hidden, (layer_p, layer_ids[i], cache))
+            new_caches.append(nc)
+        if kv_caches is not None:
+            new_caches = jax.tree.map(lambda *xs: jnp.stack(xs), *new_caches)
+        else:
+            new_caches = None
+        return hidden, new_caches

@@ -1,0 +1,91 @@
+"""Rotary position embeddings (RoPE) with linear position interpolation.
+
+Reference: megatron/model/positional_embeddings.py — complex-number RoPE with
+*interleaved-pair* convention (Meta/Llama native layout: dims (0,1), (2,3), ...
+form the rotated pairs), ``precompute_freqs_cis`` at :7 with the 32K-context
+linear scaling ``t /= scaling_factor`` at :11, and non-monotonic position_ids
+support for packed sequences at :38-47.
+
+We compute in real arithmetic (TPU has no complex MXU path): for each pair
+(x_even, x_odd) rotate by angle theta_i * pos. cos/sin are precomputed in
+fp32 and applied in fp32 for accuracy, output cast back to input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def precompute_freqs(
+    dim: int,
+    max_len: int,
+    theta: float = 10000.0,
+    scaling_factor: float = 1.0,
+    dtype=jnp.float32,
+):
+    """Return (cos, sin), each [max_len, dim//2], fp32.
+
+    positional_embeddings.py:7-21 semantics incl. position interpolation
+    (positions divided by scaling_factor).
+    """
+    freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(max_len, dtype=jnp.float32) / scaling_factor
+    angles = jnp.outer(t, freqs)  # [max_len, dim//2]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary_emb(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    position_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Rotate ``x`` [batch, seq, heads, head_dim] (interleaved-pair convention).
+
+    ``position_ids`` [batch, seq] gathers rows of cos/sin — supports packed
+    sequences with restarting positions (positional_embeddings.py:38-47).
+    Without it, positions 0..seq-1 are used.
+    """
+    b, s, h, d = x.shape
+    if position_ids is None:
+        c = cos[:s][None, :, None, :]  # [1, s, 1, d/2]
+        sn = sin[:s][None, :, None, :]
+    else:
+        c = cos[position_ids][:, :, None, :]  # [b, s, 1, d/2]
+        sn = sin[position_ids][:, :, None, :]
+    xf = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
+    x_even, x_odd = xf[..., 0], xf[..., 1]
+    out_even = x_even * c - x_odd * sn
+    out_odd = x_odd * c + x_even * sn
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(b, s, h, d)
+    return out.astype(x.dtype)
+
+
+def apply_rotary_emb_half(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    position_ids: jax.Array | None = None,
+) -> jax.Array:
+    """HF-convention RoPE (rotate_half: first/second half are the pairs).
+
+    Provided for logit-parity testing against HuggingFace checkpoints without
+    re-permuting weights; the two conventions are related by a fixed head-dim
+    permutation (reference weights_conversion/utils/permute_qkv.py).
+    """
+    b, s, h, d = x.shape
+    if position_ids is None:
+        idx = jnp.arange(s)
+        c, sn = cos[idx], sin[idx]
+        c = c[None, :, None, :]
+        sn = sn[None, :, None, :]
+    else:
+        c = cos[position_ids][:, :, None, :]
+        sn = sin[position_ids][:, :, None, :]
+    c = jnp.concatenate([c, c], axis=-1)  # [.., d]
+    sn = jnp.concatenate([sn, sn], axis=-1)
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (xf * c + rotated * sn).astype(x.dtype)

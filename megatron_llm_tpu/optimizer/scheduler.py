@@ -1,0 +1,69 @@
+"""LR + weight-decay schedules.
+
+Reference: megatron/optimizer_param_scheduler.py (warmup + constant/linear/
+cosine/inverse-square-root decay, weight-decay increment schedule, checkpoint
+state). Here schedules are pure functions of the step — jit-friendly scalars —
+and the "state" that the reference checkpoints is just the step counter.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def lr_schedule(cfg) -> Callable:
+    """Return f(step) -> lr, mirroring OptimizerParamScheduler.get_lr."""
+    o = cfg.optimizer
+    max_lr, min_lr = o.lr, o.min_lr
+    warmup = o.lr_warmup_iters
+    if o.lr_warmup_fraction is not None and o.lr_decay_iters:
+        warmup = int(o.lr_warmup_fraction * o.lr_decay_iters)
+    decay_iters = o.lr_decay_iters or (cfg.training.train_iters or 1)
+    style = o.lr_decay_style
+
+    def f(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = max_lr * step / max(warmup, 1)
+        # progress through decay window, clipped to [0, 1]
+        t = jnp.clip((step - warmup) / max(decay_iters - warmup, 1), 0.0, 1.0)
+        if style == "constant":
+            decayed = jnp.asarray(max_lr, jnp.float32)
+        elif style == "linear":
+            decayed = min_lr + (max_lr - min_lr) * (1.0 - t)
+        elif style == "cosine":
+            decayed = min_lr + (max_lr - min_lr) * 0.5 * (
+                1.0 + jnp.cos(math.pi * t)
+            )
+        elif style == "inverse-square-root":
+            eff = jnp.maximum(step, warmup + 1.0)
+            decayed = jnp.maximum(max_lr * (max(warmup, 1) ** 0.5) / jnp.sqrt(eff), min_lr)
+        else:
+            raise ValueError(f"unknown lr_decay_style {style}")
+        lr = jnp.where((warmup > 0) & (step < warmup), warm, decayed)
+        return lr
+
+    return f
+
+
+def wd_schedule(cfg) -> Callable:
+    """Weight-decay increment schedule (constant/linear/cosine)."""
+    o = cfg.optimizer
+    start = o.start_weight_decay if o.start_weight_decay is not None else o.weight_decay
+    end = o.end_weight_decay if o.end_weight_decay is not None else o.weight_decay
+    total = cfg.training.train_iters or 1
+    style = o.weight_decay_incr_style
+
+    def f(step):
+        if style == "constant" or start == end:
+            return jnp.asarray(end, jnp.float32)
+        t = jnp.clip(jnp.asarray(step, jnp.float32) / total, 0.0, 1.0)
+        if style == "linear":
+            return start + (end - start) * t
+        if style == "cosine":
+            return start + (end - start) * 0.5 * (1.0 - jnp.cos(math.pi * t))
+        raise ValueError(f"unknown weight_decay_incr_style {style}")
+
+    return f

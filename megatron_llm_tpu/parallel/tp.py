@@ -1,0 +1,130 @@
+"""Tensor-parallel + sequence-parallel sharding rules.
+
+This module is the TPU-native replacement of the reference's explicit TP layer
+classes (megatron/core/tensor_parallel/layers.py: ColumnParallelLinear:410,
+RowParallelLinear:566, VocabParallelEmbedding:128) and its conjugate-pair
+autograd collectives (mappings.py:13-278). Instead of classes issuing NCCL
+calls, parallelism is *data placement*: every parameter gets a
+``PartitionSpec`` over the (dp, pp, cp, tp) mesh and XLA inserts exactly the
+collectives the reference hand-codes —
+
+* column-parallel linear  = kernel sharded on its output axis (`tp`);
+  forward needs no comm (identity copy, mappings.py:253-254)
+* row-parallel linear     = kernel sharded on its input axis; the contraction
+  produces the all-reduce (mappings.py:257) or, with sequence parallelism,
+  a reduce-scatter onto the seq-sharded result (layers.py:292)
+* vocab-parallel embedding/head = table sharded on the vocab axis; the lookup
+  masked-gather + all-reduce (layers.py:187-210) is XLA's gather lowering
+* sequence parallelism    = activation sharding constraint putting the seq
+  axis on `tp` between blocks (scatter/gather regions, mappings.py:191-247)
+
+Shardings are derived from parameter-path rules, not stored per-layer, so the
+same tree works for any tp/pp/dp and for checkpoint resharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.core.parallel_state import DP_AXIS, TP_AXIS
+
+# Grad accumulation / FSDP-style extra sharding could compose here later.
+
+
+def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
+    """Sharding rule for one parameter, keyed on its tree path.
+
+    ``stacked`` marks per-layer parameters carrying a leading layer axis
+    (from init_stacked_layers / scan).
+    """
+    names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    lead = (None,) if stacked else ()
+
+    def spec(*rest):
+        return P(*lead, *rest)
+
+    if "word_embeddings" in names:
+        return P(TP_AXIS, None)  # vocab-parallel (VocabParallelEmbedding)
+    if "position_embeddings" in names:
+        return P(None, None)
+    if "lm_head" in names:
+        return P(None, TP_AXIS)  # column-parallel output head
+    if "qkv" in names:
+        if names[-1] == "kernel":
+            return spec(None, TP_AXIS)  # column-parallel: shard fused head dim
+        return spec(TP_AXIS)  # bias
+    if "dense" in names:
+        if names[-1] == "kernel":
+            return spec(TP_AXIS, None)  # row-parallel: shard input (head) dim
+        return spec(None)  # row-parallel bias is replicated (added post-reduce)
+    if "fc1" in names:
+        if names[-1] == "kernel":
+            # [h, 2, ffn] (GLU) or [h, ffn]: shard the ffn axis
+            return spec(None, None, TP_AXIS) if ndim == 3 + len(lead) else spec(None, TP_AXIS)
+        return spec(None, TP_AXIS) if ndim == 2 + len(lead) else spec(TP_AXIS)
+    if "fc2" in names:
+        if names[-1] == "kernel":
+            return spec(TP_AXIS, None)  # row-parallel
+        return spec(None)
+    # norms, everything else: replicated (layer-stacked keeps lead axis)
+    return P(*lead, *([None] * (ndim - len(lead))))
+
+
+def param_partition_specs(params: Any) -> Any:
+    """Build a PartitionSpec pytree mirroring ``params``.
+
+    Works on a params tree or a tree of ShapeDtypeStruct (for eval_shape-based
+    initialization without materializing).
+    """
+
+    def rule(path, leaf):
+        names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        stacked = "layers" in names
+        return _spec_for_path(path, leaf.ndim, stacked)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_partition_specs(params)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Activation sharding
+# ---------------------------------------------------------------------------
+
+
+def batch_spec(sequence_parallel: bool) -> P:
+    """Spec for [batch, seq, ...] activations on the residual stream.
+
+    Sequence parallelism (reference §2.1 SP row: scatter along seq between TP
+    ranks in LN/dropout regions) = putting the seq axis on `tp` here; XLA then
+    emits the all-gather before column-linears and the reduce-scatter after
+    row-linears exactly as layers.py:225-296 does by hand.
+    """
+    return P(DP_AXIS, TP_AXIS if sequence_parallel else None, None)
+
+
+def data_spec() -> P:
+    """Spec for integer batch tensors [batch, seq]: shard batch over dp."""
+    return P(DP_AXIS, None)
+
+
+def make_sp_constraint(cfg, mesh: Optional[Mesh] = None):
+    """Return a callable constraining residual-stream activations, or None."""
+    spec = batch_spec(cfg.parallel.sequence_parallel)
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, spec)
+
+    return constrain
+
+
+def logits_spec() -> P:
+    """Logits [b, s, vocab]: vocab sharded over tp (vocab-parallel CE)."""
+    return P(DP_AXIS, None, TP_AXIS)

@@ -1,0 +1,159 @@
+"""The sharded training step — the hot loop.
+
+Analog of the reference train_step (megatron/training.py:393-460): zero grads,
+microbatched forward/backward with grad accumulation, grad all-reduce,
+optimizer step, param gather. Under XLA SPMD the whole sequence is ONE jitted
+program over the (dp, pp, cp, tp) mesh:
+
+* DP grad all-reduce (model/distributed.py:202-232)        -> emitted by XLA
+  from the dp-replicated-params / dp-sharded-batch contraction
+* distributed-optimizer reduce-scatter + all-gather
+  (distrib_optimizer.py:527-615)                           -> emitted by XLA
+  from dp-sharded Adam state (opt_state_partition_specs)
+* TP all-reduces (mappings.py) and SP gather/scatter       -> emitted by XLA
+  from the param/activation shardings in parallel/tp.py
+* microbatch grad accumulation loop (schedules.py:213-250
+  no-pipelining schedule)                                  -> lax.scan below
+
+Pipeline-parallel schedules extend this in parallel/pipeline.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.models.language_model import loss_from_batch, make_rope_cache
+from megatron_llm_tpu.optimizer.optimizer import (
+    get_optimizer,
+    global_grad_norm,
+    opt_state_shardings,
+)
+from megatron_llm_tpu.optimizer.scheduler import lr_schedule
+from megatron_llm_tpu.parallel.tp import (
+    data_spec,
+    make_sp_constraint,
+    param_shardings,
+)
+
+
+def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
+    """[gbs, ...] -> [num_micro, gbs/num_micro, ...] for scan."""
+    def r(x):
+        gbs = x.shape[0]
+        assert gbs % num_micro == 0, f"batch {gbs} % num_micro {num_micro} != 0"
+        return x.reshape(num_micro, gbs // num_micro, *x.shape[1:])
+
+    return jax.tree.map(r, batch)
+
+
+def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None):
+    """Build the pure train_step(params, opt_state, batch, iteration, seed).
+
+    Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
+    (params, opt_state) — the reference's train_step contract
+    (training.py:393: loss dict, skipped-iter flag, grad_norm, num_zeros).
+    """
+    sp_constraint = make_sp_constraint(cfg)
+    lr_fn = lr_schedule(cfg)
+    num_micro = cfg.parallel.num_micro_batches or 1
+
+    def micro_loss(params, mb, dropout_key, rope):
+        deterministic = (
+            cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0
+        ) or dropout_key is None
+        return loss_from_batch(
+            cfg, params, mb,
+            dropout_key=dropout_key,
+            deterministic=deterministic,
+            rope_cache=rope,
+            sp_constraint=sp_constraint,
+        )
+
+    def train_step(params, opt_state, batch, iteration, opt=optimizer):
+        if opt is None:
+            raise ValueError("optimizer must be bound via make_train_step or arg")
+        rope = make_rope_cache(cfg)
+        base_key = rng_mod.dropout_key(cfg.training.seed, iteration)
+
+        grad_fn = jax.value_and_grad(
+            lambda p, mb, k: micro_loss(p, mb, k, rope)[0]
+        )
+
+        if num_micro == 1:
+            loss, grads = grad_fn(params, batch, base_key)
+        else:
+            mbs = _split_microbatches(batch, num_micro)
+
+            def accum(carry, xs):
+                g_sum, loss_sum = carry
+                mb, idx = xs
+                l, g = grad_fn(params, mb, jax.random.fold_in(base_key, idx))
+                return (jax.tree.map(jnp.add, g_sum, g), loss_sum + l), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (g_sum, loss_sum), _ = jax.lax.scan(
+                accum, (zeros, jnp.zeros((), jnp.float32)),
+                (mbs, jnp.arange(num_micro)),
+            )
+            inv = 1.0 / num_micro
+            grads = jax.tree.map(lambda g: g * inv, g_sum)
+            loss = loss_sum * inv
+
+        grad_norm = global_grad_norm(grads)
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        metrics = {
+            "lm loss": loss,
+            "grad_norm": grad_norm,
+            "learning_rate": lr_fn(iteration),
+        }
+        return new_params, new_opt_state, metrics
+
+    return train_step
+
+
+def make_jitted_train_step(cfg, mesh: Mesh, params: Any):
+    """Bind shardings and jit. Returns (step_fn, optimizer, shardings dict).
+
+    Donates params/opt_state (the XLA analog of the reference's in-place
+    param update + contiguous grad buffer reuse, distributed.py:111-157).
+    """
+    optimizer = get_optimizer(cfg, params)
+    opt_state = optimizer.init(params)
+
+    p_shard = param_shardings(mesh, params)
+    o_shard = opt_state_shardings(cfg, mesh, params, opt_state)
+    b_shard = NamedSharding(mesh, data_spec())
+    scalar = NamedSharding(mesh, P())
+
+    step = make_train_step(cfg, optimizer)
+    jstep = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, b_shard, scalar),
+        out_shardings=(p_shard, o_shard, None),
+        donate_argnums=(0, 1),
+    )
+    return jstep, optimizer, {
+        "params": p_shard,
+        "opt_state": o_shard,
+        "batch": b_shard,
+        "opt_state_value": opt_state,
+    }
+
+
+def init_sharded(cfg, mesh: Mesh, init_fn, key: jax.Array):
+    """Initialize params directly sharded (no host-side full materialization).
+
+    jit-of-init with out_shardings — the analog of the reference's
+    use_cpu_initialization + scatter, but single-program.
+    """
+    shapes = jax.eval_shape(init_fn, key)
+    shardings = param_shardings(mesh, shapes)
+    return jax.jit(init_fn, out_shardings=shardings)(key), shardings

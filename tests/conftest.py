@@ -1,0 +1,26 @@
+"""Test harness: 8 virtual CPU devices (reference tests need >=8 real GPUs
+under torchrun — tests/test_utilities.py:6; we simulate the mesh on CPU,
+which the reference cannot do)."""
+
+import os
+
+# Must be set before jax is imported anywhere. Force (not setdefault): the
+# axon TPU tunnel env presets JAX_PLATFORMS=axon and registers the tunnel in
+# every python process via sitecustomize when PALLAS_AXON_POOL_IPS is set —
+# tests must run hermetically on the virtual CPU mesh.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
