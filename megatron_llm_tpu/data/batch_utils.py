@@ -28,7 +28,8 @@ def get_ltor_batch(
 
     loss_mask = np.ones((b, s), np.float32)
     if eod_mask_loss and eod_token is not None:
-        loss_mask[labels == eod_token] = 0.0
+        # mask positions whose *input* token is EOD (utils.py:160-161)
+        loss_mask[tokens == eod_token] = 0.0
 
     position_ids = np.tile(np.arange(s, dtype=np.int32), (b, 1))
     out: Dict[str, np.ndarray] = {

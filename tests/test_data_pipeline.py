@@ -1,0 +1,142 @@
+"""Data pipeline tests: .bin/.idx round-trip, GPT dataset assembly, samplers,
+blending (reference analog: megatron/data/test/test_indexed_dataset.py)."""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.data.batch_utils import get_ltor_batch
+from megatron_llm_tpu.data.blendable_dataset import BlendableDataset, build_blending_indices
+from megatron_llm_tpu.data.gpt_dataset import (
+    GPTDataset,
+    build_train_valid_test_datasets,
+    get_train_valid_test_split_,
+)
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+    make_dataset,
+)
+from megatron_llm_tpu.data.samplers import (
+    MegatronPretrainingSampler,
+    build_pretraining_data_loader,
+)
+
+
+@pytest.fixture
+def toy_corpus(tmp_path):
+    """20 documents of varying lengths, uint16 tokens."""
+    prefix = str(tmp_path / "corpus")
+    rng = np.random.RandomState(0)
+    builder = make_builder(prefix + ".bin", vocab_size=1000)
+    docs = []
+    for i in range(20):
+        doc = rng.randint(0, 1000, size=rng.randint(5, 50)).astype(np.int64)
+        docs.append(doc)
+        builder.add_doc(doc)
+    builder.finalize(prefix + ".idx")
+    return prefix, docs
+
+
+def test_indexed_dataset_roundtrip(toy_corpus):
+    prefix, docs = toy_corpus
+    ds = make_dataset(prefix)
+    assert len(ds) == 20
+    assert ds.dtype == np.uint16
+    for i, doc in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], doc.astype(np.uint16))
+    # partial reads
+    np.testing.assert_array_equal(ds.get(3, 2, 3), docs[3][2:5].astype(np.uint16))
+    # doc_idx covers all documents
+    assert ds.doc_idx[0] == 0 and ds.doc_idx[-1] == 20
+
+
+def test_merge(toy_corpus, tmp_path):
+    prefix, docs = toy_corpus
+    merged = str(tmp_path / "merged")
+    b = MMapIndexedDatasetBuilder(merged + ".bin", dtype=np.uint16)
+    b.merge_file_(prefix)
+    b.merge_file_(prefix)
+    b.finalize(merged + ".idx")
+    ds = make_dataset(merged)
+    assert len(ds) == 40
+    np.testing.assert_array_equal(ds[20], docs[0].astype(np.uint16))
+
+
+def test_gpt_dataset_samples(toy_corpus):
+    prefix, docs = toy_corpus
+    indexed = make_dataset(prefix)
+    total_tokens = int(indexed.sizes.sum())
+    seq = 16
+    n_samples = (total_tokens - 1) // seq
+    ds = GPTDataset("train", indexed, np.arange(20), n_samples, seq, seed=5)
+    assert len(ds) >= n_samples
+    seen = set()
+    for i in range(n_samples):
+        s = ds[i]["text"]
+        assert s.shape == (seq + 1,)
+        assert s.dtype == np.int64
+        seen.add(int(s[0]))
+    # multi-epoch: ask for more samples than one epoch holds
+    ds2 = GPTDataset("train", indexed, np.arange(20), n_samples * 3, seq, seed=5)
+    assert len(ds2) >= n_samples * 3
+    _ = ds2[len(ds2) - 1]
+
+
+def test_split_parsing():
+    idx = get_train_valid_test_split_("969, 30, 1", 1000)
+    assert idx == [0, 969, 999, 1000]
+    idx = get_train_valid_test_split_("100,0,0", 50)
+    assert idx[-1] == 50
+
+
+def test_build_train_valid_test(toy_corpus):
+    prefix, _ = toy_corpus
+    train, valid, test = build_train_valid_test_datasets(
+        [prefix], "80,15,5", (10, 4, 1), seq_length=16, seed=3
+    )
+    assert train is not None and valid is not None
+    assert train[0]["text"].shape == (17,)
+
+
+def test_blending_indices_proportions():
+    w = np.array([0.7, 0.2, 0.1])
+    di, dsi = build_blending_indices(w, 1000)
+    counts = np.bincount(di, minlength=3) / 1000
+    np.testing.assert_allclose(counts, w, atol=0.01)
+    # per-dataset sample indices are sequential
+    for k in range(3):
+        np.testing.assert_array_equal(np.asarray(dsi)[di == k],
+                                      np.arange((di == k).sum()))
+
+
+def test_sampler_resume():
+    s1 = MegatronPretrainingSampler(100, 0, 10)
+    batches = list(s1)
+    assert len(batches) == 10 and batches[0] == list(range(10))
+    s2 = MegatronPretrainingSampler(100, 30, 10)
+    assert list(s2)[0] == list(range(30, 40))
+
+
+def test_data_loader_end_to_end(toy_corpus):
+    prefix, _ = toy_corpus
+    indexed = make_dataset(prefix)
+    total_tokens = int(indexed.sizes.sum())
+    ds = GPTDataset("train", indexed, np.arange(20), (total_tokens - 1) // 16,
+                    16, seed=5)
+    it = build_pretraining_data_loader(ds, consumed_samples=0, global_batch_size=4)
+    batch = next(it)
+    assert batch["text"].shape == (4, 17)
+
+
+def test_ltor_batch_eod_resets():
+    tokens = np.array([[5, 1, 7, 9, 1, 3, 2, 4]])  # eod = 1
+    out = get_ltor_batch(tokens, eod_token=1, reset_position_ids=True,
+                         reset_attention_mask=True, eod_mask_loss=True)
+    assert out["tokens"].shape == (1, 7)
+    # segment ids bump after each EOD
+    np.testing.assert_array_equal(out["segment_ids"][0], [0, 0, 1, 1, 1, 2, 2])
+    # positions reset at the token after EOD
+    np.testing.assert_array_equal(out["position_ids"][0], [0, 1, 0, 1, 2, 0, 1])
+    # positions whose input token is EOD are masked (reference utils.py:160-161)
+    np.testing.assert_array_equal(out["loss_mask"][0], [1, 0, 1, 1, 0, 1, 1])
