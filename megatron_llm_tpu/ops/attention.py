@@ -36,6 +36,46 @@ def _flash_available() -> bool:
         return False
 
 
+def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q, block_kv):
+    """Run the Pallas kernel, wrapped in shard_map when a non-trivial mesh is
+    active.
+
+    pallas_call is opaque to the GSPMD partitioner, so under pjit the kernel
+    must be mapped explicitly: batch over dp, heads over tp (attention is
+    embarrassingly parallel over both — the same decomposition the reference
+    gets from per-rank processes). Sequence stays whole here; context
+    parallelism (ring attention) shards it separately in parallel/ring.
+    """
+    from megatron_llm_tpu.core import parallel_state as ps
+    from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+
+    kwargs = dict(causal=True, sliding_window=sliding_window, scale=scale,
+                  block_q=block_q, block_kv=block_kv)
+    if not ps.mesh_is_initialized():
+        return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
+    mesh = ps.get_global_mesh()
+    if mesh.shape.get(ps.DP_AXIS, 1) == 1 and mesh.shape.get(ps.TP_AXIS, 1) == 1:
+        return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    qs = P(ps.DP_AXIS, None, ps.TP_AXIS, None)
+    kvs = P(ps.DP_AXIS, None, ps.TP_AXIS, None)
+    segs = P(ps.DP_AXIS, None)
+    if segment_ids is None:
+        fn = shard_map(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
+            mesh=mesh, in_specs=(qs, kvs, kvs), out_specs=qs, check_vma=False,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        lambda q_, k_, v_, s_: flash_attention(q_, k_, v_, segment_ids=s_, **kwargs),
+        mesh=mesh, in_specs=(qs, kvs, kvs, segs), out_specs=qs, check_vma=False,
+    )
+    return fn(q, k, v, segment_ids)
+
+
 def make_attention_bias(
     seq_len: int,
     kv_len: Optional[int] = None,
@@ -130,16 +170,8 @@ def attention(
         and _flash_available()
     )
     if flash_ok:
-        from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
-
-        return flash_attention(
-            q, k, v,
-            causal=True,
-            sliding_window=sliding_window,
-            segment_ids=segment_ids,
-            scale=scale,
-            block_q=block_q,
-            block_kv=block_kv,
+        return _flash_sharded(
+            q, k, v, segment_ids, scale, sliding_window, block_q, block_kv
         )
     if bias is None:
         seg_q = seg_kv = segment_ids

@@ -1,0 +1,164 @@
+"""Pipeline parallelism: collective-permute microbatch pipelining inside jit.
+
+Replaces the reference's pipeline engine (megatron/schedules.py:606-722 1F1B,
+p2p_communication.py isend/irecv) with the TPU-native formulation:
+
+* stage placement is *data placement*: the stacked layer axis [L, ...] is
+  sharded over the ``pp`` mesh axis (each stage holds L/pp contiguous layers)
+  — no per-stage module classes, and checkpoint resharding over pp is a
+  resharding no-op.
+* stage transfer is ``lax.ppermute`` over ``pp`` inside a ``lax.scan`` over
+  microbatch "ticks" — XLA lowers it to ICI collective-permute, the hardware
+  analog of the reference's batched isend/irecv (p2p_communication.py:205-231).
+* the schedule: every stage computes each tick; tick t feeds microbatch t into
+  stage 0; the last stage emits microbatch t-(pp-1) at tick t. Total ticks
+  M + pp - 1 — the same bubble as the reference's warmup(pp-rank-1)/steady/
+  cooldown accounting (schedules.py:648-720).
+* backward is autodiff through the scan: ppermute transposes to the reverse
+  permute, giving the mirrored cooldown. This is a GPipe-style schedule
+  (all-forward-then-all-backward per jit step) with per-stage remat; a true
+  interleaved 1F1B with jax.vjp staging is an optimization slot for later
+  rounds.
+* only ``pp`` is manual (shard_map axis_names={'pp'}): dp/tp/sp shardings
+  inside the stage body stay under GSPMD exactly as in the pp=1 path.
+
+Embedding, final norm, and the LM head run outside the pipelined region,
+replicated over pp (their grads psum over pp automatically under pjit) —
+which also implements the reference's first/last-stage embedding tying
+(module.py:52-121) without an explicit embedding group.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.core.parallel_state import PP_AXIS
+from megatron_llm_tpu.models import language_model as lm
+from megatron_llm_tpu.models.transformer import transformer_forward
+from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.norms import norm
+
+
+def _stage_body(cfg, layers_local, x, aux, dropout_key, deterministic, rope):
+    """Run this stage's local layers on one microbatch of hidden states."""
+    pp = jax.lax.axis_size(PP_AXIS)
+    stage = jax.lax.axis_index(PP_AXIS)
+    layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
+    hidden, _ = transformer_forward(
+        cfg, layers_local, x,
+        rope=rope,
+        position_ids=aux.get("position_ids"),
+        segment_ids=aux.get("segment_ids"),
+        dropout_key=dropout_key,
+        deterministic=deterministic,
+        layer_offset=stage * layers_per_stage,
+    )
+    return hidden
+
+
+def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
+                   aux_mb: Dict[str, jax.Array], dropout_key, deterministic,
+                   rope):
+    """Run the pipelined transformer body.
+
+    hidden_mb: [M, mb, s, h] embedded microbatches; aux_mb leaves [M, mb, s].
+    Returns [M, mb, s, h] final hidden states (replicated over pp).
+    """
+    pp = cfg.parallel.pipeline_model_parallel_size
+    M = hidden_mb.shape[0]
+
+    def body(layers_local, hidden_mb, aux_mb):
+        stage = jax.lax.axis_index(PP_AXIS)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            recv = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x_in = jax.tree.map(lambda a: a[mb_idx], hidden_mb)
+            aux = jax.tree.map(lambda a: a[mb_idx], aux_mb)
+            inp = jnp.where(stage == 0, x_in, recv)
+            dk = (
+                None if dropout_key is None
+                else jax.random.fold_in(dropout_key, t)
+            )
+            out = _stage_body(cfg, layers_local, inp, aux, dk, deterministic,
+                              rope)
+            nxt = jax.lax.ppermute(out, PP_AXIS, perm)
+            # last stage's output for microbatch t-(pp-1), zero elsewhere
+            y = jnp.where(stage == pp - 1, out, jnp.zeros_like(out))
+            return nxt, y
+
+        init = jnp.zeros_like(hidden_mb[0])
+        _, ys = jax.lax.scan(tick, init, jnp.arange(M + pp - 1))
+        outs = ys[pp - 1:]  # [M, mb, s, h], valid only on the last stage
+        # broadcast last-stage results to every stage (psum of one-hot data);
+        # transpose of this psum routes dLoss back to the last stage only.
+        return jax.lax.psum(outs, PP_AXIS)
+
+    # aux entries may be absent; normalize to a dict of arrays for shard_map
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: jax.sharding.PartitionSpec(PP_AXIS),
+                         stacked_layers),
+            jax.sharding.PartitionSpec(),
+            jax.tree.map(lambda _: jax.sharding.PartitionSpec(), aux_mb),
+        ),
+        out_specs=jax.sharding.PartitionSpec(),
+        axis_names={PP_AXIS},
+        check_vma=False,
+    )
+    return fn(stacked_layers, hidden_mb, aux_mb)
+
+
+def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
+                     dropout_key=None, deterministic=True, rope=None,
+                     sp_constraint=None):
+    """Full pipelined loss over the global batch (microbatched).
+
+    batch leaves [gbs, s]; gbs = M * mb. Embedding/head run outside the
+    pipeline (see module docstring).
+    """
+    M = cfg.parallel.num_micro_batches or 1
+    gbs = batch["tokens"].shape[0]
+    assert gbs % M == 0
+    mb = gbs // M
+
+    def split(x):
+        return x.reshape(M, mb, *x.shape[1:])
+
+    tokens = split(batch["tokens"])
+    labels = split(batch["labels"])
+    loss_mask = split(batch["loss_mask"])
+    aux_mb = {}
+    for k in ("position_ids", "segment_ids"):
+        if batch.get(k) is not None:
+            aux_mb[k] = split(batch[k])
+
+    if rope is None:
+        rope = lm.make_rope_cache(cfg)
+
+    # [M, mb, s, h] embeddings (vocab-parallel over tp under pjit)
+    hidden = jax.vmap(lambda t: lm.embed_tokens(cfg, params, t, None))(tokens)
+    if dropout_key is not None and not deterministic:
+        k_embed, dropout_key = jax.random.split(dropout_key)
+        hidden = rng_mod.dropout(k_embed, cfg.model.hidden_dropout, hidden)
+
+    hidden = pipeline_apply(
+        cfg, mesh, params["layers"], hidden, aux_mb, dropout_key,
+        deterministic, rope,
+    )
+
+    hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,
+                  cfg.model.use_rms_norm)
+    logits = lm.compute_logits(cfg, params, hidden)  # [M, mb, s, v]
+    per_token = softmax_cross_entropy(logits, labels)
+    mask = loss_mask.astype(jnp.float32)
+    loss = (per_token * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss, {"lm loss": loss}

@@ -29,7 +29,7 @@ from typing import Any, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from megatron_llm_tpu.core.parallel_state import DP_AXIS, TP_AXIS
+from megatron_llm_tpu.core.parallel_state import DP_AXIS, PP_AXIS, TP_AXIS
 
 # Grad accumulation / FSDP-style extra sharding could compose here later.
 
@@ -41,7 +41,9 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
     (from init_stacked_layers / scan).
     """
     names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-    lead = (None,) if stacked else ()
+    # stacked per-layer params carry the layer axis first; sharding it over
+    # ``pp`` IS pipeline stage placement (pp=1 meshes make it a no-op)
+    lead = (PP_AXIS,) if stacked else ()
 
     def spec(*rest):
         return P(*lead, *rest)

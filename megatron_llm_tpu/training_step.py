@@ -53,7 +53,8 @@ def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
     return jax.tree.map(r, batch)
 
 
-def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None):
+def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
+                    mesh: Optional[Mesh] = None):
     """Build the pure train_step(params, opt_state, batch, iteration, seed).
 
     Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
@@ -76,6 +77,8 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             sp_constraint=sp_constraint,
         )
 
+    pp = cfg.parallel.pipeline_model_parallel_size
+
     def train_step(params, opt_state, batch, iteration, opt=optimizer):
         if opt is None:
             raise ValueError("optimizer must be bound via make_train_step or arg")
@@ -86,7 +89,23 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             lambda p, mb, k: micro_loss(p, mb, k, rope)[0]
         )
 
-        if num_micro == 1:
+        if pp > 1:
+            # pipelined path: the microbatch loop lives inside the pipeline
+            from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
+
+            deterministic = (
+                cfg.model.hidden_dropout == 0.0
+                and cfg.model.attention_dropout == 0.0
+            )
+            loss, grads = jax.value_and_grad(
+                lambda p: pipeline_loss_fn(
+                    cfg, mesh, p, batch,
+                    dropout_key=None if deterministic else base_key,
+                    deterministic=deterministic, rope=rope,
+                    sp_constraint=sp_constraint,
+                )[0]
+            )(params)
+        elif num_micro == 1:
             loss, grads = grad_fn(params, batch, base_key)
         else:
             mbs = _split_microbatches(batch, num_micro)
@@ -133,7 +152,7 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any):
     b_shard = NamedSharding(mesh, data_spec())
     scalar = NamedSharding(mesh, P())
 
-    step = make_train_step(cfg, optimizer)
+    step = make_train_step(cfg, optimizer, mesh=mesh)
     jstep = jax.jit(
         step,
         in_shardings=(p_shard, o_shard, b_shard, scalar),

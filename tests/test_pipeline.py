@@ -1,0 +1,73 @@
+"""Pipeline parallelism: pp>1 must match pp=1 numerics (reference analog:
+loss-curve match requirement for the PP configs, SURVEY.md §7 step 8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.core.parallel_state import build_mesh
+from megatron_llm_tpu.models import init_model_params, make_config
+from megatron_llm_tpu.training_step import make_jitted_train_step
+
+
+def cfg_for(pp, tp=1, dp=1, num_micro=2, layers=4):
+    gbs = 4
+    cfg = make_config(
+        "llama2",
+        num_layers=layers,
+        hidden_size=64,
+        num_attention_heads=4,
+        num_attention_heads_kv=2,
+        vocab_size=256,
+        seq_length=32,
+        max_position_embeddings=64,
+        params_dtype="float32",
+        use_flash_attn=False,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        micro_batch_size=gbs // num_micro,
+        global_batch_size=gbs,
+        train_iters=10,
+        lr=1e-2,
+    )
+    cfg.parallel.data_parallel_size = dp
+    cfg.parallel.num_micro_batches = num_micro
+    return cfg
+
+
+def make_batch():
+    tok = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 256)
+    return {
+        "tokens": np.asarray(tok[:, :-1]),
+        "labels": np.asarray(tok[:, 1:]),
+        "loss_mask": np.ones((4, 32), np.float32),
+    }
+
+
+def run_one_step(cfg, devices):
+    mesh = build_mesh(
+        tensor_model_parallel_size=cfg.parallel.tensor_model_parallel_size,
+        pipeline_model_parallel_size=cfg.parallel.pipeline_model_parallel_size,
+        devices=devices,
+    )
+    with mesh:
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        step, _o, sh = make_jitted_train_step(cfg, mesh, params)
+        p, _, m = step(params, sh["opt_state_value"], make_batch(), 0)
+        return float(m["lm loss"]), jax.tree.map(np.asarray, p)
+
+
+def test_pp2_matches_pp1(eight_devices):
+    loss1, p1 = run_one_step(cfg_for(pp=1), eight_devices[:1])
+    loss2, p2 = run_one_step(cfg_for(pp=2), eight_devices[:2])
+    assert abs(loss1 - loss2) < 1e-4, (loss1, loss2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_pp4_with_tp2_matches_pp1(eight_devices):
+    loss1, p1 = run_one_step(cfg_for(pp=1), eight_devices[:1])
+    loss2, p2 = run_one_step(cfg_for(pp=4, tp=2, num_micro=4), eight_devices[:8])
+    assert abs(loss1 - loss2) < 1e-4, (loss1, loss2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
