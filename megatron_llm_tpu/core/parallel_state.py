@@ -68,11 +68,13 @@ def build_mesh(
             f"{n} devices not divisible by tp*pp*cp = {tp * pp * cp}"
         )
         dp = n // (tp * pp * cp)
+        need = n  # auto dp must consume every device
     else:
+        # an explicitly requested layout may use a subset of the devices
         dp = data_parallel_size
-    assert dp * pp * cp * tp == n, (
-        f"dp*pp*cp*tp = {dp * pp * cp * tp} != device count {n}"
-    )
+        need = dp * pp * cp * tp
+        assert need <= n, f"dp*pp*cp*tp = {need} > device count {n}"
+    devices = list(devices)[:need]
     dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
     return Mesh(dev_array, (DP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS))
 

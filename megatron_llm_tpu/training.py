@@ -1,0 +1,312 @@
+"""Training driver: the ``pretrain`` orchestration loop.
+
+Reference: megatron/training.py — ``pretrain``:55, ``train_step``:393 (ours is
+jitted whole in training_step.py), ``_train`` loop:654 (eval :713,
+signal-exit :731, save :739, time/iter exits :746-767), ``evaluate``:773,
+``training_log``:462 with tokens/sec (:591-609).
+
+Single-controller redesign: no rank gymnastics (is-last-rank printing, TP-rank
+data broadcast, all-reduced exit flags) — one process drives the mesh; exit
+decisions are plain Python.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from megatron_llm_tpu.checkpointing import load_checkpoint, save_checkpoint
+from megatron_llm_tpu.core.parallel_state import build_mesh_from_config, global_mesh
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.data.batch_utils import get_ltor_batch
+from megatron_llm_tpu.models import init_model_params
+from megatron_llm_tpu.models.language_model import loss_from_batch, make_rope_cache
+from megatron_llm_tpu.optimizer.optimizer import opt_state_shardings
+from megatron_llm_tpu.parallel.tp import make_sp_constraint, param_shardings
+from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+from megatron_llm_tpu.training_step import make_jitted_train_step
+from megatron_llm_tpu.utils.logging_utils import (
+    SignalHandler,
+    build_writer,
+    set_global,
+)
+from megatron_llm_tpu.utils.timers import Timers
+
+
+def model_flops_per_token(cfg) -> float:
+    """Matmul FLOPs/token for fwd+bwd (reference FLOP estimate family,
+    language_model.py:370-384): 6*N plus causal attention term."""
+    m = cfg.model
+    n_params = _approx_param_count(cfg)
+    attn = 6 * m.num_layers * m.hidden_size * cfg.data.seq_length  # causal half
+    return 6 * n_params + attn
+
+
+def _approx_param_count(cfg) -> int:
+    m = cfg.model
+    h, L = m.hidden_size, m.num_layers
+    d = m.kv_channels or h // m.num_attention_heads
+    n, nkv = m.num_attention_heads, m.num_attention_heads_kv or n
+    ffn = m.ffn_hidden_size
+    glu = 2 if m.glu_activation else 1
+    per_layer = h * (n + 2 * nkv) * d + n * d * h + h * ffn * glu + ffn * h
+    v = m.vocab_size or 32000
+    emb = v * h * (1 if m.tie_embed_logits else 2)
+    return per_layer * L + emb
+
+
+def build_gpt_data_iterators(cfg, tokenizer):
+    """Default dataset provider: GPT pretraining over --data_path."""
+    from megatron_llm_tpu.data.gpt_dataset import build_train_valid_test_datasets
+    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
+
+    t = cfg.training
+    gbs = t.global_batch_size
+    train_samples = (t.train_samples or (t.train_iters or 0) * gbs)
+    eval_samples = cfg.training.eval_iters * gbs * (
+        1 + (t.train_iters or 0) // max(cfg.training.eval_interval, 1)
+    )
+    train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
+        cfg.data.data_path,
+        cfg.data.split,
+        (train_samples, eval_samples, cfg.training.eval_iters * gbs),
+        cfg.data.seq_length,
+        cfg.training.seed,
+        data_impl=cfg.data.data_impl,
+    )
+
+    eod = getattr(tokenizer, "eod", None) if tokenizer else None
+
+    def collate(samples):
+        text = np.stack([s["text"] for s in samples])
+        return get_ltor_batch(
+            text,
+            eod_token=eod,
+            reset_position_ids=cfg.data.reset_position_ids,
+            reset_attention_mask=cfg.data.reset_attention_mask,
+            eod_mask_loss=cfg.data.eod_mask_loss,
+        )
+
+    def loader(ds, consumed):
+        return build_pretraining_data_loader(
+            ds, consumed, gbs, cfg.data.dataloader_type, cfg.training.seed,
+            collate_fn=collate,
+        )
+
+    return loader, (train_ds, valid_ds, test_ds)
+
+
+def make_eval_step(cfg):
+    sp_c = make_sp_constraint(cfg)
+
+    def eval_step(params, batch):
+        loss, metrics = loss_from_batch(
+            cfg, params, batch, deterministic=True, sp_constraint=sp_c
+        )
+        return metrics
+
+    return jax.jit(eval_step)
+
+
+def evaluate(cfg, params, eval_step, data_iterator, max_iters: Optional[int] = None):
+    """evaluate analog (training.py:773-860): mean loss over eval_iters."""
+    totals: Dict[str, float] = {}
+    n = 0
+    max_iters = max_iters or cfg.training.eval_iters
+    for _ in range(max_iters):
+        try:
+            batch = next(data_iterator)
+        except StopIteration:
+            break
+        metrics = eval_step(params, batch)
+        for k, v in metrics.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(n, 1) for k, v in totals.items()}
+
+
+def training_log(cfg, metrics, iteration, step_time, writer, timers,
+                 consumed_samples):
+    """training_log analog (training.py:462-641)."""
+    t = cfg.training
+    tokens_per_step = t.global_batch_size * cfg.data.seq_length
+    tps = tokens_per_step / step_time if step_time > 0 else 0.0
+    flops = model_flops_per_token(cfg) * tps
+    loss = float(metrics.get("lm loss", float("nan")))
+    lr = float(metrics.get("learning_rate", 0.0))
+    gnorm = float(metrics.get("grad_norm", 0.0))
+    msg = (
+        f"iteration {iteration:8d}/{t.train_iters or 0:8d} | "
+        f"consumed samples: {consumed_samples:12d} | "
+        f"elapsed time per iteration (ms): {step_time * 1000:.1f} | "
+        f"learning rate: {lr:.3E} | global batch size: {t.global_batch_size:5d} | "
+        f"lm loss: {loss:.6E} | grad norm: {gnorm:.3f} | "
+        f"tokens/sec: {tps:,.0f} | TFLOP/s (model): {flops / 1e12:.1f}"
+    )
+    print(msg, flush=True)
+    if writer is not None:
+        writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
+        if cfg.logging.log_learning_rate_to_tensorboard:
+            writer.add_scalar("learning-rate/learning-rate", lr, iteration)
+        writer.add_scalar("grad-norm/grad-norm", gnorm, iteration)
+        writer.add_scalar("throughput/tokens-per-sec", tps, iteration)
+        writer.add_scalar("batch-size/batch-size", t.global_batch_size, iteration)
+        if cfg.logging.log_timers_to_tensorboard and timers is not None:
+            timers.write(writer, iteration)
+    if timers is not None and cfg.logging.timing_log_level > 0:
+        log = timers.log()
+        if log:
+            print(f"    timers(ms): {log}", flush=True)
+
+
+def pretrain(
+    cfg,
+    data_iterators_provider: Optional[Callable] = None,
+    params_provider: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """End-to-end training (pretrain analog, training.py:55-196).
+
+    Returns final state dict for programmatic use/testing.
+    """
+    t0 = time.time()
+    mesh = build_mesh_from_config(cfg)
+    tokenizer = None
+    if cfg.data.tokenizer_type and (cfg.data.data_path or cfg.data.tokenizer_model
+                                    or cfg.data.tokenizer_type == "NullTokenizer"):
+        tokenizer = build_tokenizer(cfg)
+        set_global("tokenizer", tokenizer)
+
+    timers = Timers(cfg.logging.timing_log_level, cfg.logging.timing_log_option)
+    writer = build_writer(cfg)
+    sig = SignalHandler() if cfg.training.exit_signal_handler else None
+
+    with global_mesh(mesh):
+        # ---- model + optimizer ----
+        init_fn = params_provider or (lambda key: init_model_params(cfg, key))
+        key = rng_mod.init_key(cfg.training.seed)
+        shapes = jax.eval_shape(init_fn, key)
+        p_shardings = param_shardings(mesh, shapes)
+        timers("model-setup", 0).start()
+        params = jax.jit(init_fn, out_shardings=p_shardings)(key)
+        step_fn, optimizer, shardings = make_jitted_train_step(cfg, mesh, params)
+        opt_state = shardings["opt_state_value"]
+        timers("model-setup").stop()
+
+        iteration, consumed_samples = 0, 0
+        if cfg.checkpoint.load:
+            try:
+                o_shardings = opt_state_shardings(cfg, mesh, params, opt_state)
+                params, loaded_opt, iteration, consumed_samples, _ = load_checkpoint(
+                    cfg, cfg.checkpoint.load, params, opt_state,
+                    p_shardings, o_shardings,
+                )
+                if loaded_opt is not None:
+                    opt_state = loaded_opt
+                print(f"loaded checkpoint from {cfg.checkpoint.load} "
+                      f"at iteration {iteration}")
+            except FileNotFoundError as e:
+                if cfg.checkpoint.exit_on_missing_checkpoint:
+                    raise
+                print(f"WARNING: {e}; training from scratch")
+
+        # ---- data ----
+        if data_iterators_provider is not None:
+            train_iter, valid_iter_factory = data_iterators_provider(
+                cfg, tokenizer, consumed_samples
+            )
+        elif cfg.data.data_path:
+            loader, (train_ds, valid_ds, _)= build_gpt_data_iterators(cfg, tokenizer)
+            train_iter = loader(train_ds, consumed_samples)
+            valid_iter_factory = (lambda: loader(valid_ds, 0)) if valid_ds else None
+        else:
+            raise ValueError("no data: set cfg.data.data_path or pass a provider")
+
+        eval_step = make_eval_step(cfg)
+
+        # ---- train loop (_train analog, training.py:654-770) ----
+        t = cfg.training
+        gbs = t.global_batch_size
+        train_iters = t.train_iters or 0
+        exit_reason = "train_iters reached"
+        metrics: Dict[str, Any] = {}
+        step_times = []
+
+        while iteration < train_iters:
+            if t.skip_train:
+                break
+            try:
+                timers("batch-generator", 1).start()
+                batch = next(train_iter)
+                timers("batch-generator").stop()
+            except StopIteration:
+                exit_reason = "data exhausted"
+                break
+
+            timers("train-step", 0).start()
+            step_start = time.time()
+            if iteration not in (t.skip_iters or []):
+                # --skip_iters skips the update (training.py:397-399)
+                params, opt_state, metrics = step_fn(
+                    params, opt_state, batch, iteration
+                )
+                jax.block_until_ready(metrics["lm loss"])
+            step_time = time.time() - step_start
+            timers("train-step").stop()
+            step_times.append(step_time)
+            iteration += 1
+            consumed_samples += gbs
+
+            if iteration % cfg.logging.log_interval == 0:
+                avg = float(np.mean(step_times[-cfg.logging.log_interval:]))
+                training_log(cfg, metrics, iteration, avg, writer, timers,
+                             consumed_samples)
+
+            if (cfg.training.eval_interval and valid_iter_factory
+                    and iteration % cfg.training.eval_interval == 0):
+                ev = evaluate(cfg, params, eval_step, valid_iter_factory())
+                print(f" validation loss at iteration {iteration}: "
+                      + " | ".join(f"{k}: {v:.6E}" for k, v in ev.items()),
+                      flush=True)
+                if writer:
+                    for k, v in ev.items():
+                        writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
+
+            if (cfg.checkpoint.save and cfg.checkpoint.save_interval
+                    and iteration % cfg.checkpoint.save_interval == 0):
+                timers("save-checkpoint", 0).start()
+                save_checkpoint(cfg, cfg.checkpoint.save, iteration, params,
+                                opt_state, consumed_samples)
+                timers("save-checkpoint").stop()
+
+            # exit conditions (training.py:731-767)
+            if sig is not None and sig.signals_received():
+                exit_reason = "signal"
+                break
+            if t.exit_interval and iteration % t.exit_interval == 0:
+                exit_reason = "exit_interval"
+                break
+            if t.exit_duration_in_mins and (
+                (time.time() - t0) / 60.0 > t.exit_duration_in_mins
+            ):
+                exit_reason = "exit_duration"
+                break
+
+        if cfg.checkpoint.save and exit_reason != "train_iters reached":
+            save_checkpoint(cfg, cfg.checkpoint.save, iteration, params,
+                            opt_state, consumed_samples)
+        if writer is not None and hasattr(writer, "flush"):
+            writer.flush()
+
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "iteration": iteration,
+            "consumed_samples": consumed_samples,
+            "exit_reason": exit_reason,
+            "last_metrics": metrics,
+            "mesh": mesh,
+        }

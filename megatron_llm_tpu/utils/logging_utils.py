@@ -1,0 +1,112 @@
+"""Observability: tensorboard/wandb writers, global singletons, signal handler.
+
+Reference analogs: megatron/global_vars.py (singleton registry),
+megatron/wandb_logger.py (WandbTBShim — a tensorboard-API-compatible wandb
+writer), megatron/dist_signal_handler.py (SIGTERM -> checkpoint-and-exit;
+single-controller here, so no all-gather agreement protocol is needed).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Any, Dict, Optional
+
+_GLOBALS: Dict[str, Any] = {}
+
+
+def set_global(name: str, value: Any) -> None:
+    _GLOBALS[name] = value
+
+
+def get_global(name: str, default=None) -> Any:
+    return _GLOBALS.get(name, default)
+
+
+def get_tokenizer():
+    return _GLOBALS.get("tokenizer")
+
+
+def build_writer(cfg):
+    """Tensorboard writer, optionally the wandb shim (wandb_logger.py:90-161)."""
+    log = cfg.logging
+    if log.wandb_logger:
+        try:
+            return WandbTBShim(cfg)
+        except ImportError:
+            print("WARNING: wandb not available; falling back to tensorboard")
+    if log.tensorboard_dir:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            return SummaryWriter(log_dir=log.tensorboard_dir,
+                                 max_queue=log.tensorboard_queue_size)
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter
+
+                return SummaryWriter(log_dir=log.tensorboard_dir)
+            except ImportError:
+                print("WARNING: no tensorboard backend available")
+    return None
+
+
+class WandbTBShim:
+    """Minimal tensorboard-API adapter over wandb (add_scalar/add_text),
+    with step-accumulated commits (wandb_logger.py:90-161 behavior)."""
+
+    def __init__(self, cfg):
+        import wandb  # gated: raises ImportError when absent
+
+        log = cfg.logging
+        self._wandb = wandb
+        self._run = wandb.init(
+            project=log.wandb_project or None,
+            entity=log.wandb_entity or None,
+            name=log.wandb_name,
+            id=log.wandb_id,
+            resume="must" if log.wandb_resume else None,
+            config=_flat_config(cfg),
+        )
+        self._pending: Dict[str, float] = {}
+        self._step = -1
+
+    def add_scalar(self, tag: str, value, step: int):
+        if step != self._step and self._pending:
+            self._wandb.log(self._pending, step=self._step)
+            self._pending = {}
+        self._step = step
+        self._pending[tag] = value
+
+    def add_text(self, tag: str, text: str, step: int = 0):
+        self._wandb.log({tag: text}, step=step)
+
+    def flush(self):
+        if self._pending:
+            self._wandb.log(self._pending, step=self._step)
+            self._pending = {}
+
+
+def _flat_config(cfg) -> Dict[str, Any]:
+    import dataclasses
+
+    out = {}
+    for group in ("model", "parallel", "training", "optimizer", "data"):
+        for k, v in dataclasses.asdict(getattr(cfg, group)).items():
+            out[f"{group}.{k}"] = v
+    return out
+
+
+class SignalHandler:
+    """SIGTERM capture -> graceful checkpoint-and-exit
+    (dist_signal_handler.py:50-81; no cross-rank all-gather needed under the
+    single-controller runtime)."""
+
+    def __init__(self, sig=signal.SIGTERM):
+        self._triggered = False
+        self._prev = signal.signal(sig, self._handle)
+
+    def _handle(self, signum, frame):
+        self._triggered = True
+
+    def signals_received(self) -> bool:
+        return self._triggered

@@ -1,0 +1,103 @@
+"""Hierarchical named timers.
+
+Reference: megatron/timers.py (Timer:56 with barrier + cuda.synchronize
+discipline; log levels 0-2; minmax across ranks; tensorboard write). TPU
+analog: ``jax.block_until_ready`` on a marker array replaces
+``cuda.synchronize``; there is one host process, so the cross-rank max/minmax
+reductions disappear (single-controller) — per-device skew is visible in the
+profiler traces instead (utils/profiler.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._count = 0
+        self._started = False
+        self._start_time = 0.0
+
+    def start(self, barrier: bool = False):
+        assert not self._started, f"timer {self.name} already started"
+        if barrier:
+            _device_sync()
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self, barrier: bool = False):
+        assert self._started, f"timer {self.name} not started"
+        if barrier:
+            _device_sync()
+        self._elapsed += time.perf_counter() - self._start_time
+        self._count += 1
+        self._started = False
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        running = self._started
+        if running:
+            self.stop()
+        e = self._elapsed
+        if reset:
+            self.reset()
+        if running:
+            self.start()
+        return e
+
+
+def _device_sync():
+    """Analog of torch.cuda.synchronize: wait for all in-flight work."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Timers:
+    """Timer registry with log levels 0-2 (timers.py:122-304 semantics)."""
+
+    def __init__(self, log_level: int = 0, log_option: str = "minmax"):
+        self._timers: Dict[str, Timer] = {}
+        self._log_levels: Dict[str, int] = {}
+        self._max_level = log_level
+        self._option = log_option
+
+    def __call__(self, name: str, log_level: int = 0) -> Timer:
+        if name not in self._timers:
+            self._timers[name] = Timer(name)
+            self._log_levels[name] = log_level
+        return self._timers[name]
+
+    def active(self, name: str) -> bool:
+        return self._log_levels.get(name, 0) <= self._max_level
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True) -> str:
+        """Per-interval times in ms; resets by default (starts a new interval)."""
+        names = names or [
+            n for n in self._timers if self._log_levels[n] <= self._max_level
+        ]
+        parts = []
+        for n in names:
+            if n in self._timers and self._timers[n]._count > 0:
+                e = self._timers[n].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{n}: {e:.2f}")
+        return " | ".join(parts)
+
+    def write(self, writer, iteration: int, names=None, normalizer: float = 1.0):
+        """Write per-interval times in ms (same units as log()); does not
+        reset, so call before log() — whose reset then starts a new interval."""
+        names = names or list(self._timers)
+        for n in names:
+            if n in self._timers and self._timers[n]._count > 0:
+                writer.add_scalar(
+                    f"timers/{n}",
+                    self._timers[n].elapsed(reset=False) * 1000.0 / normalizer,
+                    iteration,
+                )

@@ -1,0 +1,92 @@
+"""End-to-end training driver test: toy corpus -> pretrain() -> checkpoint ->
+resume (reference analog: the test_llama_weights.py lifecycle test, minus the
+real weights)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import Config, apply_architecture
+from megatron_llm_tpu.data.indexed_dataset import make_builder
+
+
+@pytest.fixture
+def toy_corpus(tmp_path):
+    prefix = str(tmp_path / "corpus_text_document")
+    rng = np.random.RandomState(0)
+    builder = make_builder(prefix + ".bin", vocab_size=500)
+    for _ in range(50):
+        builder.add_doc(rng.randint(1, 500, size=rng.randint(40, 120)))
+    builder.finalize(prefix + ".idx")
+    return prefix
+
+
+def small_cfg(toy_corpus, tmp_path, train_iters=8):
+    cfg = Config()
+    apply_architecture(cfg, "llama2")
+    cfg.model.num_layers = 2
+    cfg.model.hidden_size = 64
+    cfg.model.num_attention_heads = 4
+    cfg.model.num_attention_heads_kv = 2
+    cfg.model.vocab_size = 512
+    cfg.model.max_position_embeddings = 64
+    cfg.data.seq_length = 32
+    cfg.data.data_path = [toy_corpus]
+    cfg.data.tokenizer_type = "NullTokenizer"
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    cfg.training.micro_batch_size = 4
+    cfg.training.global_batch_size = 4
+    cfg.training.train_iters = train_iters
+    cfg.training.eval_iters = 2
+    cfg.training.eval_interval = 4
+    cfg.optimizer.lr = 1e-3
+    cfg.optimizer.lr_warmup_iters = 2
+    cfg.checkpoint.save = str(tmp_path / "ckpt")
+    cfg.checkpoint.save_interval = 4
+    cfg.logging.log_interval = 4
+    cfg.finalize(n_devices=1)
+    return cfg
+
+
+def test_pretrain_end_to_end_and_resume(toy_corpus, tmp_path, capsys):
+    from megatron_llm_tpu.training import pretrain
+
+    cfg = small_cfg(toy_corpus, tmp_path, train_iters=8)
+    result = pretrain(cfg)
+    assert result["iteration"] == 8
+    assert result["consumed_samples"] == 32
+    first_loss = float(result["last_metrics"]["lm loss"])
+    assert np.isfinite(first_loss)
+    # checkpoint layout
+    ckpt = cfg.checkpoint.save
+    assert os.path.isfile(os.path.join(ckpt, "latest_checkpointed_iteration.txt"))
+    assert os.path.isdir(os.path.join(ckpt, "iter_0000008", "params"))
+
+    # ---- resume: 8 more iterations from the checkpoint ----
+    cfg2 = small_cfg(toy_corpus, tmp_path, train_iters=16)
+    cfg2.checkpoint.load = ckpt
+    result2 = pretrain(cfg2)
+    assert result2["iteration"] == 16
+    assert result2["consumed_samples"] == 64
+    second_loss = float(result2["last_metrics"]["lm loss"])
+    assert second_loss < 6.5  # training is actually progressing
+
+    out = capsys.readouterr().out
+    assert "validation loss" in out
+    assert "tokens/sec" in out
+
+
+def test_finetune_flag_resets_iteration(toy_corpus, tmp_path):
+    from megatron_llm_tpu.training import pretrain
+
+    cfg = small_cfg(toy_corpus, tmp_path, train_iters=4)
+    pretrain(cfg)
+
+    cfg2 = small_cfg(toy_corpus, tmp_path, train_iters=2)
+    cfg2.checkpoint.load = cfg.checkpoint.save
+    cfg2.checkpoint.finetune = True
+    cfg2.checkpoint.save = str(tmp_path / "ckpt2")
+    result = pretrain(cfg2)
+    assert result["iteration"] == 2  # reset, not resumed at 4
