@@ -32,8 +32,10 @@ def validate_family(cfg: Config) -> Config:
         _check(m.position_embedding_type == "rotary", "falcon requires rotary embeddings")
         _check(not m.use_rms_norm, "falcon uses LayerNorm, not RMSNorm")
     elif name == "mistral":
-        # mistral_model.py:30
-        _check(m.sliding_window_size == 4096, "mistral requires sliding_window_size=4096")
+        # mistral_model.py:30 pins 4096; we only require a window to be set so
+        # HF checkpoints with other window sizes convert cleanly
+        _check(m.sliding_window_size is not None,
+               "mistral requires sliding_window_size")
         _check(m.use_rms_norm and m.glu_activation == "swiglu", "mistral uses llama block")
     return cfg
 

@@ -1,0 +1,250 @@
+"""HuggingFace -> native weight conversion (Llama/Llama-2/CodeLlama/Mistral/
+Falcon).
+
+Reference: weights_conversion/hf_to_megatron.py (llama_to_megatron:116,
+falcon_to_megatron:59). Differences by design: output is ONE tp/pp-agnostic
+orbax checkpoint tagged ``release`` (sharding happens at load time via
+NamedSharding — no mp_rank_XX files), and the QKV layout is the group-major
+fused kernel documented in models/transformer.py.
+
+Run as a script:
+    python -m weights_conversion.hf_to_native --model <hf-path-or-name> \
+        --out ckpts/llama2-7b [--model_name llama2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from megatron_llm_tpu.models.language_model import padded_vocab_size
+from weights_conversion.permute_qkv import hf_rows_to_interleaved
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().to("cpu").float().numpy()
+
+
+def pack_qkv(q: np.ndarray, k: np.ndarray, v: np.ndarray,
+             n: int, nkv: int, d: int) -> np.ndarray:
+    """[n*d, h], [nkv*d, h], [nkv*d, h] (out-major, torch layout) ->
+    fused group-major kernel [h, (n+2nkv)*d]."""
+    h = q.shape[1]
+    g = n // nkv
+    qg = q.reshape(nkv, g, d, h)
+    kg = k.reshape(nkv, 1, d, h)
+    vg = v.reshape(nkv, 1, d, h)
+    fused = np.concatenate([qg, kg, vg], axis=1)  # [nkv, g+2, d, h]
+    return np.ascontiguousarray(
+        fused.reshape(nkv * (g + 2) * d, h).T
+    )  # [h, (n+2nkv)d]
+
+
+def unpack_qkv(kernel: np.ndarray, n: int, nkv: int, d: int):
+    """Inverse of pack_qkv: [h, (n+2nkv)d] -> (q, k, v) torch-layout arrays."""
+    h = kernel.shape[0]
+    g = n // nkv
+    fused = kernel.T.reshape(nkv, g + 2, d, h)
+    q = fused[:, :g].reshape(n * d, h)
+    k = fused[:, g].reshape(nkv * d, h)
+    v = fused[:, g + 1].reshape(nkv * d, h)
+    return q, k, v
+
+
+def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF Llama/Mistral state_dict -> native params pytree (numpy, fp32)."""
+    m = cfg.model
+    n, nkv, d, h = (m.num_attention_heads, m.num_attention_heads_kv,
+                    m.kv_channels, m.hidden_size)
+    L = m.num_layers
+    vpad = padded_vocab_size(m.vocab_size, cfg)
+
+    def emb_pad(w):
+        out = np.zeros((vpad, h), np.float32)
+        out[: w.shape[0]] = w
+        return out
+
+    layers: Dict[str, Any] = {}
+
+    def stack(key_fn):
+        return np.stack([key_fn(i) for i in range(L)])
+
+    def W(name, i):
+        return _np(state[f"model.layers.{i}.{name}.weight"])
+
+    def qkv_kernel(i):
+        q = hf_rows_to_interleaved(W("self_attn.q_proj", i), d)
+        k = hf_rows_to_interleaved(W("self_attn.k_proj", i), d)
+        v = W("self_attn.v_proj", i)
+        return pack_qkv(q, k, v, n, nkv, d)
+
+    params = {
+        "embedding": {
+            "word_embeddings": emb_pad(_np(state["model.embed_tokens.weight"]))
+        },
+        "layers": {
+            "input_norm": {"scale": stack(lambda i: W("input_layernorm", i))},
+            "post_norm": {
+                "scale": stack(lambda i: W("post_attention_layernorm", i))
+            },
+            "attention": {
+                "qkv": {"kernel": stack(qkv_kernel)},
+                "dense": {
+                    "kernel": stack(lambda i: W("self_attn.o_proj", i).T)
+                },
+            },
+            "mlp": {
+                # fc1 [h, 2, ffn]: slot 0 = value (up_proj), slot 1 = gated
+                # half (gate_proj) — mlp computes x1 * silu(x2)
+                "fc1": {
+                    "kernel": stack(
+                        lambda i: np.stack(
+                            [W("mlp.up_proj", i).T, W("mlp.gate_proj", i).T],
+                            axis=1,
+                        )
+                    )
+                },
+                "fc2": {"kernel": stack(lambda i: W("mlp.down_proj", i).T)},
+            },
+        },
+        "final_norm": {"scale": _np(state["model.norm.weight"])},
+    }
+    if not m.tie_embed_logits:
+        params["lm_head"] = {
+            "kernel": np.ascontiguousarray(emb_pad(_np(state["lm_head.weight"])).T)
+        }
+    return params
+
+
+def convert_falcon_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
+    """HF Falcon state_dict -> native params (parallel-attn block)."""
+    m = cfg.model
+    n, nkv, d, h = (m.num_attention_heads, m.num_attention_heads_kv,
+                    m.kv_channels, m.hidden_size)
+    L = m.num_layers
+    vpad = padded_vocab_size(m.vocab_size, cfg)
+
+    def emb_pad(w):
+        out = np.zeros((vpad, h), np.float32)
+        out[: w.shape[0]] = w
+        return out
+
+    def W(name, i):
+        return _np(state[f"transformer.h.{i}.{name}.weight"])
+
+    def B(name, i):
+        key = f"transformer.h.{i}.{name}.bias"
+        return _np(state[key]) if key in state else None
+
+    def qkv_kernel(i):
+        # HF falcon fused qkv is already [nkv, g+2, d, h]-ordered
+        w = W("self_attention.query_key_value", i)  # [(n+2nkv)d, h]
+        g = n // nkv
+        grouped = w.reshape(nkv, g + 2, d, h)
+        q = grouped[:, :g].reshape(n * d, h)
+        k = grouped[:, g].reshape(nkv * d, h)
+        v = grouped[:, g + 1].reshape(nkv * d, h)
+        q = hf_rows_to_interleaved(q, d)
+        k = hf_rows_to_interleaved(k, d)
+        return pack_qkv(q, k, v, n, nkv, d)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    ln_name = "ln_attn" if m.parallel_layernorm else "input_layernorm"
+    layers = {
+        "input_norm": {
+            "scale": stack(lambda i: W(ln_name, i)),
+            "bias": stack(lambda i: B(ln_name, i)),
+        },
+        "attention": {
+            "qkv": {"kernel": stack(qkv_kernel)},
+            "dense": {"kernel": stack(lambda i: W("self_attention.dense", i).T)},
+        },
+        "mlp": {
+            "fc1": {"kernel": stack(lambda i: W("mlp.dense_h_to_4h", i).T)},
+            "fc2": {"kernel": stack(lambda i: W("mlp.dense_4h_to_h", i).T)},
+        },
+    }
+    if m.parallel_layernorm:
+        layers["mlp_norm"] = {
+            "scale": stack(lambda i: W("ln_mlp", i)),
+            "bias": stack(lambda i: B("ln_mlp", i)),
+        }
+    return {
+        "embedding": {
+            "word_embeddings": emb_pad(_np(state["transformer.word_embeddings.weight"]))
+        },
+        "layers": layers,
+        "final_norm": {
+            "scale": _np(state["transformer.ln_f.weight"]),
+            "bias": _np(state["transformer.ln_f.bias"]),
+        },
+    }
+
+
+def convert_hf_model(hf_model, cfg) -> Dict[str, Any]:
+    state = hf_model.state_dict()
+    if cfg.model_name == "falcon":
+        return convert_falcon_state(state, cfg)
+    return convert_llama_state(state, cfg)
+
+
+def config_from_hf(hf_config, model_name: str):
+    """Derive a native Config from an HF config object."""
+    from megatron_llm_tpu.models import make_config
+
+    kw = dict(
+        num_layers=hf_config.num_hidden_layers,
+        hidden_size=hf_config.hidden_size,
+        num_attention_heads=hf_config.num_attention_heads,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=getattr(hf_config, "max_position_embeddings", 2048),
+    )
+    if model_name == "falcon":
+        kw["num_attention_heads_kv"] = getattr(hf_config, "num_kv_heads", None) or (
+            1 if getattr(hf_config, "multi_query", False)
+            else hf_config.num_attention_heads
+        )
+        kw["parallel_layernorm"] = getattr(hf_config, "new_decoder_architecture", False)
+        kw["tie_embed_logits"] = True
+    else:
+        kw["num_attention_heads_kv"] = getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        )
+        kw["ffn_hidden_size"] = hf_config.intermediate_size
+        kw["layernorm_epsilon"] = hf_config.rms_norm_eps
+        kw["rope_theta"] = getattr(hf_config, "rope_theta", 10000.0)
+        if model_name == "mistral":
+            kw["sliding_window_size"] = getattr(hf_config, "sliding_window", 4096)
+    return make_config(model_name, **kw)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True, help="HF model path or name")
+    ap.add_argument("--out", required=True, help="output checkpoint dir")
+    ap.add_argument("--model_name", default="llama2",
+                    choices=["llama", "llama2", "codellama", "mistral", "falcon"])
+    args = ap.parse_args()
+
+    import orbax.checkpoint as ocp
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(args.model)
+    cfg = config_from_hf(hf_cfg, args.model_name)
+    model = AutoModelForCausalLM.from_pretrained(args.model)
+    params = convert_hf_model(model, cfg)
+
+    out = os.path.abspath(os.path.join(args.out, "release"))
+    ocp.StandardCheckpointer().save(os.path.join(out, "params"), params)
+    with open(os.path.join(args.out, "latest_checkpointed_iteration.txt"), "w") as f:
+        f.write("release")
+    print(f"saved release checkpoint to {out}")
+
+
+if __name__ == "__main__":
+    main()

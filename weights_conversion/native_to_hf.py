@@ -1,0 +1,136 @@
+"""Native -> HuggingFace weight conversion (inverse of hf_to_native).
+
+Reference: weights_conversion/megatron_to_hf.py (un-permute qkv, write HF
+safetensors/config). Loads an orbax checkpoint (any tp/pp it was trained
+with — shardings are erased on host gather), rebuilds the HF state dict, and
+saves with ``save_pretrained`` so ``AutoModelForCausalLM.from_pretrained``
+loads it directly (tools/push_to_hub.py then uploads it).
+
+    python -m weights_conversion.native_to_hf --load ckpts/run1 \
+        --out /tmp/hf-export --model_name llama2 [--vocab_size 32000]
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict
+
+import numpy as np
+
+from weights_conversion.hf_to_native import unpack_qkv
+from weights_conversion.permute_qkv import interleaved_rows_to_hf
+
+
+def to_hf_llama_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str, Any]:
+    """Native params pytree -> HF Llama/Mistral state dict (numpy)."""
+    m = cfg.model
+    n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
+    L = m.num_layers
+    layers = params["layers"]
+    state: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight":
+            np.asarray(params["embedding"]["word_embeddings"])[:vocab_size],
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+    }
+    if "lm_head" in params:
+        state["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"]["kernel"]).T[:vocab_size]
+        )
+    for i in range(L):
+        pre = f"model.layers.{i}"
+        get = lambda *ks: np.asarray(_walk(layers, ks)[i])
+        q, k, v = unpack_qkv(get("attention", "qkv", "kernel"), n, nkv, d)
+        state[f"{pre}.self_attn.q_proj.weight"] = interleaved_rows_to_hf(q, d)
+        state[f"{pre}.self_attn.k_proj.weight"] = interleaved_rows_to_hf(k, d)
+        state[f"{pre}.self_attn.v_proj.weight"] = v
+        state[f"{pre}.self_attn.o_proj.weight"] = np.ascontiguousarray(
+            get("attention", "dense", "kernel").T
+        )
+        fc1 = get("mlp", "fc1", "kernel")  # [h, 2, ffn]
+        state[f"{pre}.mlp.up_proj.weight"] = np.ascontiguousarray(fc1[:, 0, :].T)
+        state[f"{pre}.mlp.gate_proj.weight"] = np.ascontiguousarray(fc1[:, 1, :].T)
+        state[f"{pre}.mlp.down_proj.weight"] = np.ascontiguousarray(
+            get("mlp", "fc2", "kernel").T
+        )
+        state[f"{pre}.input_layernorm.weight"] = get("input_norm", "scale")
+        state[f"{pre}.post_attention_layernorm.weight"] = get("post_norm", "scale")
+    return state
+
+
+def _walk(tree, keys):
+    for k in keys:
+        tree = tree[k]
+    return tree
+
+
+def hf_config_from_native(cfg, vocab_size: int):
+    from transformers import LlamaConfig, MistralConfig
+
+    m = cfg.model
+    common = dict(
+        vocab_size=vocab_size,
+        hidden_size=m.hidden_size,
+        intermediate_size=m.ffn_hidden_size,
+        num_hidden_layers=m.num_layers,
+        num_attention_heads=m.num_attention_heads,
+        num_key_value_heads=m.num_attention_heads_kv,
+        max_position_embeddings=m.max_position_embeddings,
+        rms_norm_eps=m.layernorm_epsilon,
+        rope_theta=m.rope_theta,
+        tie_word_embeddings=m.tie_embed_logits,
+    )
+    if cfg.model_name == "mistral":
+        return MistralConfig(sliding_window=m.sliding_window_size, **common)
+    return LlamaConfig(**common)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--load", required=True, help="native checkpoint dir")
+    ap.add_argument("--out", required=True, help="HF output dir")
+    ap.add_argument("--model_name", default="llama2")
+    ap.add_argument("--vocab_size", type=int, default=None,
+                    help="unpadded vocab size (default: from checkpoint meta)")
+    args = ap.parse_args()
+
+    import json
+    import os
+
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from megatron_llm_tpu.checkpointing import (
+        checkpoint_dir,
+        load_checkpoint,
+        read_tracker,
+    )
+    from megatron_llm_tpu.models import make_config
+
+    iteration, release = read_tracker(args.load)
+    meta_path = os.path.join(
+        checkpoint_dir(args.load, iteration or 0, release), "meta.json"
+    )
+    with open(meta_path) as f:
+        saved = json.load(f)["config"]
+    cfg = make_config(args.model_name or saved.get("model_name", "llama2"),
+                      **{k: v for k, v in saved["model"].items() if v is not None})
+
+    import orbax.checkpoint as ocp
+
+    path = checkpoint_dir(os.path.abspath(args.load), iteration or 0, release)
+    params = ocp.StandardCheckpointer().restore(os.path.join(path, "params"))
+
+    vocab = args.vocab_size or saved["model"].get("vocab_size")
+    state = to_hf_llama_state(params, cfg, vocab)
+    hf_cfg = hf_config_from_native(cfg, vocab)
+    model = AutoModelForCausalLM.from_config(hf_cfg)
+    model.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()},
+        strict=not cfg.model.tie_embed_logits,
+    )
+    model.save_pretrained(args.out, safe_serialization=True)
+    print(f"saved HF model to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

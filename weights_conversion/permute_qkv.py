@@ -1,0 +1,39 @@
+"""RoPE convention permutations.
+
+HuggingFace Llama applies RoPE in the "half-rotation" convention (pairs are
+(i, i + d/2)); this framework — like the Meta/reference checkpoints
+(megatron/model/positional_embeddings.py) — uses the interleaved convention
+(pairs are (2i, 2i+1)). Converting weights between the two is a fixed
+permutation of each head's output rows (the reference's analog:
+weights_conversion/utils/permute_qkv.py — historically the #1 source of
+silent logit mismatch, hence the dedicated module + tests).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def interleave_perm(head_dim: int) -> np.ndarray:
+    """index map: interleaved_row[j] = hf_row[perm[j]]."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def hf_rows_to_interleaved(w: np.ndarray, head_dim: int) -> np.ndarray:
+    """Permute per-head output rows of an HF [heads*d, in] projection so the
+    interleaved-RoPE model computes identical rotations."""
+    out_dim, in_dim = w.shape
+    heads = out_dim // head_dim
+    perm = interleave_perm(head_dim)
+    return w.reshape(heads, head_dim, in_dim)[:, perm, :].reshape(out_dim, in_dim)
+
+
+def interleaved_rows_to_hf(w: np.ndarray, head_dim: int) -> np.ndarray:
+    out_dim, in_dim = w.shape
+    heads = out_dim // head_dim
+    inv = np.argsort(interleave_perm(head_dim))
+    return w.reshape(heads, head_dim, in_dim)[:, inv, :].reshape(out_dim, in_dim)
