@@ -14,6 +14,13 @@ os.environ["XLA_FLAGS"] = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
+# The axon sitecustomize registers its PJRT plugin at interpreter startup
+# (before conftest runs), which wins over the env var — pin the platform via
+# jax.config too, which takes effect as long as no backend is initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
