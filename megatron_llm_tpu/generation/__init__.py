@@ -1,1 +1,17 @@
+"""Text generation — megatron/text_generation analog."""
 
+from megatron_llm_tpu.generation.api import InferenceEngine
+from megatron_llm_tpu.generation.generation import (
+    beam_search,
+    generate_tokens,
+    score_tokens,
+)
+from megatron_llm_tpu.generation.sampling import sample
+
+__all__ = [
+    "InferenceEngine",
+    "beam_search",
+    "generate_tokens",
+    "score_tokens",
+    "sample",
+]

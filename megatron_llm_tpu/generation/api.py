@@ -1,0 +1,177 @@
+"""Inference API — megatron/text_generation/api.py analog.
+
+``InferenceEngine`` bundles (cfg, params, tokenizer) — the state the
+reference keeps in process-globals — and exposes the same surface:
+``generate_and_post_process`` (api.py:19-68) and
+``beam_search_and_post_process`` (api.py:152-178).  No parameter broadcasts
+(api.py:93-117): SPMD means one controller process.
+
+Compile-cache policy: prompt batches are padded UP to a BUCKET multiple and
+the prefill is bucketed DOWN, so a server sees a handful of compilations,
+then reuses them for any prompt mix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from megatron_llm_tpu.generation import generation as gen
+from megatron_llm_tpu.generation.tokenization import (
+    detokenize_generations,
+    tokenize_prompts_and_batch,
+)
+
+
+def _bucket_down(n: int, bucket: int = gen.BUCKET) -> int:
+    return max(1, (n // bucket) * bucket)
+
+
+class InferenceEngine:
+    """Holds a model + tokenizer and serves generation requests."""
+
+    def __init__(self, cfg, params, tokenizer):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+
+    def _check_limits(self, batch_size: int, samples_length: int) -> None:
+        """Request-size guards (generation.py:133-138): position range and
+        total-token budget."""
+        max_pos = self.cfg.model.max_position_embeddings
+        if samples_length > max_pos:
+            raise ValueError(
+                "Length of prompt + tokens_to_generate longer than allowed")
+        budget = self.cfg.inference.max_tokens_to_oom
+        if samples_length * batch_size > budget:
+            raise ValueError(
+                f"Too many tokens.  {samples_length * batch_size} is greater "
+                f"than {budget}")
+
+    # -- generate ----------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        tokens_to_generate: int = 0,
+        return_output_log_probs: bool = False,
+        top_k_sampling: int = 0,
+        top_p_sampling: float = 0.0,
+        temperature: float = 1.0,
+        add_BOS: bool = False,
+        use_eod_token_for_early_termination: bool = True,
+        stop_on_double_eol: bool = False,
+        stop_on_eol: bool = False,
+        random_seed: int = -1,
+    ):
+        """api.generate analog (api.py:70-151): returns (tokens [b, S] np,
+        lengths [b] np, output_log_probs [b, S-1] np or None)."""
+        tok = self.tokenizer
+        tokens, lengths, samples_length = tokenize_prompts_and_batch(
+            tok, prompts, tokens_to_generate, add_BOS,
+            pad_to_multiple=gen.BUCKET,
+        )
+        self._check_limits(len(prompts), samples_length)
+
+        if tokens_to_generate == 0:
+            # scoring mode (api.py:129-131): teacher-forced log-probs.
+            # Score on the bucket-padded batch (stable compile cache) and
+            # slice the result back to the true length.
+            log_probs = np.asarray(gen.score_tokens(self.cfg, self.params, tokens))
+            return (tokens[:, :samples_length], lengths,
+                    log_probs[:, : samples_length - 1])
+
+        termination_id = getattr(self.cfg.model, "eos_id", None) or tok.eod
+        prefill_len = min(_bucket_down(int(lengths.min())), tokens.shape[1] - 1)
+        if random_seed == -1:
+            # unseeded request: fresh entropy per call (the reference leaves
+            # the torch RNG stream running, api.py:119-120)
+            import os
+
+            random_seed = int.from_bytes(os.urandom(4), "little")
+        key = jax.random.PRNGKey(random_seed)
+        result = gen.generate_tokens(
+            self.cfg, self.params, tokens, lengths, samples_length,
+            prefill_len=prefill_len, termination_id=termination_id,
+            sample_key=key, top_k=top_k_sampling, top_p=top_p_sampling,
+            temperature=temperature,
+            use_eod_for_termination=use_eod_token_for_early_termination,
+            stop_on_double_eol=stop_on_double_eol, stop_on_eol=stop_on_eol,
+        )
+        out_tokens = np.asarray(result.tokens)[:, :samples_length]
+        out_lengths = np.asarray(result.lengths)
+        out_log_probs = (
+            np.asarray(result.output_log_probs)[:, : samples_length - 1]
+            if return_output_log_probs else None
+        )
+        return out_tokens, out_lengths, out_log_probs
+
+    def generate_and_post_process(
+        self,
+        prompts: Sequence[str],
+        tokens_to_generate: int = 0,
+        return_output_log_probs: bool = False,
+        top_k_sampling: int = 0,
+        top_p_sampling: float = 0.0,
+        temperature: float = 1.0,
+        add_BOS: bool = False,
+        use_eod_token_for_early_termination: bool = True,
+        stop_on_double_eol: bool = False,
+        stop_on_eol: bool = False,
+        random_seed: int = -1,
+    ):
+        """api.generate_and_post_process analog (api.py:19-68): returns
+        (prompts_plus_generations, segments, output_log_probs, tokens)."""
+        tokens, lengths, log_probs = self.generate(
+            prompts, tokens_to_generate,
+            return_output_log_probs=return_output_log_probs or tokens_to_generate == 0,
+            top_k_sampling=top_k_sampling, top_p_sampling=top_p_sampling,
+            temperature=temperature, add_BOS=add_BOS,
+            use_eod_token_for_early_termination=use_eod_token_for_early_termination,
+            stop_on_double_eol=stop_on_double_eol, stop_on_eol=stop_on_eol,
+            random_seed=random_seed,
+        )
+        tokens, texts, segments = detokenize_generations(
+            self.tokenizer, tokens, lengths, True)
+        if return_output_log_probs and log_probs is not None:
+            log_probs = [
+                list(map(float, row[: len(seg) - 1]))
+                for row, seg in zip(log_probs, segments)
+            ]
+        else:
+            log_probs = None
+        return texts, segments, log_probs, tokens
+
+    # -- beam search -------------------------------------------------------
+
+    def beam_search_and_post_process(
+        self,
+        prompts: Sequence[str],
+        tokens_to_generate: int = 0,
+        beam_size: int = 0,
+        add_BOS: bool = False,
+        stop_token: Optional[int] = None,
+        num_return_gen: int = 1,
+        length_penalty: float = 1.0,
+    ):
+        """api.beam_search_and_post_process analog (api.py:152-201)."""
+        tok = self.tokenizer
+        stop_token = tok.eod if stop_token is None else stop_token
+        tokens, lengths, samples_length = tokenize_prompts_and_batch(
+            tok, prompts, tokens_to_generate, add_BOS,
+            pad_to_multiple=gen.BUCKET,
+        )
+        self._check_limits(1, samples_length)
+        out_tokens, scores = gen.beam_search(
+            self.cfg, self.params, tokens[:1], int(lengths[0]),
+            beam_size=beam_size, stop_token=stop_token,
+            num_return_gen=num_return_gen, length_penalty=length_penalty,
+            samples_length=samples_length,
+        )
+        out_tokens = np.asarray(out_tokens)[:, :samples_length]
+        out_lengths = np.full((out_tokens.shape[0],), samples_length, np.int64)
+        _, texts, segments = detokenize_generations(
+            tok, out_tokens, out_lengths, True)
+        return texts, segments, [float(s) for s in np.asarray(scores)]

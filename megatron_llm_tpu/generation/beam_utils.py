@@ -1,0 +1,39 @@
+"""Beam hypothesis container — megatron/text_generation/beam_utils.py analog
+(BeamHypotheses:19-64, itself from HuggingFace). Host-side bookkeeping; holds
+numpy token arrays."""
+
+from __future__ import annotations
+
+
+class BeamHypotheses:
+    def __init__(self, num_beams: int, length_penalty: float = 1.0,
+                 early_stopping: bool = False):
+        self.length_penalty = length_penalty
+        self.early_stopping = early_stopping
+        self.num_beams = num_beams
+        self.beams = []  # list of (score, tokens)
+        self.worst_score = 1e9
+
+    def __len__(self) -> int:
+        return len(self.beams)
+
+    def add(self, hyp, sum_logprobs: float, length: int) -> None:
+        score = sum_logprobs / length ** self.length_penalty
+        if len(self) < self.num_beams or score > self.worst_score:
+            self.beams.append((score, hyp))
+            if len(self) > self.num_beams:
+                sorted_scores = sorted(
+                    (s, idx) for idx, (s, _) in enumerate(self.beams)
+                )
+                del self.beams[sorted_scores[0][1]]
+                self.worst_score = sorted_scores[1][0]
+            else:
+                self.worst_score = min(score, self.worst_score)
+
+    def is_done(self, best_sum_logprobs: float, cur_len: int) -> bool:
+        """No remaining open beam can beat the worst kept hypothesis."""
+        if len(self) < self.num_beams:
+            return False
+        if self.early_stopping:
+            return True
+        return self.worst_score >= best_sum_logprobs / cur_len ** self.length_penalty

@@ -1,0 +1,80 @@
+"""Sampling utilities — functional JAX analog of
+megatron/text_generation/sampling.py (sample:45, top-k filter:14, top-p
+filter:22).
+
+All functions are pure and jit-safe with *static* top_k/top_p/temperature
+(the jit cache is keyed per sampling config; a config change recompiles
+once, which matches how a generation server runs in practice).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def modify_logits_for_top_k_filtering(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep only the top-k logits, set the rest to -inf (sampling.py:14-18)."""
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def modify_logits_for_top_p_filtering(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering (sampling.py:22-41), including the reference's
+    shift-by-one so the first token crossing the threshold is kept."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    cum_probs = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    filter_sorted = cum_probs > top_p
+    # shift right: token at the boundary stays selectable
+    filter_sorted = jnp.concatenate(
+        [jnp.zeros_like(filter_sorted[..., :1]), filter_sorted[..., :-1]], axis=-1
+    )
+    # un-sort the filter back to vocab order
+    inv = jnp.argsort(sorted_idx, axis=-1)
+    filter_ = jnp.take_along_axis(filter_sorted, inv, axis=-1)
+    return jnp.where(filter_, NEG_INF, logits)
+
+
+def sample(
+    key: Optional[jax.Array],
+    logits: jax.Array,  # [b, v]
+    *,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    vocab_size: Optional[int] = None,
+) -> jax.Array:
+    """Sample one token per row (sampling.py:45-95). ``top_k == 1`` is greedy;
+    top-k and top-p are mutually exclusive.
+
+    ``vocab_size`` masks the vocab-padding region to -inf before selection.
+    (The reference instead CLAMPS the sample into [0, vocab) after selection,
+    sampling.py:90-93 — which can spuriously emit token vocab-1 whenever a
+    padding logit wins; masking picks the best *valid* token instead.)"""
+    assert logits.ndim == 2, "expected [b, v] logits"
+    if vocab_size and vocab_size < logits.shape[-1]:
+        logits = jnp.where(
+            jnp.arange(logits.shape[-1])[None, :] >= vocab_size, NEG_INF, logits
+        )
+    if top_k == 1:
+        assert top_p == 0.0, "cannot set both greedy and top-p sampling"
+        samples = jnp.argmax(logits, axis=-1)
+    else:
+        logits = logits.astype(jnp.float32)
+        if temperature != 1.0:
+            logits = logits / temperature
+        if top_k > 1:
+            assert top_p == 0.0, "cannot set both top-k and top-p sampling"
+            assert top_k <= logits.shape[-1], "top-k larger than logit size"
+            logits = modify_logits_for_top_k_filtering(logits, top_k)
+        elif top_p > 0.0:
+            assert top_p <= 1.0, "top-p should be in (0, 1]"
+            logits = modify_logits_for_top_p_filtering(logits, top_p)
+        assert key is not None, "non-greedy sampling needs a PRNG key"
+        samples = jax.random.categorical(key, logits, axis=-1)
+    return samples.astype(jnp.int32)

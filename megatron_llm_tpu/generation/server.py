@@ -1,0 +1,219 @@
+"""REST text-generation server — megatron/text_generation_server.py analog.
+
+Same wire contract (PUT /api, identical request fields/validation messages,
+``{"text", "segments", "logprobs"}`` / ``{"text", "segments", "scores"}``
+responses, GET / serves the static UI).  Differences by design:
+
+* stdlib ``http.server`` instead of Flask (not baked into the TPU image).
+* No ``send_do_generate``/``send_do_beam_search`` rank broadcasts
+  (text_generation_server.py:21-27): SPMD has one controller process, so
+  the server just calls the engine.
+* The request lock is kept (:14, :181): generation programs are
+  single-stream on the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+_STATIC_DIR = Path(__file__).parent / "static"
+
+
+def _validate(payload: dict):
+    """Field validation with the reference's messages
+    (text_generation_server.py:31-178). Returns (params dict, error str)."""
+    if "prompts" not in payload:
+        return None, "prompts argument required"
+    if "max_len" in payload:
+        return None, "max_len is no longer used.  Replace with tokens_to_generate"
+    if "sentences" in payload:
+        return None, "sentences is no longer used.  Replace with prompts"
+    prompts = payload["prompts"]
+    if not isinstance(prompts, list):
+        return None, "prompts is not a list of strings"
+    if len(prompts) == 0:
+        return None, "prompts is empty"
+    if len(prompts) > 128:
+        return None, "Maximum number of prompts is 128"
+
+    p = {"prompts": prompts}
+
+    tokens_to_generate = payload.get("tokens_to_generate", 64)
+    if not isinstance(tokens_to_generate, int) or tokens_to_generate < 0:
+        return None, "tokens_to_generate must be an integer greater than or equal to 0"
+    p["tokens_to_generate"] = tokens_to_generate
+
+    logprobs = payload.get("logprobs", False)
+    if not isinstance(logprobs, bool):
+        return None, "logprobs must be a boolean value"
+    if tokens_to_generate == 0 and not logprobs:
+        return None, "tokens_to_generate=0 implies logprobs should be True"
+    p["logprobs"] = logprobs
+
+    temperature = payload.get("temperature", 1.0)
+    if not isinstance(temperature, (int, float)) or not 0.0 < temperature <= 100.0:
+        return None, "temperature must be a positive number less than or equal to 100.0"
+    p["temperature"] = float(temperature)
+
+    top_k = payload.get("top_k", 0)
+    if not isinstance(top_k, int) or not 0 <= top_k <= 1000:
+        return None, ("top_k must be equal to or greater than 0 and less "
+                      "than or equal to 1000")
+    p["top_k"] = top_k
+
+    top_p = payload.get("top_p", 0.0)
+    if isinstance(top_p, int):
+        top_p = float(top_p)
+    if not isinstance(top_p, float) or not 0 <= top_p <= 1.0:
+        return None, "top_p must be less than or equal to 1.0"
+    if top_p > 0.0 and top_k > 0:
+        return None, "cannot set both top-k and top-p samplings."
+    p["top_p"] = top_p
+
+    add_BOS = payload.get("add_BOS", False)
+    if not isinstance(add_BOS, bool):
+        return None, "add_BOS must be a boolean value"
+    if any(len(prompt) == 0 for prompt in prompts) and not add_BOS:
+        return None, "Empty prompts require add_BOS=true"
+    p["add_BOS"] = add_BOS
+
+    for flag in ("stop_on_double_eol", "stop_on_eol", "no_log"):
+        val = payload.get(flag, False)
+        if not isinstance(val, bool):
+            return None, f"{flag} must be a boolean value"
+        p[flag] = val
+
+    random_seed = payload.get("random_seed", -1)
+    if not isinstance(random_seed, int):
+        return None, "random_seed must be integer"
+    if random_seed < -1:
+        return None, "random_seed must be a positive integer"
+    p["random_seed"] = random_seed
+
+    beam_width = payload.get("beam_width")
+    if beam_width is not None:
+        if not isinstance(beam_width, int) or beam_width < 1:
+            return None, "beam_width must be an integer > 1"
+        if len(prompts) > 1:
+            return None, "When doing beam_search, batch size must be 1"
+    p["beam_width"] = beam_width
+
+    stop_token = payload.get("stop_token", 50256)
+    if not isinstance(stop_token, int):
+        return None, "stop_token must be an integer"
+    p["stop_token"] = stop_token
+
+    length_penalty = payload.get("length_penalty", 1.0)
+    if isinstance(length_penalty, int):
+        length_penalty = float(length_penalty)
+    if not isinstance(length_penalty, float):
+        return None, "length_penalty must be a float"
+    p["length_penalty"] = length_penalty
+    return p, None
+
+
+class MegatronServer:
+    """text_generation_server.MegatronServer analog (:234-241)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def handle_request(self, payload: dict):
+        """Core PUT /api logic; returns (status_code, response dict-or-str)."""
+        params, err = _validate(payload)
+        if err:
+            return 400, err
+        with self.lock:
+            try:
+                if params["beam_width"] is not None:
+                    texts, segments, scores = self.engine.beam_search_and_post_process(
+                        params["prompts"],
+                        tokens_to_generate=params["tokens_to_generate"],
+                        beam_size=params["beam_width"],
+                        add_BOS=params["add_BOS"],
+                        stop_token=params["stop_token"],
+                        num_return_gen=params["beam_width"],
+                        length_penalty=params["length_penalty"],
+                    )
+                    return 200, {"text": texts, "segments": segments,
+                                 "scores": scores}
+                texts, segments, logprobs, _ = self.engine.generate_and_post_process(
+                    params["prompts"],
+                    tokens_to_generate=params["tokens_to_generate"],
+                    return_output_log_probs=params["logprobs"],
+                    top_k_sampling=params["top_k"],
+                    top_p_sampling=params["top_p"],
+                    temperature=params["temperature"],
+                    add_BOS=params["add_BOS"],
+                    stop_on_double_eol=params["stop_on_double_eol"],
+                    stop_on_eol=params["stop_on_eol"],
+                    random_seed=params["random_seed"],
+                )
+                return 200, {"text": texts, "segments": segments,
+                             "logprobs": logprobs}
+            except (ValueError, AssertionError) as ve:
+                return 400, str(ve.args[0] if ve.args else ve)
+            except Exception as e:  # engine failure must still answer the client
+                import traceback
+
+                traceback.print_exc()
+                return 500, f"internal error: {type(e).__name__}: {e}"
+
+    def _make_handler(server):  # noqa: N805 — `server` is the enclosing object
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body, content_type="application/json"):
+                data = (json.dumps(body) if content_type == "application/json"
+                        else body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PUT(self):
+                if self.path.rstrip("/") != "/api":
+                    return self._send(404, "not found", "text/plain")
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._send(400, "invalid JSON", "text/plain")
+                code, body = server.handle_request(payload)
+                if isinstance(body, str):
+                    return self._send(code, body, "text/plain")
+                return self._send(code, body)
+
+            do_POST = do_PUT  # convenience; reference is PUT-only
+
+            def do_GET(self):
+                index = _STATIC_DIR / "index.html"
+                if self.path in ("/", "/index.html") and index.exists():
+                    return self._send(200, index.read_text(), "text/html")
+                return self._send(404, "not found", "text/plain")
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+        return Handler
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000):
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.serve_forever()
+
+    def start_background(self, host: str = "127.0.0.1", port: int = 5000):
+        """Run in a daemon thread (used by tests); returns the bound port."""
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -1,0 +1,77 @@
+"""Prompt tokenization / generation detokenization —
+megatron/text_generation/tokenization.py analog.
+
+No broadcast plumbing: under SPMD a single host process feeds the program,
+so the reference's rank-0 tokenize + broadcast (tokenization.py:47-79) is
+just a function call.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def tokenize_prompts_and_batch(
+    tokenizer,
+    prompts: Sequence[str],
+    tokens_to_generate: int,
+    add_BOS: bool = False,
+    pad_to_multiple: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Tokenize, right-pad with eod to max(prompt)+tokens_to_generate
+    (tokenization.py:84-119). ``pad_to_multiple`` rounds the padded length up
+    to a bucket multiple so jit programs are reused across prompt lengths."""
+    if add_BOS:
+        bos = getattr(tokenizer, "bos_token_id", None)
+        if bos is None:
+            bos = getattr(tokenizer, "bos", None)
+        if bos is None:
+            bos = tokenizer.eod  # reference behavior: BOS falls back to eod
+        prompts_tokens = [[bos] + tokenizer.tokenize(p) for p in prompts]
+    else:
+        prompts_tokens = [tokenizer.tokenize(p) for p in prompts]
+
+    lengths = [len(t) for t in prompts_tokens]
+    samples_length = max(lengths) + tokens_to_generate
+    padded_length = samples_length
+    if pad_to_multiple:
+        padded_length = -(-padded_length // pad_to_multiple) * pad_to_multiple
+    tokens = np.full((len(prompts), padded_length), tokenizer.eod, np.int32)
+    for row, t in enumerate(prompts_tokens):
+        tokens[row, : len(t)] = t
+    return tokens, np.asarray(lengths, np.int32), samples_length
+
+
+def detokenize_generations(
+    tokenizer,
+    tokens,     # [b, S] array-like
+    lengths,    # [b]
+    return_segments: bool,
+):
+    """Detokenize (tokenization.py:13-44). Segments are per-token text pieces;
+    we use the tokenizer's id->token mapping when available (HF fast
+    tokenizers) and fall back to one-id detokenize."""
+    tokens = np.asarray(tokens).tolist()
+    lengths = np.asarray(lengths).tolist()
+
+    prompts_plus_generations: List[str] = []
+    segments: List[List[str]] = []
+    for sequence_tokens, length in zip(tokens, lengths):
+        sequence_tokens = sequence_tokens[: int(length)]
+        prompts_plus_generations.append(tokenizer.detokenize(sequence_tokens))
+        if return_segments:
+            hf = getattr(tokenizer, "tokenizer", None)
+            if hf is not None and hasattr(hf, "convert_ids_to_tokens"):
+                words = [
+                    hf.convert_tokens_to_string([piece])
+                    for piece in hf.convert_ids_to_tokens(sequence_tokens)
+                ]
+            else:
+                words = [tokenizer.detokenize([t]) for t in sequence_tokens]
+            segments.append(words)
+
+    if return_segments:
+        return tokens, prompts_plus_generations, segments
+    return tokens, prompts_plus_generations

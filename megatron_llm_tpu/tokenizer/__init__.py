@@ -1,1 +1,8 @@
+"""Tokenizers — megatron/tokenizer analog."""
 
+from megatron_llm_tpu.tokenizer.tokenizer import (
+    AbstractTokenizer,
+    build_tokenizer,
+)
+
+__all__ = ["AbstractTokenizer", "build_tokenizer"]

@@ -1,0 +1,101 @@
+"""REST server contract tests (reference analog: none — the reference server
+is untested; we gate on the documented wire contract of
+text_generation_server.py: PUT /api validation messages and response keys)."""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu.generation import InferenceEngine
+from megatron_llm_tpu.generation.server import MegatronServer, _validate
+from megatron_llm_tpu.models import init_model_params, make_config
+
+from tests.test_generation import VOCAB, ToyTokenizer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=128,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False,
+    )
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    engine = InferenceEngine(cfg, params, ToyTokenizer())
+    srv = MegatronServer(engine)
+    port = srv.start_background(port=0)  # ephemeral port
+    yield f"http://127.0.0.1:{port}"
+    srv.stop()
+
+
+def _put(url, payload):
+    req = urllib.request.Request(
+        url + "/api", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT",
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_validation_messages():
+    assert _validate({})[1] == "prompts argument required"
+    assert _validate({"prompts": "x"})[1] == "prompts is not a list of strings"
+    assert _validate({"prompts": []})[1] == "prompts is empty"
+    assert _validate({"prompts": ["a"], "max_len": 3})[1].startswith(
+        "max_len is no longer used")
+    assert _validate({"prompts": ["a"], "tokens_to_generate": 0})[1] == \
+        "tokens_to_generate=0 implies logprobs should be True"
+    assert _validate({"prompts": ["a"], "top_k": 3, "top_p": 0.5})[1] == \
+        "cannot set both top-k and top-p samplings."
+    assert _validate({"prompts": ["a", "b"], "beam_width": 2})[1] == \
+        "When doing beam_search, batch size must be 1"
+    params, err = _validate({"prompts": ["a"], "tokens_to_generate": 8})
+    assert err is None and params["tokens_to_generate"] == 8
+
+
+def test_server_generate_roundtrip(server):
+    status, body = _put(server, {
+        "prompts": ["hello"], "tokens_to_generate": 4, "top_k": 1,
+        "logprobs": True,
+    })
+    assert status == 200
+    assert set(body) == {"text", "segments", "logprobs"}
+    assert len(body["text"]) == 1 and isinstance(body["text"][0], str)
+    assert len(body["logprobs"][0]) == len(body["segments"][0]) - 1
+
+
+def test_server_beam_roundtrip(server):
+    status, body = _put(server, {
+        "prompts": ["hello"], "tokens_to_generate": 4, "beam_width": 2,
+        "stop_token": VOCAB + 9,
+    })
+    assert status == 200
+    assert set(body) == {"text", "segments", "scores"}
+    assert len(body["text"]) == 2
+
+
+def test_server_rejects_bad_request(server):
+    status, body = _put(server, {"prompts": []})
+    assert status == 400
+
+
+def test_server_rejects_overlong_request(server):
+    """prompt + tokens_to_generate > max_position_embeddings -> 400 with the
+    reference's message (generation.py:133-135)."""
+    status, body = _put(server, {
+        "prompts": ["hello"], "tokens_to_generate": 100000})
+    assert status == 400
+    assert "longer than allowed" in body
+
+
+def test_server_serves_ui(server):
+    with urllib.request.urlopen(server + "/") as resp:
+        assert resp.status == 200
+        assert b"Generate" in resp.read()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Launch the REST text-generation server —
+tools/run_text_generation_server.py analog (:24-90).
+
+Loads a model from a checkpoint (or random-inits a tiny one with
+``--random_init`` for smoke testing), builds the InferenceEngine, and
+serves PUT /api.  Single process: no torchrun, no rank loop (ranks >0 in
+the reference spin on broadcast — SPMD needs none of that).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model_name", default="llama2")
+    ap.add_argument("--load", help="checkpoint directory to serve")
+    ap.add_argument("--tokenizer_type", default="HFTokenizer")
+    ap.add_argument("--tokenizer_model", help="tokenizer name/path")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--random_init", action="store_true",
+                    help="serve a random tiny model (smoke test)")
+    args, extra = ap.parse_known_args()
+
+    import jax
+
+    from megatron_llm_tpu.config.arguments import parse_args
+    from megatron_llm_tpu.generation import InferenceEngine
+    from megatron_llm_tpu.generation.server import MegatronServer
+    from megatron_llm_tpu.models import init_model_params
+    from megatron_llm_tpu.tokenizer import build_tokenizer
+
+    cfg = parse_args(
+        ["--model_name", args.model_name] + extra
+        + (["--tokenizer_type", args.tokenizer_type] if args.tokenizer_type else [])
+        + (["--tokenizer_model", args.tokenizer_model] if args.tokenizer_model else [])
+    )
+    tokenizer = build_tokenizer(cfg)
+    if cfg.model.vocab_size is None:
+        cfg.model.vocab_size = tokenizer.vocab_size
+
+    key = jax.random.PRNGKey(cfg.training.seed)
+    if args.random_init:
+        params = init_model_params(cfg, key)
+    else:
+        if not args.load:
+            ap.error("--load is required unless --random_init")
+        from megatron_llm_tpu.checkpointing import load_checkpoint
+
+        template = jax.eval_shape(
+            lambda k: init_model_params(cfg, k), key)
+        params, _, _, _, _ = load_checkpoint(cfg, args.load, template)
+
+    engine = InferenceEngine(cfg, params, tokenizer)
+    server = MegatronServer(engine)
+    print(f"serving on http://{args.host}:{args.port}/api", flush=True)
+    server.run(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""REST client for the generation server — tools/text_generation_cli.py
+analog: read prompts from stdin, PUT them to <url>/api, print the text."""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def put(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url.rstrip("/") + "/api",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="PUT",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print("usage: text_generation_cli.py http://host:port", file=sys.stderr)
+        sys.exit(1)
+    url = sys.argv[1]
+    while True:
+        try:
+            sys.stdout.write("Enter prompt: ")
+            sys.stdout.flush()
+            prompt = input()
+        except EOFError:
+            break
+        data = put(url, {"prompts": [prompt], "tokens_to_generate": 64})
+        print("Megatron Response: ")
+        print(data["text"][0])
