@@ -218,6 +218,7 @@ class DataConfig:
     data_type: str = "gpt"  # 'gpt' | 'instruction'
     variable_seq_lengths: bool = False
     scalar_loss_mask: float = 0.0
+    loss_role: str = "assistant"  # 'assistant' | 'user' | 'all'
 
 
 @dataclass

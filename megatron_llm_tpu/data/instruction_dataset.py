@@ -1,0 +1,243 @@
+"""Instruction / chat SFT dataset over paired ``-text`` / ``-role`` indexed datasets.
+
+Reference: megatron/data/instruction_dataset.py (Role enum :20-24,
+InstructionDataset :27-52, split/sample logic :153-315, collator :377-475).
+
+Behavioral contract reproduced here:
+
+* a sample is two aligned token streams stored under ``{prefix}-text`` and
+  ``{prefix}-role`` (produced by ``tools/preprocess_instruct_data.py``); the
+  role stream tags every token with the speaker (system/user/assistant) or the
+  ``PACK_SEP`` sentinel that separates conversations packed into one sample.
+* sampling is per-epoch permutation of the (split-restricted) document ids,
+  concatenated until ``num_samples`` is reached (reference ``_sample_dataset``
+  :153-169) — there is no token-offset index like the GPT dataset.
+* the collator pads to ``seq_length + 1``, builds the loss mask from the role
+  stream (loss on ``loss_role`` tokens only, padding always masked), and shifts
+  left-to-right, so ``loss_mask[t]`` gates the prediction made *from* input
+  token ``t`` (reference collator :444-467 semantics, quirks included).
+
+TPU-first difference: instead of materializing the reference's
+``[b, 1, s, s]`` boolean attention mask (:323-375), packed-example structure is
+expressed as per-token **segment ids** which ``ops/attention.py`` consumes
+directly (block-diagonal gating ``seg_q == seg_kv`` composed with the causal
+flag inside the flash kernel) — O(s) host work instead of O(s²).
+Padding positions get segment id ``-1`` so no real token attends to them.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+from megatron_llm_tpu.data.gpt_dataset import (
+    get_train_valid_test_split_,
+    _normalize_blend,
+)
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset
+
+
+class Role(enum.IntEnum):
+    """Reference instruction_dataset.py:20-24."""
+
+    system = 0
+    user = 1
+    assistant = 2
+    PACK_SEP = 1000  # separates two conversations packed into one sample
+
+
+class InstructionDataset:
+    """Map-style dataset returning aligned ``{"text", "role"}`` int64 arrays."""
+
+    def __init__(self, name: str, sample_indices: np.ndarray,
+                 indexed_text, indexed_role, seq_length: int):
+        assert len(indexed_text) == len(indexed_role)
+        if sample_indices.size:
+            assert sample_indices.min() >= 0
+            assert sample_indices.max() < len(indexed_text)
+        self.name = name
+        self.sample_indices = sample_indices
+        self.indexed_text = indexed_text
+        self.indexed_role = indexed_role
+        self.seq_length = seq_length
+
+    def __len__(self) -> int:
+        return int(self.sample_indices.shape[0])
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        doc = int(self.sample_indices[idx])
+        text = np.asarray(self.indexed_text[doc], dtype=np.int64)
+        role = np.asarray(self.indexed_role[doc], dtype=np.int64)
+        assert text.shape == role.shape
+        return {"text": text, "role": role}
+
+
+def get_indexed_datasets_(data_prefix: str, data_impl: str = "mmap",
+                          skip_warmup: bool = True):
+    """Open the paired ``-text`` / ``-role`` indexed datasets (reference :136-150)."""
+    del data_impl, skip_warmup  # mmap is the only on-disk format we ship
+    indexed_text = MMapIndexedDataset(f"{data_prefix}-text")
+    indexed_role = MMapIndexedDataset(f"{data_prefix}-role")
+    return indexed_text, indexed_role
+
+
+def _sample_dataset(np_rng: np.random.RandomState, document_indices: np.ndarray,
+                    indexed_text, indexed_role, name: str,
+                    num_samples: int, seq_length: int) -> InstructionDataset:
+    """Epoch-permutation sampling (reference ``_sample_dataset`` :153-169)."""
+    assert num_samples > 0
+    remaining, chunks = num_samples, []
+    while remaining > 0:
+        count = min(remaining, len(document_indices))
+        chunks.append(np_rng.permutation(document_indices)[:count])
+        remaining -= count
+    return InstructionDataset(name, np.concatenate(chunks), indexed_text,
+                              indexed_role, seq_length)
+
+
+def _build_split_datasets(prefix: str, splits_string: str,
+                          nums: Sequence[int], seq_length: int, seed: int):
+    """One prefix → (train, valid, test) via permuted-document split (:172-204)."""
+    indexed_text, indexed_role = get_indexed_datasets_(prefix)
+    total = len(indexed_text)
+    splits = get_train_valid_test_split_(splits_string, total)
+    np_rng = np.random.RandomState(seed=seed)
+    document_indices = np_rng.permutation(total)
+    out = []
+    for i, name in enumerate(("train", "valid", "test")):
+        begin, end = splits[i], splits[i + 1]
+        if end <= begin or nums[i] <= 0:
+            out.append(None)
+        else:
+            out.append(_sample_dataset(np_rng, document_indices[begin:end],
+                                       indexed_text, indexed_role, name,
+                                       int(nums[i]), seq_length))
+    return tuple(out)
+
+
+def build_train_valid_test_datasets(
+    data_prefix: Sequence[str],
+    splits_string: str,
+    train_valid_test_num_samples: Sequence[int],
+    seq_length: int,
+    seed: int,
+    train_data_prefix: Sequence[str] = (),
+    valid_data_prefix: Sequence[str] = (),
+    test_data_prefix: Sequence[str] = (),
+    **_unused,
+):
+    """Reference ``build_train_valid_test_datasets`` (:208-315): either one
+    blended corpus split by ``splits_string``, or separate per-split prefixes."""
+    if data_prefix:
+        if len(data_prefix) == 1:
+            return _build_split_datasets(data_prefix[0], splits_string,
+                                         train_valid_test_num_samples,
+                                         seq_length, seed)
+        prefixes, weights, per_ds_nums = _normalize_blend(
+            data_prefix, train_valid_test_num_samples)
+        parts = [
+            _build_split_datasets(p, splits_string, nums, seq_length, seed)
+            for p, nums in zip(prefixes, per_ds_nums)
+        ]
+        out = []
+        for i, n in enumerate(train_valid_test_num_samples):
+            pairs = [(p[i], w) for p, w in zip(parts, weights) if p[i] is not None]
+            if not pairs:
+                out.append(None)
+                continue
+            ds, ws = zip(*pairs)
+            ws = np.asarray(ws) / np.sum(ws)  # renormalize over surviving parts
+            out.append(BlendableDataset(list(ds), ws, int(n)))
+        return tuple(out)
+
+    def one(prefixes, name, n):
+        if not prefixes or n <= 0:
+            return None
+        if len(prefixes) == 1:
+            plist, weights = list(prefixes), np.array([1.0])
+        else:
+            plist, weights, _ = _normalize_blend(prefixes, (n,))
+        parts = []
+        for j, p in enumerate(plist):
+            text, role = get_indexed_datasets_(p)
+            docs = np.arange(len(text), dtype=np.int64)
+            nj = int(np.ceil(n * weights[j] * 1.005)) if len(plist) > 1 else n
+            parts.append(_sample_dataset(np.random.RandomState(seed=seed), docs,
+                                         text, role, name, nj, seq_length))
+        if len(parts) == 1:
+            return parts[0]
+        return BlendableDataset(parts, weights, int(n))
+
+    return (one(train_data_prefix, "train", train_valid_test_num_samples[0]),
+            one(valid_data_prefix, "valid", train_valid_test_num_samples[1]),
+            one(test_data_prefix, "test", train_valid_test_num_samples[2]))
+
+
+def round_to_multiple_of(x: int, y: int) -> int:
+    return ((x + y - 1) // y) * y
+
+
+def instruction_collator(
+    samples: List[Dict[str, np.ndarray]],
+    seq_length: int,
+    pad_id: int,
+    loss_role: str = "assistant",
+    scalar_loss_mask: float = 0.0,
+    variable_seq_lengths: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Vectorized collator reproducing reference ``instruction_collator``
+    (:377-475) semantics, emitting segment ids instead of a dense mask.
+
+    Returns ``{tokens, labels, loss_mask, position_ids, segment_ids}`` each of
+    shape ``[b, seq_length]`` (static unless ``variable_seq_lengths``).
+    """
+    assert loss_role in ("assistant", "user", "all")
+    s = seq_length
+    if variable_seq_lengths:
+        longest = max(len(x["text"]) for x in samples)
+        s = min(seq_length, round_to_multiple_of(longest, 16))
+    s1 = s + 1  # buffer one extra token so the shift yields s positions
+
+    b = len(samples)
+    text = np.full((b, s1), pad_id, dtype=np.int64)
+    role = np.full((b, s1), -1, dtype=np.int64)
+    valid = np.zeros((b, s1), dtype=bool)
+    for i, x in enumerate(samples):
+        n = min(len(x["text"]), s1)
+        text[i, :n] = x["text"][:n]
+        role[i, :n] = x["role"][:n]
+        valid[i, :n] = True
+
+    # loss mask over the full buffer, then shifted (reference :402,444-453):
+    # scalar base, 1.0 on loss-role tokens, 0.0 wherever the token is pad.
+    loss = np.full((b, s1), scalar_loss_mask, dtype=np.float32)
+    if loss_role == "all":
+        loss[:] = 1.0
+    else:
+        loss[role == int(Role[loss_role])] = 1.0
+    loss[text == pad_id] = 0.0
+    loss[~valid] = 0.0
+
+    # example id per token: +1 at each PACK_SEP (the PACK_SEP token opens the
+    # new example, reference :424-433); padding gets sentinel -1.
+    is_sep = role == int(Role.PACK_SEP)
+    seg = np.cumsum(is_sep, axis=1)
+    seg[~valid] = -1
+
+    # position ids reset at each example boundary (reference :363-372: the
+    # PACK_SEP token itself is position 0 of its example).
+    idx = np.arange(s1, dtype=np.int64)[None, :]
+    sep_pos = np.where(is_sep, idx, 0)
+    seg_start = np.maximum.accumulate(sep_pos, axis=1)
+    position_ids = idx - seg_start
+
+    return {
+        "tokens": text[:, :-1].astype(np.int32),
+        "labels": text[:, 1:].astype(np.int32),
+        "loss_mask": loss[:, :-1],
+        "position_ids": position_ids[:, :-1].astype(np.int32),
+        "segment_ids": seg[:, :-1].astype(np.int32),
+    }

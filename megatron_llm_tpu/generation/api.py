@@ -29,6 +29,10 @@ def _bucket_down(n: int, bucket: int = gen.BUCKET) -> int:
     return max(1, (n // bucket) * bucket)
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
 class InferenceEngine:
     """Holds a model + tokenizer and serves generation requests."""
 
@@ -75,13 +79,24 @@ class InferenceEngine:
         )
         self._check_limits(len(prompts), samples_length)
 
+        # pad the batch dim up to a power of two so the decode program is
+        # compiled per size *bucket*, not per request size; padded rows are
+        # copies of row 0 and are sliced off before returning.
+        b = len(prompts)
+        b_pad = _next_pow2(b)
+        if b_pad != b:
+            tokens = np.concatenate(
+                [tokens, np.tile(tokens[:1], (b_pad - b, 1))], axis=0)
+            lengths = np.concatenate(
+                [lengths, np.tile(lengths[:1], b_pad - b)], axis=0)
+
         if tokens_to_generate == 0:
             # scoring mode (api.py:129-131): teacher-forced log-probs.
             # Score on the bucket-padded batch (stable compile cache) and
             # slice the result back to the true length.
             log_probs = np.asarray(gen.score_tokens(self.cfg, self.params, tokens))
-            return (tokens[:, :samples_length], lengths,
-                    log_probs[:, : samples_length - 1])
+            return (tokens[:b, :samples_length], lengths[:b],
+                    log_probs[:b, : samples_length - 1])
 
         termination_id = getattr(self.cfg.model, "eos_id", None) or tok.eod
         prefill_len = min(_bucket_down(int(lengths.min())), tokens.shape[1] - 1)
@@ -100,10 +115,10 @@ class InferenceEngine:
             use_eod_for_termination=use_eod_token_for_early_termination,
             stop_on_double_eol=stop_on_double_eol, stop_on_eol=stop_on_eol,
         )
-        out_tokens = np.asarray(result.tokens)[:, :samples_length]
-        out_lengths = np.asarray(result.lengths)
+        out_tokens = np.asarray(result.tokens)[:b, :samples_length]
+        out_lengths = np.asarray(result.lengths)[:b]
         out_log_probs = (
-            np.asarray(result.output_log_probs)[:, : samples_length - 1]
+            np.asarray(result.output_log_probs)[:b, : samples_length - 1]
             if return_output_log_probs else None
         )
         return out_tokens, out_lengths, out_log_probs
@@ -157,6 +172,8 @@ class InferenceEngine:
         length_penalty: float = 1.0,
     ):
         """api.beam_search_and_post_process analog (api.py:152-201)."""
+        if len(prompts) != 1:
+            raise ValueError("beam search supports exactly one prompt")
         tok = self.tokenizer
         stop_token = tok.eod if stop_token is None else stop_token
         tokens, lengths, samples_length = tokenize_prompts_and_batch(

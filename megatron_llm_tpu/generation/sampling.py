@@ -26,8 +26,8 @@ def modify_logits_for_top_k_filtering(logits: jax.Array, top_k: int) -> jax.Arra
 def modify_logits_for_top_p_filtering(logits: jax.Array, top_p: float) -> jax.Array:
     """Nucleus filtering (sampling.py:22-41), including the reference's
     shift-by-one so the first token crossing the threshold is kept."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     sorted_idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sorted_idx, axis=-1)
     cum_probs = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
     filter_sorted = cum_probs > top_p
     # shift right: token at the boundary stays selectable

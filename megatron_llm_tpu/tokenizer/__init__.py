@@ -1,8 +1,18 @@
 """Tokenizers — megatron/tokenizer analog."""
 
+from types import SimpleNamespace
+
 from megatron_llm_tpu.tokenizer.tokenizer import (
     AbstractTokenizer,
     build_tokenizer,
 )
 
-__all__ = ["AbstractTokenizer", "build_tokenizer"]
+
+def build_tokenizer_flat(args) -> AbstractTokenizer:
+    """Adapter for flat argparse namespaces (the ``tools/preprocess_*`` CLIs),
+    which carry tokenizer flags at top level rather than under ``cfg.data``."""
+    cfg = SimpleNamespace(data=args, model=SimpleNamespace(vocab_size=None))
+    return build_tokenizer(cfg)
+
+
+__all__ = ["AbstractTokenizer", "build_tokenizer", "build_tokenizer_flat"]

@@ -59,21 +59,42 @@ def _approx_param_count(cfg) -> int:
     return per_layer * L + emb
 
 
-def build_gpt_data_iterators(cfg, tokenizer):
-    """Default dataset provider: GPT pretraining over --data_path."""
-    from megatron_llm_tpu.data.gpt_dataset import build_train_valid_test_datasets
-    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
-
+def _train_valid_test_num_samples(cfg):
+    """Sample counts for the three splits (training.py:877-961 math)."""
     t = cfg.training
     gbs = t.global_batch_size
     train_samples = (t.train_samples or (t.train_iters or 0) * gbs)
-    eval_samples = cfg.training.eval_iters * gbs * (
-        1 + (t.train_iters or 0) // max(cfg.training.eval_interval, 1)
+    eval_samples = t.eval_iters * gbs * (
+        1 + (t.train_iters or 0) // max(t.eval_interval, 1)
     )
+    return train_samples, eval_samples, t.eval_iters * gbs
+
+
+def _make_loader_factory(cfg, collate):
+    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
+
+    def loader(ds, consumed):
+        return build_pretraining_data_loader(
+            ds, consumed, cfg.training.global_batch_size,
+            cfg.data.dataloader_type, cfg.training.seed, collate_fn=collate,
+        )
+
+    return loader
+
+
+def build_gpt_data_iterators(cfg, tokenizer):
+    """Default dataset provider: GPT pretraining over --data_path."""
+    from megatron_llm_tpu.data.gpt_dataset import build_train_valid_test_datasets
+
+    if not cfg.data.data_path:
+        raise ValueError(
+            "--data_type gpt requires --data_path (per-split "
+            "--train_data_path is only supported with --data_type instruction)"
+        )
     train_ds, valid_ds, test_ds = build_train_valid_test_datasets(
         cfg.data.data_path,
         cfg.data.split,
-        (train_samples, eval_samples, cfg.training.eval_iters * gbs),
+        _train_valid_test_num_samples(cfg),
         cfg.data.seq_length,
         cfg.training.seed,
         data_impl=cfg.data.data_impl,
@@ -91,13 +112,50 @@ def build_gpt_data_iterators(cfg, tokenizer):
             eod_mask_loss=cfg.data.eod_mask_loss,
         )
 
-    def loader(ds, consumed):
-        return build_pretraining_data_loader(
-            ds, consumed, gbs, cfg.data.dataloader_type, cfg.training.seed,
-            collate_fn=collate,
+    return _make_loader_factory(cfg, collate), (train_ds, valid_ds, test_ds)
+
+
+def build_instruction_data_iterators(cfg, tokenizer):
+    """Instruction-tuning dataset provider (--data_type instruction)."""
+    from megatron_llm_tpu.data.instruction_dataset import (
+        build_train_valid_test_datasets as build_instruct,
+        instruction_collator,
+    )
+
+    train_ds, valid_ds, test_ds = build_instruct(
+        cfg.data.data_path,
+        cfg.data.split,
+        _train_valid_test_num_samples(cfg),
+        cfg.data.seq_length,
+        cfg.training.seed,
+        train_data_prefix=cfg.data.train_data_path,
+        valid_data_prefix=cfg.data.valid_data_path,
+        test_data_prefix=cfg.data.test_data_path,
+    )
+
+    try:
+        pad = tokenizer.pad
+    except (NotImplementedError, AttributeError):
+        pad = getattr(tokenizer, "eod", 0)
+
+    def collate(samples):
+        return instruction_collator(
+            samples,
+            seq_length=cfg.data.seq_length,
+            pad_id=pad,
+            loss_role=cfg.data.loss_role,
+            scalar_loss_mask=cfg.data.scalar_loss_mask,
+            variable_seq_lengths=cfg.data.variable_seq_lengths,
         )
 
-    return loader, (train_ds, valid_ds, test_ds)
+    return _make_loader_factory(cfg, collate), (train_ds, valid_ds, test_ds)
+
+
+def build_data_iterators(cfg, tokenizer):
+    """Dispatch on --data_type (gpt | instruction)."""
+    if cfg.data.data_type == "instruction":
+        return build_instruction_data_iterators(cfg, tokenizer)
+    return build_gpt_data_iterators(cfg, tokenizer)
 
 
 def make_eval_step(cfg):
@@ -218,8 +276,8 @@ def pretrain(
             train_iter, valid_iter_factory = data_iterators_provider(
                 cfg, tokenizer, consumed_samples
             )
-        elif cfg.data.data_path:
-            loader, (train_ds, valid_ds, _)= build_gpt_data_iterators(cfg, tokenizer)
+        elif cfg.data.data_path or cfg.data.train_data_path:
+            loader, (train_ds, valid_ds, _) = build_data_iterators(cfg, tokenizer)
             train_iter = loader(train_ds, consumed_samples)
             valid_iter_factory = (lambda: loader(valid_ds, 0)) if valid_ds else None
         else:

@@ -1,0 +1,46 @@
+"""Merge multiple ``.bin``/``.idx`` indexed datasets into one.
+
+Reference: tools/merge_datasets.py — same CLI: ``--input`` a directory whose
+``*.idx``/``*.bin`` prefix pairs are merged into ``--output_prefix``.
+"""
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.append(str(Path(__file__).parent.parent.absolute()))
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", type=str, required=True,
+                   help="directory containing the .bin/.idx pairs to merge")
+    p.add_argument("--output_prefix", type=str, required=True)
+    args = p.parse_args()
+
+    prefixes = sorted(
+        os.path.join(args.input, f[:-4])
+        for f in os.listdir(args.input)
+        if f.endswith(".idx")
+        and os.path.isfile(os.path.join(args.input, f[:-4] + ".bin"))
+    )
+    if not prefixes:
+        raise SystemExit(f"no .bin/.idx pairs found in {args.input}")
+
+    dtype = MMapIndexedDataset(prefixes[0]).dtype
+    builder = MMapIndexedDatasetBuilder(f"{args.output_prefix}.bin", dtype=dtype)
+    for prefix in prefixes:
+        print(f"merging {prefix}")
+        builder.merge_file_(prefix)
+    builder.finalize(f"{args.output_prefix}.idx")
+    print(f"wrote {args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()

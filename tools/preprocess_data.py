@@ -1,0 +1,147 @@
+"""Preprocess a jsonl corpus into the binary ``.bin``/``.idx`` format.
+
+Reference: tools/preprocess_data.py (Encoder :34-86, main loop :138-208).
+Same CLI surface and on-disk format; the output is directly consumable by
+``megatron_llm_tpu.data.gpt_dataset`` (and by the reference itself — the
+format is unchanged).
+
+Example:
+    python tools/preprocess_data.py --input corpus.jsonl \
+        --output_prefix corpus --tokenizer_type SentencePieceTokenizer \
+        --vocab_file tokenizer.model --workers 8 --chunk_size 32 --append_eod
+"""
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from multiprocessing import Pool
+from pathlib import Path
+
+sys.path.append(str(Path(__file__).parent.parent.absolute()))
+
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDatasetBuilder, best_fitting_dtype
+from megatron_llm_tpu.tokenizer import build_tokenizer_flat as build_tokenizer
+
+
+def try_nltk_splitter(lang: str):
+    try:
+        import nltk
+
+        splitter = nltk.load(f"tokenizers/punkt/{lang}.pickle")
+        return splitter.tokenize
+    except Exception:
+        print("WARNING: nltk sentence splitting unavailable; "
+              "treating each document as one sentence")
+        return lambda text: [text]
+
+
+class Encoder:
+    """Per-worker tokenizer state (reference Encoder:34)."""
+
+    tokenizer = None
+    splitter = None
+
+    def __init__(self, args):
+        self.args = args
+
+    def initializer(self):
+        Encoder.tokenizer = build_tokenizer(self.args)
+        Encoder.splitter = (try_nltk_splitter(self.args.lang)
+                            if self.args.split_sentences else None)
+
+    def encode(self, line):
+        data = json.loads(line)
+        out = {}
+        for key in self.args.json_keys:
+            text = data[key]
+            if Encoder.splitter is not None:
+                sentences = Encoder.splitter(text)
+            else:
+                sentences = [text]
+            doc = [Encoder.tokenizer.tokenize(s) for s in sentences if s]
+            doc = [s for s in doc if len(s) > 0]
+            if doc and self.args.append_eod:
+                doc[-1] = doc[-1] + [Encoder.tokenizer.eod]
+            out[key] = doc
+        return out, len(line)
+
+
+def get_args():
+    p = argparse.ArgumentParser()
+    g = p.add_argument_group("input data")
+    g.add_argument("--input", type=str, nargs="+", required=True)
+    g.add_argument("--json_keys", nargs="+", default=["text"])
+    g.add_argument("--split_sentences", action="store_true")
+    g.add_argument("--lang", type=str, default="english")
+
+    g = p.add_argument_group("tokenizer")
+    g.add_argument("--tokenizer_type", type=str, required=True)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merge_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--vocab_extra_ids", type=int, default=0)
+    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
+    g.add_argument("--no_new_tokens", action="store_true")
+    g.add_argument("--append_eod", action="store_true")
+
+    g = p.add_argument_group("output data")
+    g.add_argument("--output_prefix", type=str, required=True)
+    g.add_argument("--dataset_impl", type=str, default="mmap",
+                   choices=["mmap"])
+
+    g = p.add_argument_group("runtime")
+    g.add_argument("--workers", type=int, default=1)
+    g.add_argument("--chunk_size", type=int, default=32)
+    g.add_argument("--log_interval", type=int, default=100)
+    args = p.parse_args()
+    # --vocab_file is the reference's spelling for the sentencepiece model
+    # path; accept it as an alias for --tokenizer_model.
+    if args.tokenizer_model is None and args.vocab_file is not None:
+        args.tokenizer_model = args.vocab_file
+    args.rank = 0
+    args.make_vocab_size_divisible_by = 128
+    args.tensor_model_parallel_size = 1
+    return args
+
+
+def main():
+    args = get_args()
+    encoder = Encoder(args)
+    tokenizer = build_tokenizer(args)
+    dtype = best_fitting_dtype(tokenizer.vocab_size)
+
+    builders, idx_files = {}, {}
+    for key in args.json_keys:
+        suffix = f"_{key}" if len(args.json_keys) > 1 else ""
+        bin_f = f"{args.output_prefix}{suffix}.bin"
+        idx_files[key] = f"{args.output_prefix}{suffix}.idx"
+        builders[key] = MMapIndexedDatasetBuilder(bin_f, dtype=dtype)
+
+    fs = map(open, args.input)
+    lines = itertools.chain(*fs)
+    start = time.time()
+    total_bytes = 0
+    with Pool(args.workers, initializer=encoder.initializer) as pool:
+        for i, (doc, nbytes) in enumerate(
+                pool.imap(encoder.encode, lines, args.chunk_size), start=1):
+            total_bytes += nbytes
+            for key, sentences in doc.items():
+                if not sentences:
+                    continue
+                for sentence in sentences:
+                    builders[key].add_item(sentence)
+                builders[key].end_document()
+            if i % args.log_interval == 0:
+                elapsed = time.time() - start
+                print(f"processed {i} documents "
+                      f"({i / elapsed:.1f} docs/s, "
+                      f"{total_bytes / 1024 / 1024 / elapsed:.2f} MB/s)")
+    for key in args.json_keys:
+        builders[key].finalize(idx_files[key])
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

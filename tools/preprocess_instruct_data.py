@@ -1,0 +1,154 @@
+"""Preprocess a jsonl chat corpus into paired ``-text``/``-role`` ``.bin``/``.idx``.
+
+Reference: tools/preprocess_instruct_data.py (Encoder :34-62, pack_docs
+:148-196, main :199-250).  Each input line is
+``{"id": ..., "conversations": [{"role": "user", "content": ...}, ...]}``;
+every message is wrapped in the ChatML-style template
+``<|im_start|>{role}\\n{content}<|im_end|>\\n`` and the role stream tags each
+token with its speaker's ``Role`` value.  With ``--do_packing``, documents are
+greedily packed (longest-first) into sequences of at most ``--max_seq_length``
+tokens, joined by a BOS token tagged ``Role.PACK_SEP``.
+"""
+
+import argparse
+import itertools
+import json
+import sys
+import time
+from multiprocessing import Pool
+from pathlib import Path
+
+sys.path.append(str(Path(__file__).parent.parent.absolute()))
+
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDatasetBuilder, best_fitting_dtype
+from megatron_llm_tpu.data.instruction_dataset import Role
+from megatron_llm_tpu.tokenizer import build_tokenizer_flat as build_tokenizer
+
+
+def format_message(message: str, role: str) -> str:
+    return f"<|im_start|>{role}\n{message}<|im_end|>\n"
+
+
+class Encoder:
+    tokenizer = None
+
+    def __init__(self, args):
+        self.args = args
+
+    def initializer(self):
+        Encoder.tokenizer = build_tokenizer(self.args)
+
+    def encode(self, line):
+        data = json.loads(line)
+        tokens, roles = [], []
+        for turn in data["conversations"]:
+            role = turn["role"]
+            ids = Encoder.tokenizer.tokenize(format_message(turn["content"], role))
+            tokens += ids
+            roles += [int(Role[role])] * len(ids)
+        return len(line), tokens, roles
+
+
+def pack_docs(docs, sep_token, max_seq_length):
+    """Greedy packing (reference pack_docs:148-196): append docs while they
+    fit, joining with ``sep_token`` tagged PACK_SEP; oversized docs truncate."""
+    packed = []
+    cur_tokens, cur_roles, cur_size = [], [], 0
+    for size, tokens, roles in docs:
+        if len(cur_tokens) + len(tokens) + (1 if cur_tokens else 0) <= max_seq_length:
+            if cur_tokens:
+                cur_tokens.append(sep_token)
+                cur_roles.append(int(Role.PACK_SEP))
+            cur_tokens += tokens
+            cur_roles += roles
+            cur_size += size
+        elif not cur_tokens:
+            packed.append((size, tokens[:max_seq_length], roles[:max_seq_length]))
+        else:
+            packed.append((cur_size, cur_tokens, cur_roles))
+            cur_tokens, cur_roles, cur_size = list(tokens), list(roles), size
+    if cur_tokens:
+        packed.append((cur_size, cur_tokens, cur_roles))
+    print(f"packed into {len(packed)} documents")
+    return packed
+
+
+def get_args():
+    p = argparse.ArgumentParser()
+    g = p.add_argument_group("input data")
+    g.add_argument("--input", type=str, nargs="+", required=True)
+
+    g = p.add_argument_group("tokenizer")
+    g.add_argument("--tokenizer_type", type=str, required=True)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merge_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--vocab_extra_ids", type=int, default=0)
+    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
+    g.add_argument("--no_new_tokens", action="store_true")
+
+    g = p.add_argument_group("output data")
+    g.add_argument("--output_prefix", type=str, required=True)
+    g.add_argument("--dataset_impl", type=str, default="mmap", choices=["mmap"])
+
+    g = p.add_argument_group("runtime")
+    g.add_argument("--workers", type=int, default=1)
+    g.add_argument("--chunk_size", type=int, default=32)
+    g.add_argument("--log_interval", type=int, default=100)
+    g.add_argument("--do_packing", action="store_true")
+    g.add_argument("--max_seq_length", type=int, default=4096)
+    args = p.parse_args()
+    # --vocab_file is the reference's spelling for the sentencepiece model
+    # path; accept it as an alias for --tokenizer_model.
+    if args.tokenizer_model is None and args.vocab_file is not None:
+        args.tokenizer_model = args.vocab_file
+    args.rank = 0
+    args.make_vocab_size_divisible_by = 128
+    args.tensor_model_parallel_size = 1
+    return args
+
+
+def main():
+    args = get_args()
+    encoder = Encoder(args)
+    tokenizer = build_tokenizer(args)
+    dtype = best_fitting_dtype(tokenizer.vocab_size)
+
+    text_builder = MMapIndexedDatasetBuilder(
+        f"{args.output_prefix}-text.bin", dtype=dtype)
+    role_builder = MMapIndexedDatasetBuilder(
+        f"{args.output_prefix}-role.bin", dtype=best_fitting_dtype(Role.PACK_SEP + 1))
+
+    fs = map(open, args.input)
+    lines = itertools.chain(*fs)
+    start = time.time()
+    total_bytes = 0
+    with Pool(args.workers, initializer=encoder.initializer) as pool:
+        docs = pool.imap(encoder.encode, lines, args.chunk_size)
+        if args.do_packing:
+            print("sorting documents by length for packing...")
+            docs = sorted(docs, key=lambda x: len(x[1]), reverse=True)
+            sep = getattr(tokenizer, "bos_token_id", None)
+            if sep is None:
+                sep = tokenizer.eod
+            docs = pack_docs(docs, sep, args.max_seq_length)
+        for i, (size, tokens, roles) in enumerate(docs, start=1):
+            assert len(tokens) == len(roles)
+            if not tokens:
+                print("WARNING: skipping empty document")
+                continue
+            total_bytes += size
+            text_builder.add_doc(tokens)
+            role_builder.add_doc(roles)
+            if i % args.log_interval == 0:
+                elapsed = time.time() - start
+                print(f"processed {i} documents "
+                      f"({i / elapsed:.1f} docs/s, "
+                      f"{total_bytes / 1024 / 1024 / elapsed:.2f} MB/s)")
+    text_builder.finalize(f"{args.output_prefix}-text.idx")
+    role_builder.finalize(f"{args.output_prefix}-role.idx")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
