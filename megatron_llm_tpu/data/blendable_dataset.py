@@ -14,8 +14,14 @@ import numpy as np
 
 
 def build_blending_indices(weights: np.ndarray, size: int):
-    """Greedy proportional-fill (helpers.cpp:20-80 semantics, vectorized by
-    chunk): returns (dataset_index[size] u8, dataset_sample_index[size] i64)."""
+    """Greedy proportional-fill (helpers.cpp:20-80 semantics): native C++
+    when available, Python loop fallback.  Returns
+    (dataset_index[size] u8, dataset_sample_index[size] i64)."""
+    from megatron_llm_tpu.data import native
+
+    out = native.build_blending_indices(np.asarray(weights, np.float64), size)
+    if out is not None:
+        return out
     n = len(weights)
     dataset_index = np.empty(size, np.uint8)
     dataset_sample_index = np.empty(size, np.int64)

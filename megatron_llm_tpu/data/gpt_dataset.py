@@ -65,12 +65,19 @@ def _build_doc_idx(documents: np.ndarray, num_epochs: int, rng: np.random.Random
 
 def _build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int,
                       num_samples: int) -> np.ndarray:
-    """Vectorized replacement of helpers.cpp::build_sample_idx (:83-185).
+    """Replacement of helpers.cpp::build_sample_idx (:83-185): native C++
+    walk when the ctypes helper library is available, vectorized numpy
+    otherwise (identical output, tested for parity).
 
     Returns [num_samples+1, 2] int32: for each sample boundary, (index into
     doc_idx, token offset within that document). Sample i spans tokens
     [boundary_i, boundary_{i+1}] with one extra token for the label shift.
     """
+    from megatron_llm_tpu.data import native
+
+    out = native.build_sample_idx(sizes, doc_idx, seq_length, num_samples)
+    if out is not None:
+        return out
     doc_lens = sizes[doc_idx].astype(np.int64)
     cum = np.concatenate(([0], np.cumsum(doc_lens)))
     total_tokens = int(cum[-1])

@@ -89,6 +89,7 @@ def _sample_dataset(np_rng: np.random.RandomState, document_indices: np.ndarray,
                     num_samples: int, seq_length: int) -> InstructionDataset:
     """Epoch-permutation sampling (reference ``_sample_dataset`` :153-169)."""
     assert num_samples > 0
+    assert len(document_indices) > 0, f"{name}: empty document set"
     remaining, chunks = num_samples, []
     while remaining > 0:
         count = min(remaining, len(document_indices))
@@ -157,16 +158,16 @@ def build_train_valid_test_datasets(
         if not prefixes or n <= 0:
             return None
         if len(prefixes) == 1:
-            plist, weights = list(prefixes), np.array([1.0])
+            plist, weights, per_ds = list(prefixes), np.array([1.0]), [(n,)]
         else:
-            plist, weights, _ = _normalize_blend(prefixes, (n,))
+            plist, weights, per_ds = _normalize_blend(prefixes, (n,))
         parts = []
         for j, p in enumerate(plist):
             text, role = get_indexed_datasets_(p)
             docs = np.arange(len(text), dtype=np.int64)
-            nj = int(np.ceil(n * weights[j] * 1.005)) if len(plist) > 1 else n
             parts.append(_sample_dataset(np.random.RandomState(seed=seed), docs,
-                                         text, role, name, nj, seq_length))
+                                         text, role, name, per_ds[j][0],
+                                         seq_length))
         if len(parts) == 1:
             return parts[0]
         return BlendableDataset(parts, weights, int(n))

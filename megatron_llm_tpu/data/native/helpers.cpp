@@ -1,0 +1,89 @@
+// Native dataset index helpers — TPU-agnostic CPU-side index construction.
+//
+// Reference: megatron/data/helpers.cpp (build_sample_idx :83-185,
+// build_blending_indices :20-80).  Unlike the reference this is a plain
+// C ABI shared library loaded via ctypes (no pybind11 in this toolchain);
+// the Python callers in gpt_dataset.py / blendable_dataset.py fall back to
+// the numpy implementations when the library is absent.
+//
+// Build: make -C megatron_llm_tpu/data/native  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdio>
+
+extern "C" {
+
+// Sample-boundary map for the GPT pretraining dataset.
+//
+// sizes:    per-document token counts, indexed by document id
+// doc_idx:  epoch-shuffled document ids, length doc_idx_len
+// out:      [num_samples + 1, 2] int32 row-major; row i = (index into
+//           doc_idx, token offset within that document) of the i-th sample
+//           boundary.  Sample i spans tokens [i*seq_length, (i+1)*seq_length]
+//           with a one-token overlap for the label shift.
+//
+// Returns 0 on success, -1 if the corpus runs out of tokens.
+int build_sample_idx(const int32_t *sizes, const int32_t *doc_idx,
+                     int64_t doc_idx_len, int64_t seq_length,
+                     int64_t num_samples, int32_t *out) {
+  int64_t sample = 0;
+  int64_t doc_cursor = 0;   // index into doc_idx
+  int64_t doc_offset = 0;   // token offset within current document
+  out[0] = 0;
+  out[1] = 0;
+
+  while (sample < num_samples) {
+    int64_t remaining = seq_length;
+    while (remaining > 0) {
+      if (doc_cursor >= doc_idx_len) return -1;
+      int64_t doc_length = (int64_t)sizes[doc_idx[doc_cursor]] - doc_offset;
+      if (doc_length > remaining) {
+        // sample boundary lands inside this document
+        doc_offset += remaining;
+        remaining = 0;
+      } else {
+        remaining -= doc_length;
+        ++doc_cursor;
+        doc_offset = 0;
+      }
+    }
+    // boundary position; keep the one-token overlap by pointing at the
+    // exact token index (the consumer reads [boundary_i, boundary_{i+1}]).
+    ++sample;
+    if (doc_cursor >= doc_idx_len && doc_offset == 0) {
+      // boundary falls exactly at the corpus end: only legal if this is the
+      // final boundary AND the +1 readahead token exists — it does not, so
+      // report exhaustion like the numpy assert does.
+      return -1;
+    }
+    out[2 * sample] = (int32_t)doc_cursor;
+    out[2 * sample + 1] = (int32_t)doc_offset;
+  }
+  return 0;
+}
+
+// Weighted-blend assignment: sample i draws from the dataset whose consumed
+// fraction is furthest below its weight (reference helpers.cpp:20-80).
+void build_blending_indices(uint8_t *dataset_index,
+                            int64_t *dataset_sample_index,
+                            const double *weights, int32_t num_datasets,
+                            int64_t size) {
+  int64_t current[256] = {0};
+  for (int64_t i = 0; i < size; ++i) {
+    double sample_count = (double)(i + 1);
+    double max_error = weights[0] * sample_count - (double)current[0];
+    int32_t best = 0;
+    for (int32_t k = 1; k < num_datasets; ++k) {
+      double error = weights[k] * sample_count - (double)current[k];
+      if (error > max_error) {
+        max_error = error;
+        best = k;
+      }
+    }
+    dataset_index[i] = (uint8_t)best;
+    dataset_sample_index[i] = current[best];
+    ++current[best];
+  }
+}
+
+}  // extern "C"

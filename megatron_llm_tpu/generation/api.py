@@ -77,13 +77,13 @@ class InferenceEngine:
             tok, prompts, tokens_to_generate, add_BOS,
             pad_to_multiple=gen.BUCKET,
         )
-        self._check_limits(len(prompts), samples_length)
-
         # pad the batch dim up to a power of two so the decode program is
         # compiled per size *bucket*, not per request size; padded rows are
-        # copies of row 0 and are sliced off before returning.
+        # copies of row 0 and are sliced off before returning.  The OOM
+        # budget is checked against the padded size that actually runs.
         b = len(prompts)
         b_pad = _next_pow2(b)
+        self._check_limits(b_pad, samples_length)
         if b_pad != b:
             tokens = np.concatenate(
                 [tokens, np.tile(tokens[:1], (b_pad - b, 1))], axis=0)

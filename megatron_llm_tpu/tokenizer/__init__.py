@@ -15,4 +15,35 @@ def build_tokenizer_flat(args) -> AbstractTokenizer:
     return build_tokenizer(cfg)
 
 
-__all__ = ["AbstractTokenizer", "build_tokenizer", "build_tokenizer_flat"]
+def add_tokenizer_args(parser):
+    """Shared tokenizer flag group for the preprocessing CLIs."""
+    g = parser.add_argument_group("tokenizer")
+    g.add_argument("--tokenizer_type", type=str, required=True)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merge_file", type=str, default=None)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--vocab_extra_ids", type=int, default=0)
+    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
+    g.add_argument("--no_new_tokens", action="store_true")
+    return g
+
+
+def finalize_tokenizer_args(args):
+    """Post-parse fixups shared by the preprocessing CLIs: the reference's
+    ``--vocab_file`` spelling aliases the sentencepiece model path, and
+    ``build_tokenizer`` expects a rank/TP context."""
+    if args.tokenizer_model is None and args.vocab_file is not None:
+        args.tokenizer_model = args.vocab_file
+    args.rank = 0
+    args.make_vocab_size_divisible_by = 128
+    args.tensor_model_parallel_size = 1
+    return args
+
+
+__all__ = [
+    "AbstractTokenizer",
+    "add_tokenizer_args",
+    "build_tokenizer",
+    "build_tokenizer_flat",
+    "finalize_tokenizer_args",
+]

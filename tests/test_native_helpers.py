@@ -1,0 +1,88 @@
+"""Native C++ index helpers vs the numpy fallbacks — exact parity
+(reference analog: helpers.cpp is the only implementation there; here both
+paths must agree bit-for-bit)."""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.data import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native helpers not built (no g++?)")
+
+
+def numpy_sample_idx(sizes, doc_idx, seq_length, num_samples):
+    doc_lens = sizes[doc_idx].astype(np.int64)
+    cum = np.concatenate(([0], np.cumsum(doc_lens)))
+    starts = np.arange(num_samples + 1, dtype=np.int64) * seq_length
+    assert starts[-1] <= cum[-1] - 1
+    doc_of_start = np.searchsorted(cum, starts, side="right") - 1
+    out = np.empty((num_samples + 1, 2), np.int32)
+    out[:, 0] = doc_of_start
+    out[:, 1] = starts - cum[doc_of_start]
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("seq_length", [7, 32, 129])
+def test_sample_idx_parity(seed, seq_length):
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(1, 200, size=100).astype(np.int32)
+    doc_idx = rng.permutation(np.tile(np.arange(100, dtype=np.int32), 3))
+    total = int(sizes[doc_idx].sum())
+    num_samples = (total - 1) // seq_length
+    ours = native.build_sample_idx(sizes, doc_idx, seq_length, num_samples)
+    ref = numpy_sample_idx(sizes, doc_idx, seq_length, num_samples)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_sample_idx_exhaustion_raises():
+    sizes = np.array([10], np.int32)
+    doc_idx = np.array([0], np.int32)
+    with pytest.raises(AssertionError):
+        native.build_sample_idx(sizes, doc_idx, 8, 5)
+
+
+def test_doc_boundary_alignment():
+    # boundaries exactly at document edges
+    sizes = np.array([8, 8, 8], np.int32)
+    doc_idx = np.array([0, 1, 2], np.int32)
+    out = native.build_sample_idx(sizes, doc_idx, 8, 2)
+    np.testing.assert_array_equal(out, [[0, 0], [1, 0], [2, 0]])
+
+
+@pytest.mark.parametrize("weights", [[0.5, 0.5], [0.7, 0.2, 0.1],
+                                     [0.05, 0.95], [1.0]])
+def test_blending_parity(weights):
+    w = np.asarray(weights, np.float64)
+    size = 997
+    di, dsi = native.build_blending_indices(w, size)
+    # python-loop reference (the pre-native fallback in blendable_dataset)
+    n = len(w)
+    current = np.zeros(n, np.int64)
+    for i in range(size):
+        k = int(np.argmax(w * (i + 1) - current))
+        assert di[i] == k
+        assert dsi[i] == current[k]
+        current[k] += 1
+    # proportionality: each dataset consumed ~weight*size
+    counts = np.bincount(di, minlength=n)
+    np.testing.assert_allclose(counts / size, w, atol=2 / size)
+
+
+def test_blendable_dataset_uses_native():
+    from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+
+    class Fake:
+        def __init__(self, tag, n):
+            self.tag, self.n = tag, n
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            return (self.tag, i)
+
+    ds = BlendableDataset([Fake("a", 10), Fake("b", 10)], [0.3, 0.7], 50)
+    tags = [ds[i][0] for i in range(50)]
+    assert 10 <= tags.count("a") <= 20

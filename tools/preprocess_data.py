@@ -22,7 +22,11 @@ from pathlib import Path
 sys.path.append(str(Path(__file__).parent.parent.absolute()))
 
 from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDatasetBuilder, best_fitting_dtype
-from megatron_llm_tpu.tokenizer import build_tokenizer_flat as build_tokenizer
+from megatron_llm_tpu.tokenizer import (
+    add_tokenizer_args,
+    build_tokenizer_flat as build_tokenizer,
+    finalize_tokenizer_args,
+)
 
 
 def try_nltk_splitter(lang: str):
@@ -76,15 +80,8 @@ def get_args():
     g.add_argument("--split_sentences", action="store_true")
     g.add_argument("--lang", type=str, default="english")
 
-    g = p.add_argument_group("tokenizer")
-    g.add_argument("--tokenizer_type", type=str, required=True)
-    g.add_argument("--vocab_file", type=str, default=None)
-    g.add_argument("--merge_file", type=str, default=None)
-    g.add_argument("--tokenizer_model", type=str, default=None)
-    g.add_argument("--vocab_extra_ids", type=int, default=0)
-    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
-    g.add_argument("--no_new_tokens", action="store_true")
-    g.add_argument("--append_eod", action="store_true")
+    add_tokenizer_args(p)
+    p.add_argument("--append_eod", action="store_true")
 
     g = p.add_argument_group("output data")
     g.add_argument("--output_prefix", type=str, required=True)
@@ -95,15 +92,7 @@ def get_args():
     g.add_argument("--workers", type=int, default=1)
     g.add_argument("--chunk_size", type=int, default=32)
     g.add_argument("--log_interval", type=int, default=100)
-    args = p.parse_args()
-    # --vocab_file is the reference's spelling for the sentencepiece model
-    # path; accept it as an alias for --tokenizer_model.
-    if args.tokenizer_model is None and args.vocab_file is not None:
-        args.tokenizer_model = args.vocab_file
-    args.rank = 0
-    args.make_vocab_size_divisible_by = 128
-    args.tensor_model_parallel_size = 1
-    return args
+    return finalize_tokenizer_args(p.parse_args())
 
 
 def main():

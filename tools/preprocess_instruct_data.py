@@ -22,7 +22,11 @@ sys.path.append(str(Path(__file__).parent.parent.absolute()))
 
 from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDatasetBuilder, best_fitting_dtype
 from megatron_llm_tpu.data.instruction_dataset import Role
-from megatron_llm_tpu.tokenizer import build_tokenizer_flat as build_tokenizer
+from megatron_llm_tpu.tokenizer import (
+    add_tokenizer_args,
+    build_tokenizer_flat as build_tokenizer,
+    finalize_tokenizer_args,
+)
 
 
 def format_message(message: str, role: str) -> str:
@@ -78,14 +82,7 @@ def get_args():
     g = p.add_argument_group("input data")
     g.add_argument("--input", type=str, nargs="+", required=True)
 
-    g = p.add_argument_group("tokenizer")
-    g.add_argument("--tokenizer_type", type=str, required=True)
-    g.add_argument("--vocab_file", type=str, default=None)
-    g.add_argument("--merge_file", type=str, default=None)
-    g.add_argument("--tokenizer_model", type=str, default=None)
-    g.add_argument("--vocab_extra_ids", type=int, default=0)
-    g.add_argument("--vocab_extra_ids_list", type=str, default=None)
-    g.add_argument("--no_new_tokens", action="store_true")
+    add_tokenizer_args(p)
 
     g = p.add_argument_group("output data")
     g.add_argument("--output_prefix", type=str, required=True)
@@ -97,15 +94,7 @@ def get_args():
     g.add_argument("--log_interval", type=int, default=100)
     g.add_argument("--do_packing", action="store_true")
     g.add_argument("--max_seq_length", type=int, default=4096)
-    args = p.parse_args()
-    # --vocab_file is the reference's spelling for the sentencepiece model
-    # path; accept it as an alias for --tokenizer_model.
-    if args.tokenizer_model is None and args.vocab_file is not None:
-        args.tokenizer_model = args.vocab_file
-    args.rank = 0
-    args.make_vocab_size_divisible_by = 128
-    args.tensor_model_parallel_size = 1
-    return args
+    return finalize_tokenizer_args(p.parse_args())
 
 
 def main():
@@ -129,7 +118,7 @@ def main():
             print("sorting documents by length for packing...")
             docs = sorted(docs, key=lambda x: len(x[1]), reverse=True)
             sep = getattr(tokenizer, "bos_token_id", None)
-            if sep is None:
+            if sep is None or sep < 0:  # sentencepiece returns -1 for no-BOS
                 sep = tokenizer.eod
             docs = pack_docs(docs, sep, args.max_seq_length)
         for i, (size, tokens, roles) in enumerate(docs, start=1):
