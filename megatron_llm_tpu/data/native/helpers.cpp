@@ -29,7 +29,13 @@ int build_sample_idx(const int32_t *sizes, const int32_t *doc_idx,
   int64_t sample = 0;
   int64_t doc_cursor = 0;   // index into doc_idx
   int64_t doc_offset = 0;   // token offset within current document
-  out[0] = 0;
+
+  // A boundary at offset 0 must point past any zero-length documents, like
+  // the numpy fallback's searchsorted(side="right") does — otherwise sample
+  // assembly would issue a read against an empty document.
+  while (doc_cursor < doc_idx_len && sizes[doc_idx[doc_cursor]] == 0)
+    ++doc_cursor;
+  out[0] = (int32_t)doc_cursor;
   out[1] = 0;
 
   while (sample < num_samples) {
@@ -50,6 +56,10 @@ int build_sample_idx(const int32_t *sizes, const int32_t *doc_idx,
     // boundary position; keep the one-token overlap by pointing at the
     // exact token index (the consumer reads [boundary_i, boundary_{i+1}]).
     ++sample;
+    if (doc_offset == 0) {
+      while (doc_cursor < doc_idx_len && sizes[doc_idx[doc_cursor]] == 0)
+        ++doc_cursor;
+    }
     if (doc_cursor >= doc_idx_len && doc_offset == 0) {
       // boundary falls exactly at the corpus end: only legal if this is the
       // final boundary AND the +1 readahead token exists — it does not, so

@@ -41,18 +41,19 @@ class InferenceEngine:
         self.params = params
         self.tokenizer = tokenizer
 
-    def _check_limits(self, batch_size: int, samples_length: int) -> None:
-        """Request-size guards (generation.py:133-138): position range and
-        total-token budget."""
+    def _check_limits(self, batch_size: int, samples_length: int,
+                      run_length: Optional[int] = None) -> None:
+        """Request-size guards (generation.py:133-138): position range on the
+        logical length, token budget on the (bucket-padded) size that runs."""
         max_pos = self.cfg.model.max_position_embeddings
         if samples_length > max_pos:
             raise ValueError(
                 "Length of prompt + tokens_to_generate longer than allowed")
         budget = self.cfg.inference.max_tokens_to_oom
-        if samples_length * batch_size > budget:
+        run_tokens = (run_length or samples_length) * batch_size
+        if run_tokens > budget:
             raise ValueError(
-                f"Too many tokens.  {samples_length * batch_size} is greater "
-                f"than {budget}")
+                f"Too many tokens.  {run_tokens} is greater than {budget}")
 
     # -- generate ----------------------------------------------------------
 
@@ -83,7 +84,7 @@ class InferenceEngine:
         # budget is checked against the padded size that actually runs.
         b = len(prompts)
         b_pad = _next_pow2(b)
-        self._check_limits(b_pad, samples_length)
+        self._check_limits(b_pad, samples_length, tokens.shape[1])
         if b_pad != b:
             tokens = np.concatenate(
                 [tokens, np.tile(tokens[:1], (b_pad - b, 1))], axis=0)
@@ -180,7 +181,7 @@ class InferenceEngine:
             tok, prompts, tokens_to_generate, add_BOS,
             pad_to_multiple=gen.BUCKET,
         )
-        self._check_limits(1, samples_length)
+        self._check_limits(1, samples_length, tokens.shape[1])
         out_tokens, scores = gen.beam_search(
             self.cfg, self.params, tokens[:1], int(lengths[0]),
             beam_size=beam_size, stop_token=stop_token,

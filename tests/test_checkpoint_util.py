@@ -1,0 +1,92 @@
+"""Checkpoint resharding tool tests (reference analog: the TP=2/PP=2
+shard-and-back step of tests/test_llama_weights.py:180-189)."""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+import pytest
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from checkpoint_util import reshard_checkpoint  # noqa: E402
+
+from megatron_llm_tpu.config import Config, apply_architecture  # noqa: E402
+from megatron_llm_tpu.checkpointing import save_checkpoint  # noqa: E402
+from megatron_llm_tpu.models.language_model import (  # noqa: E402
+    init_model_params,
+    padded_vocab_size,
+)
+
+
+def tiny_cfg(tp=1):
+    cfg = Config()
+    apply_architecture(cfg, "llama2")
+    cfg.model.num_layers = 2
+    cfg.model.hidden_size = 64
+    cfg.model.num_attention_heads = 8
+    cfg.model.num_attention_heads_kv = 8
+    cfg.model.vocab_size = 500
+    cfg.model.make_vocab_size_divisible_by = 128
+    cfg.model.max_position_embeddings = 64
+    cfg.parallel.tensor_model_parallel_size = tp
+    cfg.training.params_dtype = "float32"
+    cfg.finalize(n_devices=None)
+    return cfg
+
+
+def test_reshard_repads_vocab_and_updates_meta(tmp_path):
+    cfg = tiny_cfg(tp=1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    src_rows = padded_vocab_size(500, cfg)  # 512 at tp=1
+    assert params["embedding"]["word_embeddings"].shape[0] == src_rows
+
+    save_checkpoint(cfg, str(tmp_path / "src"), 7, params, consumed_samples=3)
+    meta = reshard_checkpoint(str(tmp_path / "src"), str(tmp_path / "dst"),
+                              target_tp=8, target_pp=2)
+    assert meta["config"]["parallel"]["tensor_model_parallel_size"] == 8
+    assert meta["config"]["parallel"]["pipeline_model_parallel_size"] == 2
+
+    restored = ocp.StandardCheckpointer().restore(
+        str(tmp_path / "dst" / "iter_0000007" / "params"))
+    emb = np.asarray(restored["embedding"]["word_embeddings"])
+    assert emb.shape[0] == 1024  # 128 * 8 = 1024-multiple at tp=8
+    np.testing.assert_array_equal(
+        emb[:src_rows], np.asarray(params["embedding"]["word_embeddings"]))
+    np.testing.assert_array_equal(emb[src_rows:], 0.0)
+    head = np.asarray(restored["lm_head"]["kernel"])
+    assert head.shape[1] == 1024
+    # tracker carries the iteration forward
+    assert (tmp_path / "dst" / "latest_checkpointed_iteration.txt").read_text() == "7"
+
+
+def test_reshard_back_roundtrip(tmp_path):
+    cfg = tiny_cfg(tp=1)
+    params = init_model_params(cfg, jax.random.PRNGKey(1))
+    save_checkpoint(cfg, str(tmp_path / "a"), 1, params)
+    reshard_checkpoint(str(tmp_path / "a"), str(tmp_path / "b"),
+                       target_tp=8, target_pp=1)
+    reshard_checkpoint(str(tmp_path / "b"), str(tmp_path / "c"),
+                       target_tp=1, target_pp=1)
+    restored = ocp.StandardCheckpointer().restore(
+        str(tmp_path / "c" / "iter_0000001" / "params"))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, jax.tree.map(np.asarray, params))
+
+
+def test_reshard_rejects_bad_layout(tmp_path):
+    cfg = tiny_cfg(tp=1)
+    params = init_model_params(cfg, jax.random.PRNGKey(2))
+    save_checkpoint(cfg, str(tmp_path / "src"), 1, params)
+    with pytest.raises(ValueError, match="not divisible"):
+        reshard_checkpoint(str(tmp_path / "src"), str(tmp_path / "dst"),
+                           target_tp=1, target_pp=3)
+    with pytest.raises(ValueError, match="cannot be sharded"):
+        reshard_checkpoint(str(tmp_path / "src"), str(tmp_path / "dst2"),
+                           target_tp=16, target_pp=1)

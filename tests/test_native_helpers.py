@@ -25,15 +25,27 @@ def numpy_sample_idx(sizes, doc_idx, seq_length, num_samples):
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
 @pytest.mark.parametrize("seq_length", [7, 32, 129])
-def test_sample_idx_parity(seed, seq_length):
+@pytest.mark.parametrize("min_doc_len", [0, 1])  # 0 → zero-length docs present
+def test_sample_idx_parity(seed, seq_length, min_doc_len):
     rng = np.random.RandomState(seed)
-    sizes = rng.randint(1, 200, size=100).astype(np.int32)
+    sizes = rng.randint(min_doc_len, 200, size=100).astype(np.int32)
     doc_idx = rng.permutation(np.tile(np.arange(100, dtype=np.int32), 3))
     total = int(sizes[doc_idx].sum())
     num_samples = (total - 1) // seq_length
     ours = native.build_sample_idx(sizes, doc_idx, seq_length, num_samples)
     ref = numpy_sample_idx(sizes, doc_idx, seq_length, num_samples)
     np.testing.assert_array_equal(ours, ref)
+
+
+def test_sample_idx_boundary_on_empty_doc_run():
+    # boundary lands exactly where a run of empty docs sits: both paths must
+    # point past the empties at the next non-empty document
+    sizes = np.array([5, 0, 0, 4, 7], np.int32)
+    doc_idx = np.arange(5, dtype=np.int32)
+    ours = native.build_sample_idx(sizes, doc_idx, 5, 2)
+    ref = numpy_sample_idx(sizes, doc_idx, 5, 2)
+    np.testing.assert_array_equal(ours, ref)
+    assert ours[1].tolist() == [3, 0]  # skipped docs 1, 2
 
 
 def test_sample_idx_exhaustion_raises():
@@ -71,7 +83,7 @@ def test_blending_parity(weights):
 
 
 def test_blendable_dataset_uses_native():
-    from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+    from megatron_llm_tpu.data import blendable_dataset
 
     class Fake:
         def __init__(self, tag, n):
@@ -83,6 +95,13 @@ def test_blendable_dataset_uses_native():
         def __getitem__(self, i):
             return (self.tag, i)
 
-    ds = BlendableDataset([Fake("a", 10), Fake("b", 10)], [0.3, 0.7], 50)
+    ds = blendable_dataset.BlendableDataset(
+        [Fake("a", 10), Fake("b", 10)], [0.3, 0.7], 50)
     tags = [ds[i][0] for i in range(50)]
     assert 10 <= tags.count("a") <= 20
+    # the dispatch really took the native path: its output must be the
+    # native result verbatim (not the python-loop fallback's recomputation)
+    di, dsi = native.build_blending_indices(ds.weights, 50)
+    np.testing.assert_array_equal(ds.dataset_index, di)
+    np.testing.assert_array_equal(ds.dataset_sample_index, dsi)
+    assert ds.dataset_index.dtype == np.uint8

@@ -1,0 +1,156 @@
+"""Checkpoint resharding / conversion between parallel configurations.
+
+Reference: tools/checkpoint_util.py (+ checkpoint_loader_megatron.py /
+checkpoint_saver_megatron.py) — there, a loader process reassembles full
+tensors from (tp, pp)-sharded torch files and a saver process re-splits them
+for the target sizes, streaming over a multiprocessing queue (:1-86).
+
+TPU-native redesign: orbax checkpoints store each tensor ONCE, logically —
+there are no per-rank shard files, so "resharding" is loading the pytree and
+re-saving it.  The only real tensor transformation is the vocab-padding row
+count, which depends on the target TP size
+(``make_vocab_size_divisible_by * tp``, models/language_model.py:31-39):
+embedding and LM-head rows are sliced/zero-padded to the target padded vocab.
+The target parallel sizes are recorded in the checkpoint's meta.json so
+``--use_checkpoint_args`` picks them up.
+
+Example:
+    python tools/checkpoint_util.py --load_dir ckpts/7b \
+        --save_dir ckpts/7b-tp8 --target_tensor_parallel_size 8 \
+        --target_pipeline_parallel_size 2
+"""
+
+import argparse
+import shutil
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.append(str(Path(__file__).parent.parent.absolute()))
+
+import numpy as np
+import orbax.checkpoint as ocp
+
+from megatron_llm_tpu.checkpointing import (
+    TRACKER_FILENAME,
+    checkpoint_dir,
+    read_tracker,
+)
+
+
+def _load_meta(path: str) -> dict:
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)
+
+
+def _padded_vocab(vocab_size: int, divisible_by: int, tp: int) -> int:
+    multiple = divisible_by * tp
+    return multiple * ((vocab_size + multiple - 1) // multiple)
+
+
+def _repad_vocab_rows(arr: np.ndarray, target_rows: int, axis: int) -> np.ndarray:
+    """Slice or zero-pad ``arr`` along ``axis`` to ``target_rows``
+    (reference saver re-pads the embedding the same way,
+    checkpoint_saver_megatron.py vocab handling)."""
+    cur = arr.shape[axis]
+    if cur == target_rows:
+        return arr
+    if cur > target_rows:
+        index = [slice(None)] * arr.ndim
+        index[axis] = slice(0, target_rows)
+        return arr[tuple(index)]
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, target_rows - cur)
+    return np.pad(arr, pad)
+
+
+def reshard_checkpoint(load_dir: str, save_dir: str,
+                       target_tp: int, target_pp: int,
+                       target_dp: int = 1) -> dict:
+    """Load → transform vocab padding → save with updated parallel config."""
+    iteration, release = read_tracker(load_dir)
+    if iteration is None and not release:
+        raise FileNotFoundError(f"no {TRACKER_FILENAME} in {load_dir}")
+    src = os.path.abspath(checkpoint_dir(load_dir, iteration or 0, release))
+    meta = _load_meta(src)
+    cfg_dict = meta.get("config", {})
+    model_cfg = cfg_dict.get("model", {})
+    par_cfg = cfg_dict.get("parallel", {})
+
+    src_tp = int(par_cfg.get("tensor_model_parallel_size", 1))
+    vocab = int(model_cfg.get("vocab_size"))
+    divisible = int(model_cfg.get("make_vocab_size_divisible_by", 128))
+    src_padded = _padded_vocab(vocab, divisible, src_tp)
+    tgt_padded = _padded_vocab(vocab, divisible, target_tp)
+
+    n_layers = int(model_cfg.get("num_layers"))
+    if n_layers % target_pp != 0:
+        raise ValueError(
+            f"num_layers {n_layers} not divisible by target pp {target_pp}")
+    n_heads = int(model_cfg.get("num_attention_heads"))
+    n_kv = int(model_cfg.get("num_attention_heads_kv") or n_heads)
+    if n_heads % target_tp != 0 or (n_kv % target_tp != 0 and
+                                    target_tp % n_kv != 0):
+        raise ValueError(
+            f"attention heads ({n_heads} q / {n_kv} kv) cannot be sharded "
+            f"over target tp {target_tp}")
+
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(src, "params"))
+
+    if src_padded != tgt_padded:
+        print(f"re-padding vocab rows {src_padded} -> {tgt_padded} "
+              f"(tp {src_tp} -> {target_tp})")
+        emb = np.asarray(params["embedding"]["word_embeddings"])
+        params["embedding"]["word_embeddings"] = _repad_vocab_rows(
+            emb, tgt_padded, axis=0)
+        if "lm_head" in params:
+            head = np.asarray(params["lm_head"]["kernel"])
+            params["lm_head"]["kernel"] = _repad_vocab_rows(
+                head, tgt_padded, axis=1)
+
+    dst = os.path.abspath(checkpoint_dir(save_dir, iteration or 0, release))
+    os.makedirs(save_dir, exist_ok=True)
+    if os.path.exists(dst):  # orbax refuses to overwrite; allow re-runs
+        shutil.rmtree(dst)
+    ckptr.save(os.path.join(dst, "params"), params)
+    ckptr.wait_until_finished()
+
+    par_cfg = dict(par_cfg)
+    par_cfg["tensor_model_parallel_size"] = target_tp
+    par_cfg["pipeline_model_parallel_size"] = target_pp
+    par_cfg["data_parallel_size"] = target_dp
+    cfg_dict = dict(cfg_dict)
+    cfg_dict["parallel"] = par_cfg
+    meta = dict(meta)
+    meta["config"] = cfg_dict
+    # optimizer state is intentionally NOT carried over (the reference tool
+    # also converts model weights only); training resumes with a fresh
+    # optimizer under the new layout.
+    with open(os.path.join(dst, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+    with open(os.path.join(save_dir, TRACKER_FILENAME), "w") as f:
+        f.write("release" if release else str(iteration))
+    print(f"saved resharded checkpoint to {dst}")
+    return meta
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--load_dir", type=str, required=True)
+    p.add_argument("--save_dir", type=str, required=True)
+    p.add_argument("--target_tensor_parallel_size", type=int, default=1)
+    p.add_argument("--target_pipeline_parallel_size", type=int, default=1)
+    p.add_argument("--target_data_parallel_size", type=int, default=1)
+    args = p.parse_args()
+    reshard_checkpoint(
+        args.load_dir, args.save_dir,
+        args.target_tensor_parallel_size,
+        args.target_pipeline_parallel_size,
+        args.target_data_parallel_size,
+    )
+
+
+if __name__ == "__main__":
+    main()
