@@ -75,11 +75,11 @@ def main():
         opt_state = sh["opt_state_value"]
 
         tok = jax.random.randint(jax.random.PRNGKey(1), (mbs, seq + 1), 0, 32000)
-        batch = {
+        batch = sh["place_batch"]({
             "tokens": tok[:, :-1],
             "labels": tok[:, 1:],
             "loss_mask": jnp.ones((mbs, seq), jnp.float32),
-        }
+        })
 
         # warmup / compile
         params, opt_state, m = step(params, opt_state, batch, 0)

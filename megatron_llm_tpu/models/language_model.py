@@ -28,14 +28,19 @@ from megatron_llm_tpu.ops.rope import precompute_freqs
 Params = Dict[str, Any]
 
 
-def padded_vocab_size(vocab_size: int, cfg) -> int:
-    """Pad vocab to a multiple of make_vocab_size_divisible_by * tp
+def pad_vocab(vocab_size: int, divisible_by: int, tp: int) -> int:
+    """Pad vocab to a multiple of ``divisible_by * tp``
     (reference tokenizer.py:_vocab_size_with_padding:49-62)."""
-    multiple = (
-        cfg.model.make_vocab_size_divisible_by
-        * cfg.parallel.tensor_model_parallel_size
-    )
+    multiple = divisible_by * tp
     return multiple * ((vocab_size + multiple - 1) // multiple)
+
+
+def padded_vocab_size(vocab_size: int, cfg) -> int:
+    return pad_vocab(
+        vocab_size,
+        cfg.model.make_vocab_size_divisible_by,
+        cfg.parallel.tensor_model_parallel_size,
+    )
 
 
 def init_model_params(cfg, key: jax.Array) -> Params:
@@ -111,6 +116,7 @@ def model_forward(
     *,
     position_ids: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
+    token_idx: Optional[jax.Array] = None,
     labels: Optional[jax.Array] = None,
     loss_mask: Optional[jax.Array] = None,
     dropout_key: Optional[jax.Array] = None,
@@ -140,6 +146,7 @@ def model_forward(
     hidden, new_caches = transformer_forward(
         cfg, params["layers"], hidden,
         rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
+        token_idx=token_idx,
         dropout_key=dropout_key, deterministic=deterministic,
         kv_caches=kv_caches, cache_index=cache_index,
         sp_constraint=sp_constraint,
@@ -172,6 +179,7 @@ def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
         cfg, params, batch["tokens"],
         position_ids=batch.get("position_ids"),
         segment_ids=batch.get("segment_ids"),
+        token_idx=batch.get("token_idx"),
         labels=batch["labels"],
         dropout_key=dropout_key,
         deterministic=deterministic,

@@ -138,6 +138,7 @@ def attention_sublayer(
     deterministic: bool,
     kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
     cache_index: Optional[jax.Array] = None,
+    token_idx: Optional[jax.Array] = None,
 ):
     """ParallelAttention analog (transformer.py:280-657).
 
@@ -184,6 +185,7 @@ def attention_sublayer(
             causal=True,
             sliding_window=m.sliding_window_size,
             segment_ids=segment_ids,
+            token_idx=token_idx,
             scale=scale,
             use_flash=cfg.training.use_flash_attn,
             dropout_rate=0.0 if deterministic else m.attention_dropout,
@@ -227,6 +229,7 @@ def block_forward(
     rope=None,
     position_ids=None,
     segment_ids=None,
+    token_idx=None,
     dropout_key=None,
     deterministic: bool = True,
     hidden_dropout_rate: Optional[float] = None,
@@ -253,7 +256,7 @@ def block_forward(
     ln1 = norm(hidden, p["input_norm"], eps, m.use_rms_norm)
     attn_out, new_cache = attention_sublayer(
         cfg, p["attention"], ln1, rope, position_ids, segment_ids,
-        dk_attn, deterministic, kv_cache, cache_index,
+        dk_attn, deterministic, kv_cache, cache_index, token_idx=token_idx,
     )
 
     if m.parallel_attn:
@@ -300,6 +303,7 @@ def transformer_forward(
     rope=None,
     position_ids=None,
     segment_ids=None,
+    token_idx=None,
     dropout_key=None,
     deterministic: bool = True,
     kv_caches=None,        # stacked [L, ...] pair, or None
@@ -324,6 +328,7 @@ def transformer_forward(
         out, new_cache = block_forward(
             cfg, layer_params, carry_hidden,
             rope=rope, position_ids=position_ids, segment_ids=segment_ids,
+            token_idx=token_idx,
             dropout_key=dk, deterministic=deterministic,
             hidden_dropout_rate=rate,
             kv_cache=cache, cache_index=cache_index,

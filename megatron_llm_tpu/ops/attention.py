@@ -83,6 +83,7 @@ def make_attention_bias(
     sliding_window: Optional[int] = None,
     segment_ids_q: Optional[jax.Array] = None,
     segment_ids_kv: Optional[jax.Array] = None,
+    token_idx: Optional[jax.Array] = None,
     dtype=jnp.float32,
 ) -> jax.Array:
     """Build an additive attention bias [*, 1, q_len, kv_len].
@@ -92,8 +93,14 @@ def make_attention_bias(
     varlen path, instruction_dataset.py + transformer.py:540-582).
     """
     kv_len = kv_len if kv_len is not None else seq_len
-    q_pos = jnp.arange(seq_len)[:, None]
-    kv_pos = jnp.arange(kv_len)[None, :]
+    if token_idx is not None:
+        # zigzag/permuted layouts: causal structure follows the original
+        # token order, not the storage order (parallel/ring.py)
+        q_pos = token_idx[:, None]
+        kv_pos = token_idx[None, :]
+    else:
+        q_pos = jnp.arange(seq_len)[:, None]
+        kv_pos = jnp.arange(kv_len)[None, :]
     allowed = jnp.ones((seq_len, kv_len), dtype=bool)
     if causal:
         allowed &= q_pos >= kv_pos
@@ -148,6 +155,7 @@ def attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     segment_ids: Optional[jax.Array] = None,
+    token_idx: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     use_flash: bool = True,
@@ -156,14 +164,35 @@ def attention(
     block_q: int = 512,
     block_kv: int = 512,
 ) -> jax.Array:
-    """Dispatch between the Pallas flash kernel and the XLA fallback."""
+    """Dispatch between ring attention (cp > 1), the Pallas flash kernel,
+    and the XLA fallback."""
     sq = q.shape[1]
     on_tpu = jax.default_backend() == "tpu"
+
+    from megatron_llm_tpu.core import parallel_state as ps
+
+    cp = (
+        ps.get_context_parallel_world_size()
+        if ps.mesh_is_initialized()
+        else 1
+    )
+    if cp > 1:
+        assert bias is None and dropout_rate == 0.0, (
+            "context parallelism supports structural masking only "
+            "(causal/sliding-window/segment), no bias or attention dropout"
+        )
+        from megatron_llm_tpu.parallel.ring import ring_attention
+
+        return ring_attention(
+            q, k, v, segment_ids=segment_ids, token_idx=token_idx,
+            causal=causal, sliding_window=sliding_window, scale=scale,
+        )
     flash_ok = (
         use_flash
         and bias is None
         and dropout_rate == 0.0
         and causal
+        and token_idx is None  # kernel masks by storage order only
         and on_tpu
         and sq >= 128
         and q.shape[-1] in (64, 128, 256)
@@ -177,7 +206,7 @@ def attention(
         seg_q = seg_kv = segment_ids
         bias = make_attention_bias(
             sq, k.shape[1], causal=causal, sliding_window=sliding_window,
-            segment_ids_q=seg_q, segment_ids_kv=seg_kv,
+            segment_ids_q=seg_q, segment_ids_kv=seg_kv, token_idx=token_idx,
         )
     return xla_attention(
         q, k, v, bias=bias, scale=scale,

@@ -37,23 +37,31 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.core import rng as rng_mod
-from megatron_llm_tpu.core.parallel_state import PP_AXIS
+from megatron_llm_tpu.core.parallel_state import CP_AXIS, PP_AXIS
 from megatron_llm_tpu.models import language_model as lm
 from megatron_llm_tpu.models.transformer import transformer_forward
 from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
 from megatron_llm_tpu.ops.norms import norm
 
 
-def _stage_body(cfg, layers_local, x, aux, dropout_key, deterministic, rope):
+def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
+                deterministic, rope):
     """Run this stage's local layers on one microbatch of hidden states."""
     pp = jax.lax.axis_size(PP_AXIS)
     stage = jax.lax.axis_index(PP_AXIS)
+    if dropout_key is not None:
+        # distinct dropout streams per cp seq-chunk (analog of the reference's
+        # per-TP-rank RNG fork inside parallel regions, random.py:144-172)
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(CP_AXIS)
+        )
     layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
     hidden, _ = transformer_forward(
         cfg, layers_local, x,
         rope=rope,
         position_ids=aux.get("position_ids"),
         segment_ids=aux.get("segment_ids"),
+        token_idx=token_idx,
         dropout_key=dropout_key,
         deterministic=deterministic,
         layer_offset=stage * layers_per_stage,
@@ -63,16 +71,23 @@ def _stage_body(cfg, layers_local, x, aux, dropout_key, deterministic, rope):
 
 def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
                    aux_mb: Dict[str, jax.Array], dropout_key, deterministic,
-                   rope):
+                   rope, token_idx: Optional[jax.Array] = None):
     """Run the pipelined transformer body.
 
-    hidden_mb: [M, mb, s, h] embedded microbatches; aux_mb leaves [M, mb, s].
+    hidden_mb: [M, mb, s, h] embedded microbatches; aux_mb leaves [M, mb, s];
+    token_idx: optional [s] zigzag index vector (parallel/ring.py).
     Returns [M, mb, s, h] final hidden states (replicated over pp).
     """
     pp = cfg.parallel.pipeline_model_parallel_size
     M = hidden_mb.shape[0]
+    if token_idx is None:
+        # constant placeholder so the shard_map signature is static; the
+        # sentinel -1 row is never read (selected below)
+        token_idx_arr = jnp.full((hidden_mb.shape[2],), -1, jnp.int32)
+    else:
+        token_idx_arr = token_idx
 
-    def body(layers_local, hidden_mb, aux_mb):
+    def body(layers_local, hidden_mb, aux_mb, token_idx_local):
         stage = jax.lax.axis_index(PP_AXIS)
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
@@ -86,8 +101,11 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
                 None if dropout_key is None
                 else jax.random.fold_in(dropout_key, t)
             )
-            out = _stage_body(cfg, layers_local, inp, aux, dk, deterministic,
-                              rope)
+            out = _stage_body(
+                cfg, layers_local, inp, aux,
+                token_idx_local if token_idx is not None else None,
+                dk, deterministic, rope,
+            )
             nxt = jax.lax.ppermute(out, PP_AXIS, perm)
             # last stage's output for microbatch t-(pp-1), zero elsewhere
             y = jnp.where(stage == pp - 1, out, jnp.zeros_like(out))
@@ -100,21 +118,26 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
         # transpose of this psum routes dLoss back to the last stage only.
         return jax.lax.psum(outs, PP_AXIS)
 
-    # aux entries may be absent; normalize to a dict of arrays for shard_map
+    # cp joins pp as a manual axis: hidden/aux seq dims are cp-local inside
+    # the body, and the attention dispatch takes the ring_attention_manual
+    # path (parallel/ring.py) — one shard_map, no nesting.
+    P = jax.sharding.PartitionSpec
+    hidden_spec = P(None, None, CP_AXIS, None)  # [M, mb, s, h]
+    aux_spec = P(None, None, CP_AXIS)           # [M, mb, s]
     fn = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            jax.tree.map(lambda _: jax.sharding.PartitionSpec(PP_AXIS),
-                         stacked_layers),
-            jax.sharding.PartitionSpec(),
-            jax.tree.map(lambda _: jax.sharding.PartitionSpec(), aux_mb),
+            jax.tree.map(lambda _: P(PP_AXIS), stacked_layers),
+            hidden_spec,
+            jax.tree.map(lambda _: aux_spec, aux_mb),
+            P(CP_AXIS),
         ),
-        out_specs=jax.sharding.PartitionSpec(),
-        axis_names={PP_AXIS},
+        out_specs=hidden_spec,
+        axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
-    return fn(stacked_layers, hidden_mb, aux_mb)
+    return fn(stacked_layers, hidden_mb, aux_mb, token_idx_arr)
 
 
 def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
@@ -140,6 +163,7 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     for k in ("position_ids", "segment_ids"):
         if batch.get(k) is not None:
             aux_mb[k] = split(batch[k])
+    token_idx = batch.get("token_idx")  # [s], batch-invariant (zigzag cp)
 
     if rope is None:
         rope = lm.make_rope_cache(cfg)
@@ -152,7 +176,7 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
 
     hidden = pipeline_apply(
         cfg, mesh, params["layers"], hidden, aux_mb, dropout_key,
-        deterministic, rope,
+        deterministic, rope, token_idx=token_idx,
     )
 
     hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,

@@ -1,0 +1,276 @@
+"""Ring attention — context parallelism over the ``cp`` mesh axis.
+
+The reference has **no** context parallelism (SURVEY §2.1: long context is
+served by FlashAttention-2 + RoPE scaling + sliding window only); this module
+is the TPU-native extension that makes sequence length a first-class sharded
+dimension, the way the reference makes hidden/vocab dims sharded via TP.
+
+Design (blockwise ring attention, Liu et al. 2023 style, TPU-native):
+
+* the sequence axis of Q/K/V is sharded over ``cp``; each device holds a
+  contiguous (or zigzag-permuted) chunk.
+* K/V chunks rotate around the cp ring with ``lax.ppermute`` (one ICI hop per
+  step — the collective rides the torus neighbour links), while each device
+  accumulates its local Q against every K/V chunk with the online-softmax
+  recurrence (running max ``m``, normalizer ``l``, unnormalized output ``o``)
+  — the same accumulation the Pallas flash kernel uses per block, lifted to
+  the inter-chip level.
+* causal masking is computed from explicit *token indices* carried (and
+  rotated) alongside K/V, so arbitrary sequence permutations work. That is
+  what makes **zigzag load balancing** a pure data transform: device ``i``
+  holds chunks ``i`` and ``2*cp-1-i`` of the sequence, so every device sees
+  the same amount of unmasked causal work (a contiguous split leaves device 0
+  nearly idle and device cp-1 doing all of it).
+* the whole loop is a differentiable ``lax.scan``; the backward pass is
+  autodiff through the scan, with ``ppermute``'s transpose providing the
+  reverse rotation — no hand-written bwd collectives.
+
+GQA is computed grouped (no K/V head expansion), matching ops/attention.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.ops.attention import NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# Zigzag load balancing (pure data transform)
+# ---------------------------------------------------------------------------
+
+
+def zigzag_permutation(seq_len: int, cp: int) -> np.ndarray:
+    """Permutation p so that tokens p[chunk_i] land on cp-rank i balanced.
+
+    Splits the sequence into 2*cp chunks; rank i holds chunks (i, 2*cp-1-i).
+    Under causal masking every rank then attends to the same number of
+    unmasked (q, k) pairs.
+    """
+    assert seq_len % (2 * cp) == 0, (
+        f"seq_len {seq_len} must be divisible by 2*cp = {2 * cp} for zigzag"
+    )
+    c = seq_len // (2 * cp)
+    chunks = np.arange(seq_len).reshape(2 * cp, c)
+    order = []
+    for i in range(cp):
+        order.append(chunks[i])
+        order.append(chunks[2 * cp - 1 - i])
+    return np.concatenate(order)
+
+
+def apply_zigzag(batch: Dict[str, np.ndarray], cp: int) -> Dict[str, np.ndarray]:
+    """Permute every per-token tensor of a batch for zigzag CP sharding.
+
+    Adds ``token_idx`` (the original sequence index of each permuted slot) so
+    ring attention can reconstruct the causal structure. Per-token CE loss is
+    permutation-invariant under the matching label/mask permutation, so the
+    training loss is unchanged.
+    """
+    seq_keys = ("tokens", "labels", "loss_mask", "position_ids", "segment_ids")
+    some = next(v for k, v in batch.items() if k in seq_keys)
+    perm = zigzag_permutation(some.shape[1], cp)
+    out = dict(batch)
+    for k in seq_keys:
+        if k in batch and batch[k] is not None:
+            out[k] = np.ascontiguousarray(np.asarray(batch[k])[:, perm])
+    if "position_ids" not in out or out.get("position_ids") is None:
+        # RoPE must still see original positions after the permutation.
+        out["position_ids"] = np.broadcast_to(
+            perm[None, :], some.shape[:2]
+        ).astype(np.int32)
+    out["token_idx"] = perm.astype(np.int32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The ring loop (runs inside shard_map; cp axis is manual)
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [b, sq_loc, n, d]
+    k: jax.Array,  # [b, skv_loc, nkv, d]
+    v: jax.Array,  # [b, skv_loc, nkv, d]
+    q_idx: jax.Array,    # [sq_loc] global token indices of local Q rows
+    kv_idx: jax.Array,   # [skv_loc] global token indices of local K/V rows
+    seg_q: Optional[jax.Array],   # [b, sq_loc] or None
+    seg_kv: Optional[jax.Array],  # [b, skv_loc] or None
+    *,
+    axis_name: str,
+    scale: float,
+    causal: bool,
+    sliding_window: Optional[int],
+) -> jax.Array:
+    cp = lax.axis_size(axis_name)
+    b, sq, n, d = q.shape
+    nkv = k.shape[2]
+    g = n // nkv
+    qg = (q.astype(jnp.float32) * scale).reshape(b, sq, nkv, g, d)
+
+    # send chunk i -> i+1 each step; after t steps a device holds the K/V
+    # chunk of cp-rank (i - t) % cp. The rotated kv_idx tracks that for us.
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def allowed_mask(kv_idx_t, seg_kv_t):
+        ok = jnp.ones((1, sq, k.shape[1]), dtype=bool)
+        qi = q_idx[:, None]
+        ki = kv_idx_t[None, :]
+        if causal:
+            ok &= (qi >= ki)[None]
+        if sliding_window is not None:
+            ok &= (qi - ki < sliding_window)[None]
+        if seg_q is not None:
+            ok = ok & (seg_q[:, :, None] == seg_kv_t[:, None, :])
+        return ok  # [1 or b, sq, skv]
+
+    def step(carry, _):
+        o, m, l, k_t, v_t, kv_idx_t, seg_kv_t = carry
+        # scores [b, nkv, g, sq, skv] in fp32
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_t.astype(jnp.float32))
+        ok = allowed_mask(kv_idx_t, seg_kv_t)[:, None, None]  # [b,1,1,sq,skv]
+        s_masked = jnp.where(ok, s, NEG_INF)
+        m_new = jnp.maximum(m, s_masked.max(axis=-1))
+        # mask applied to p directly — never rely on exp(-inf - -inf)
+        p = jnp.where(ok, jnp.exp(s - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p, v_t.astype(jnp.float32)
+        )
+        k_t = lax.ppermute(k_t, axis_name, perm)
+        v_t = lax.ppermute(v_t, axis_name, perm)
+        kv_idx_t = lax.ppermute(kv_idx_t, axis_name, perm)
+        if seg_kv_t is not None:
+            seg_kv_t = lax.ppermute(seg_kv_t, axis_name, perm)
+        return (o_new, m_new, l_new, k_t, v_t, kv_idx_t, seg_kv_t), None
+
+    o0 = jnp.zeros((b, nkv, g, sq, d), jnp.float32)
+    m0 = jnp.full((b, nkv, g, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nkv, g, sq), jnp.float32)
+    (o, _, l, *_), _ = lax.scan(
+        step, (o0, m0, l0, k, v, kv_idx, seg_kv), None, length=cp
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l_safe[..., None]).transpose(0, 3, 1, 2, 4)  # [b, sq, nkv, g, d]
+    return out.reshape(b, sq, n, d).astype(q.dtype)
+
+
+def _local_indices(token_idx: Optional[jax.Array], s_local: int, axis_name: str):
+    """Global token indices of this device's chunk (contiguous by default)."""
+    if token_idx is not None:
+        return token_idx
+    return lax.axis_index(axis_name) * s_local + jnp.arange(s_local)
+
+
+# ---------------------------------------------------------------------------
+# Public entry: shard_map over the (dp, cp, tp) mesh
+# ---------------------------------------------------------------------------
+
+
+def ring_attention_manual(
+    q: jax.Array,  # [b, s_local, n, d] — cp-LOCAL shards
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    segment_ids: Optional[jax.Array] = None,  # [b, s_local]
+    token_idx: Optional[jax.Array] = None,    # [s_local]
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention for callers already inside a shard_map that manualizes
+    ``cp`` (e.g. the pipeline body, parallel/pipeline.py): operates on local
+    seq shards directly, no inner shard_map."""
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    idx = _local_indices(token_idx, q.shape[1], ps.CP_AXIS)
+    return _ring_attention_local(
+        q, k, v, idx, idx, segment_ids, segment_ids,
+        axis_name=ps.CP_AXIS, scale=scale, causal=causal,
+        sliding_window=sliding_window,
+    )
+
+
+def cp_is_manual() -> bool:
+    """True when tracing inside a shard_map that already binds the cp axis."""
+    abstract = jax.sharding.get_abstract_mesh()
+    return (
+        abstract is not None
+        and not abstract.empty
+        and ps.CP_AXIS in set(abstract.manual_axes)
+    )
+
+
+def ring_attention(
+    q: jax.Array,  # [b, s, n, d] — global (pjit-land) arrays
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    segment_ids: Optional[jax.Array] = None,  # [b, s]
+    token_idx: Optional[jax.Array] = None,    # [s] original indices (zigzag)
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Context-parallel attention: seq over ``cp``, heads over ``tp``,
+    batch over ``dp``.
+
+    Called from the ops/attention dispatcher when the active mesh has cp > 1.
+    From pjit-land it wraps the ring loop in shard_map; from inside an
+    enclosing shard_map that already manualizes cp it runs locally.
+    """
+    if cp_is_manual():
+        return ring_attention_manual(
+            q, k, v, segment_ids=segment_ids, token_idx=token_idx,
+            causal=causal, sliding_window=sliding_window, scale=scale,
+        )
+    mesh = mesh or ps.get_global_mesh()
+    cp = mesh.shape.get(ps.CP_AXIS, 1)
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    assert q.shape[1] % cp == 0, (
+        f"seq_len {q.shape[1]} not divisible by cp {cp}"
+    )
+
+    qs = P(ps.DP_AXIS, ps.CP_AXIS, ps.TP_AXIS, None)
+    segs = P(ps.DP_AXIS, ps.CP_AXIS)
+    idxs = P(ps.CP_AXIS)
+    s_local = q.shape[1] // cp
+
+    kw = dict(axis_name=ps.CP_AXIS, scale=scale, causal=causal,
+              sliding_window=sliding_window)
+
+    def local(q_, k_, v_, seg_=None, tok_=None):
+        idx = _local_indices(tok_, s_local, ps.CP_AXIS)
+        return _ring_attention_local(
+            q_, k_, v_, idx, idx, seg_, seg_, **kw
+        )
+
+    in_specs = [qs, qs, qs]
+    args = [q, k, v]
+    fn = local
+    if segment_ids is not None and token_idx is not None:
+        fn = lambda q_, k_, v_, s_, t_: local(q_, k_, v_, seg_=s_, tok_=t_)
+        in_specs += [segs, idxs]
+        args += [segment_ids, token_idx]
+    elif segment_ids is not None:
+        fn = lambda q_, k_, v_, s_: local(q_, k_, v_, seg_=s_)
+        in_specs += [segs]
+        args += [segment_ids]
+    elif token_idx is not None:
+        fn = lambda q_, k_, v_, t_: local(q_, k_, v_, tok_=t_)
+        in_specs += [idxs]
+        args += [token_idx]
+
+    mapped = shard_map(
+        fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=qs, check_vma=False
+    )
+    return mapped(*args)

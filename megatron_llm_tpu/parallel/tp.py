@@ -29,7 +29,7 @@ from typing import Any, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from megatron_llm_tpu.core.parallel_state import DP_AXIS, PP_AXIS, TP_AXIS
+from megatron_llm_tpu.core.parallel_state import CP_AXIS, DP_AXIS, PP_AXIS, TP_AXIS
 
 # Grad accumulation / FSDP-style extra sharding could compose here later.
 
@@ -101,25 +101,48 @@ def param_shardings(mesh: Mesh, params: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def batch_spec(sequence_parallel: bool) -> P:
+def batch_spec(sequence_parallel: bool, context_parallel: bool = False) -> P:
     """Spec for [batch, seq, ...] activations on the residual stream.
 
     Sequence parallelism (reference §2.1 SP row: scatter along seq between TP
     ranks in LN/dropout regions) = putting the seq axis on `tp` here; XLA then
     emits the all-gather before column-linears and the reduce-scatter after
     row-linears exactly as layers.py:225-296 does by hand.
+
+    Context parallelism stacks on top: the seq axis is sharded over cp always
+    (ring attention, parallel/ring.py) and additionally over tp in the
+    LN/dropout regions when SP is also on.
     """
-    return P(DP_AXIS, TP_AXIS if sequence_parallel else None, None)
+    if context_parallel:
+        seq = (CP_AXIS, TP_AXIS) if sequence_parallel else CP_AXIS
+    else:
+        seq = TP_AXIS if sequence_parallel else None
+    return P(DP_AXIS, seq, None)
 
 
-def data_spec() -> P:
-    """Spec for integer batch tensors [batch, seq]: shard batch over dp."""
-    return P(DP_AXIS, None)
+def data_spec(context_parallel: bool = False) -> P:
+    """Spec for integer batch tensors [batch, seq]: batch over dp, and the
+    seq axis over cp when context parallelism is active."""
+    return P(DP_AXIS, CP_AXIS if context_parallel else None)
+
+
+def batch_shardings(cfg, mesh: Mesh, batch: Any) -> Any:
+    """Per-key shardings for a batch dict ([b, s] tensors; ``token_idx`` is
+    the [s] zigzag index vector, sharded over cp only)."""
+    cp = cfg.parallel.context_parallel_size > 1
+    d = NamedSharding(mesh, data_spec(cp))
+    idx = NamedSharding(mesh, P(CP_AXIS) if cp else P(None))
+    return {
+        k: (idx if k == "token_idx" else d) for k in batch
+    }
 
 
 def make_sp_constraint(cfg, mesh: Optional[Mesh] = None):
     """Return a callable constraining residual-stream activations, or None."""
-    spec = batch_spec(cfg.parallel.sequence_parallel)
+    spec = batch_spec(
+        cfg.parallel.sequence_parallel,
+        cfg.parallel.context_parallel_size > 1,
+    )
 
     def constrain(x):
         return jax.lax.with_sharding_constraint(x, spec)

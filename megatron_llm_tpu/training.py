@@ -309,7 +309,8 @@ def pretrain(
             if iteration not in (t.skip_iters or []):
                 # --skip_iters skips the update (training.py:397-399)
                 params, opt_state, metrics = step_fn(
-                    params, opt_state, batch, iteration
+                    params, opt_state, shardings["place_batch"](batch),
+                    iteration,
                 )
                 jax.block_until_ready(metrics["lm loss"])
             step_time = time.time() - step_start

@@ -37,6 +37,7 @@ from megatron_llm_tpu.optimizer.optimizer import (
 )
 from megatron_llm_tpu.optimizer.scheduler import lr_schedule
 from megatron_llm_tpu.parallel.tp import (
+    batch_shardings,
     data_spec,
     make_sp_constraint,
     param_shardings,
@@ -44,13 +45,25 @@ from megatron_llm_tpu.parallel.tp import (
 
 
 def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
-    """[gbs, ...] -> [num_micro, gbs/num_micro, ...] for scan."""
+    """[gbs, ...] -> [num_micro, gbs/num_micro, ...] for scan.
+
+    ``token_idx`` (the [s] zigzag index vector, parallel/ring.py) is batch-
+    invariant and is broadcast to every microbatch rather than split.
+    """
+    batch = dict(batch)
+    token_idx = batch.pop("token_idx", None)
+
     def r(x):
         gbs = x.shape[0]
         assert gbs % num_micro == 0, f"batch {gbs} % num_micro {num_micro} != 0"
         return x.reshape(num_micro, gbs // num_micro, *x.shape[1:])
 
-    return jax.tree.map(r, batch)
+    out = jax.tree.map(r, batch)
+    if token_idx is not None:
+        out["token_idx"] = jnp.broadcast_to(
+            token_idx[None], (num_micro, *token_idx.shape)
+        )
+    return out
 
 
 def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
@@ -149,20 +162,29 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any):
 
     p_shard = param_shardings(mesh, params)
     o_shard = opt_state_shardings(cfg, mesh, params, opt_state)
-    b_shard = NamedSharding(mesh, data_spec())
+    cp = cfg.parallel.context_parallel_size > 1
+    b_shard = NamedSharding(mesh, data_spec(cp))
     scalar = NamedSharding(mesh, P())
 
     step = make_train_step(cfg, optimizer, mesh=mesh)
+    # batch in_sharding is UNSPECIFIED (follows the committed input): batches
+    # may carry the [s] token_idx vector whose sharding differs per key —
+    # callers place batches with place_batch / batch_shardings.
     jstep = jax.jit(
         step,
-        in_shardings=(p_shard, o_shard, b_shard, scalar),
+        in_shardings=(p_shard, o_shard, None, scalar),
         out_shardings=(p_shard, o_shard, None),
         donate_argnums=(0, 1),
     )
+
+    def place_batch(batch):
+        return jax.device_put(batch, batch_shardings(cfg, mesh, batch))
+
     return jstep, optimizer, {
         "params": p_shard,
         "opt_state": o_shard,
         "batch": b_shard,
+        "place_batch": place_batch,
         "opt_state_value": opt_state,
     }
 

@@ -44,9 +44,7 @@ def _load_meta(path: str) -> dict:
         return json.load(f)
 
 
-def _padded_vocab(vocab_size: int, divisible_by: int, tp: int) -> int:
-    multiple = divisible_by * tp
-    return multiple * ((vocab_size + multiple - 1) // multiple)
+from megatron_llm_tpu.models.language_model import pad_vocab as _padded_vocab
 
 
 def _repad_vocab_rows(arr: np.ndarray, target_rows: int, axis: int) -> np.ndarray:
