@@ -1,0 +1,74 @@
+"""Pluggable evaluation metrics registry.
+
+Reference: megatron/metrics.py — ``MetricInput``:11, metric fns :62-97,
+``METRICS`` registry :100-110 consumed via the ``--metrics`` flag
+(arguments.py:94-95) and computed in ``loss_func`` during validation only
+(finetune.py:183-187). Here the metric functions are pure jax and run inside
+the jitted eval step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class MetricInput:
+    """Everything a metric may need (reference MetricInput:11-20)."""
+
+    batch: Dict[str, jax.Array]          # tokens/labels/loss_mask[...]
+    per_token_loss: jax.Array            # [b, s] fp32 CE
+    logits: Optional[jax.Array] = None   # [b, s, v] (argmax metrics only)
+
+
+def _masked_mean_loss(inp: MetricInput) -> jax.Array:
+    mask = inp.batch["loss_mask"].astype(jnp.float32)
+    return (inp.per_token_loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def perplexity(inp: MetricInput) -> jax.Array:
+    """exp of the masked mean CE (metrics.py:62-70)."""
+    return jnp.exp(_masked_mean_loss(inp))
+
+
+def accuracy(inp: MetricInput) -> jax.Array:
+    """Fraction of loss-masked positions where argmax(logits) == label
+    (metrics.py:73-83, vocab_parallel_max_indices analog — under pjit the
+    vocab-sharded argmax is XLA's problem, cross_entropy.py:146-175)."""
+    assert inp.logits is not None, "accuracy metric needs logits"
+    pred = jnp.argmax(inp.logits, axis=-1)
+    mask = inp.batch["loss_mask"].astype(jnp.float32)
+    correct = (pred == inp.batch["labels"]).astype(jnp.float32)
+    return (correct * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def count_loss_mask(inp: MetricInput) -> jax.Array:
+    """Mean number of loss-counted tokens per sample (metrics.py:86-90)."""
+    return inp.batch["loss_mask"].astype(jnp.float32).sum(axis=-1).mean()
+
+
+METRICS: Dict[str, Callable[[MetricInput], jax.Array]] = {
+    "perplexity": perplexity,
+    "ppl": perplexity,
+    "accuracy": accuracy,
+    "count": count_loss_mask,
+}
+
+
+def needs_logits(names) -> bool:
+    return any(n in ("accuracy",) for n in names)
+
+
+def compute_metrics(names, inp: MetricInput) -> Dict[str, jax.Array]:
+    out = {}
+    for name in names:
+        if name not in METRICS:
+            raise KeyError(
+                f"unknown metric {name!r}; available: {sorted(METRICS)}"
+            )
+        out[name] = METRICS[name](inp)
+    return out

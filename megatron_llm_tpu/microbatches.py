@@ -1,0 +1,121 @@
+"""Number-of-microbatches calculators (constant + batch-size ramp-up).
+
+Reference: megatron/microbatches.py (build:9, ConstantNumMicroBatches:59,
+RampupBatchsizeNumMicroBatches:78-144). Semantics preserved exactly: the
+global batch size ramps from ``start`` to ``global_batch_size`` in
+``increment`` steps, each stage lasting ``ramp_samples / num_increments``
+consumed samples; every stage's batch size must divide by
+micro_batch_size * dp.
+
+TPU note: the jitted train step is specialized on the number of microbatches,
+so each ramp stage triggers one recompilation (the pretrain loop caches the
+compiled step per stage).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches: int = 1
+        self.current_global_batch_size: int = 1
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples: int, consistency_check: bool = True):
+        pass
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """microbatches.py:59-75."""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        assert global_batch_size % micro_batch_times_dp == 0, (
+            f"global batch size ({global_batch_size}) is not divisible by "
+            f"micro batch size ({micro_batch_size}) times data parallel size "
+            f"({data_parallel_size})"
+        )
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        assert self.num_micro_batches >= 1
+        self.current_global_batch_size = global_batch_size
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Batch-size ramp-up (microbatches.py:78-144).
+
+    ``rampup_batch_size = (start, increment, ramp_samples)``: batch size
+    starts at ``start`` and grows by ``increment`` per stage until reaching
+    ``global_batch_size``, evenly spread over ``ramp_samples`` samples.
+    """
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_dp = micro_batch_size * data_parallel_size
+        assert start_batch_size % self.micro_batch_times_dp == 0
+        assert batch_size_increment % self.micro_batch_times_dp == 0
+        assert global_batch_size % self.micro_batch_times_dp == 0
+        assert batch_size_increment > 0
+        assert start_batch_size > 0
+        assert global_batch_size >= start_batch_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+
+        diff = global_batch_size - start_batch_size
+        assert diff % batch_size_increment == 0, (
+            f"global batch ({global_batch_size}) - start ({start_batch_size}) "
+            f"not divisible by increment ({batch_size_increment})"
+        )
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            ramup_samples / num_increments if num_increments > 0 else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool = True):
+        if consumed_samples > self.ramup_samples or (
+            self.rampup_samples_per_increment == 0
+        ):
+            bs = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            bs = min(
+                self.start_batch_size + steps * self.batch_size_increment,
+                self.global_batch_size,
+            )
+        if consistency_check:
+            assert bs % self.micro_batch_times_dp == 0
+        self.current_global_batch_size = bs
+        self.num_micro_batches = bs // self.micro_batch_times_dp
+
+
+def build_num_microbatches_calculator(cfg) -> NumMicroBatchesCalculator:
+    """build_num_microbatches_calculator analog (microbatches.py:9-56)."""
+    t = cfg.training
+    dp = cfg.parallel.data_parallel_size or 1
+    if t.rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            t.global_batch_size, t.micro_batch_size, dp
+        )
+    assert len(t.rampup_batch_size) == 3, (
+        "rampup_batch_size = (start, increment, ramp_samples)"
+    )
+    start, incr, samples = t.rampup_batch_size
+    return RampupBatchsizeNumMicroBatches(
+        int(start), int(incr), int(samples), t.global_batch_size,
+        t.micro_batch_size, dp,
+    )

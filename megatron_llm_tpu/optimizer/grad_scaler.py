@@ -1,0 +1,155 @@
+"""Loss scaling for fp16 training — constant and dynamic scalers.
+
+Replaces the reference's grad scalers (optimizer/grad_scaler.py:40-120:
+``ConstantGradScaler``, ``DynamicGradScaler`` with growth/backoff/hysteresis)
+and the found-inf/skip-step machinery of ``MixedPrecisionOptimizer``
+(optimizer/optimizer.py:384-466). TPU-native formulation: one optax
+``GradientTransformation`` wrapping the whole optimizer chain —
+
+* the train step multiplies the loss by the current scale (read out of the
+  optimizer state via :func:`find_scaler_state`), so fp16 backward
+  intermediates stay above underflow;
+* ``update`` un-scales the incoming grads, checks finiteness, and on overflow
+  zeroes the updates and keeps the inner state — the skip-step semantics of
+  optimizer.py:408-436 — while the scaler state applies the reference's
+  hysteresis/backoff/growth rules (grad_scaler.py:75-120) inside jit via
+  ``jnp.where`` selects (no host round-trip).
+
+bf16 (the default) needs none of this and never constructs the wrapper
+(validate_args:139-148 analog: bf16 grads accumulate in fp32).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScalerState(NamedTuple):
+    loss_scale: jax.Array      # fp32 scalar, current S
+    growth_tracker: jax.Array  # int32: consecutive finite steps
+    hysteresis_left: jax.Array  # int32: overflows tolerated before backoff
+    skipped_total: jax.Array   # int32: cumulative skipped iterations
+    last_skipped: jax.Array    # bool: this step was skipped
+
+
+def with_loss_scaling(
+    inner: optax.GradientTransformation,
+    *,
+    initial_scale: float,
+    min_scale: float = 1.0,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 1000,
+    hysteresis: int = 2,
+    constant: bool = False,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` with loss-scale bookkeeping and skip-on-overflow."""
+
+    def init(params):
+        s = ScalerState(
+            loss_scale=jnp.asarray(initial_scale, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            hysteresis_left=jnp.asarray(hysteresis, jnp.int32),
+            skipped_total=jnp.zeros((), jnp.int32),
+            last_skipped=jnp.zeros((), bool),
+        )
+        return (s, inner.init(params))
+
+    def update(grads, state, params=None):
+        s, istate = state
+        inv = (1.0 / s.loss_scale).astype(jnp.float32)
+        unscaled = jax.tree.map(
+            lambda g: g.astype(jnp.float32) * inv, grads
+        )
+        finite = jnp.array(True)
+        for g in jax.tree_util.tree_leaves(unscaled):
+            finite &= jnp.all(jnp.isfinite(g))
+        found_inf = ~finite
+
+        # inner chain always runs (on zeroed grads when overflowed) so both
+        # outcomes share one trace; selects discard the poisoned results.
+        safe = jax.tree.map(
+            lambda g: jnp.where(found_inf, jnp.zeros_like(g), g), unscaled
+        )
+        updates, new_istate = inner.update(safe, istate, params)
+        updates = jax.tree.map(
+            lambda u: jnp.where(found_inf, jnp.zeros_like(u), u), updates
+        )
+        new_istate = jax.tree.map(
+            lambda old, new: jnp.where(found_inf, old, new), istate, new_istate
+        )
+
+        if constant:
+            new_s = s._replace(
+                skipped_total=s.skipped_total + found_inf.astype(jnp.int32),
+                last_skipped=found_inf,
+            )
+            return updates, (new_s, new_istate)
+
+        # DynamicGradScaler.update semantics (grad_scaler.py:75-120):
+        # on overflow the growth tracker resets and hysteresis decrements;
+        # once exhausted, EVERY further consecutive overflow backs the scale
+        # off (the tracker is only replenished in the growth branch — the
+        # reference never resets it after a backoff).
+        hyst = jnp.where(found_inf, s.hysteresis_left - 1, s.hysteresis_left)
+        do_backoff = found_inf & (hyst <= 0)
+        scale = jnp.where(
+            do_backoff,
+            jnp.maximum(s.loss_scale * backoff_factor, min_scale),
+            s.loss_scale,
+        )
+        growth = jnp.where(found_inf, 0, s.growth_tracker + 1)
+        do_grow = growth >= growth_interval
+        scale = jnp.where(do_grow, scale * growth_factor, scale)
+        growth = jnp.where(do_grow, 0, growth)
+        hyst = jnp.where(do_grow, jnp.asarray(hysteresis, jnp.int32), hyst)
+
+        new_s = ScalerState(
+            loss_scale=scale,
+            growth_tracker=growth,
+            hysteresis_left=hyst,
+            skipped_total=s.skipped_total + found_inf.astype(jnp.int32),
+            last_skipped=found_inf,
+        )
+        return updates, (new_s, new_istate)
+
+    return optax.GradientTransformation(init, update)
+
+
+def find_scaler_state(opt_state: Any) -> Optional[ScalerState]:
+    """Locate the ScalerState in an optax state tree (None when not scaling).
+
+    optax states are (nested) tuples/namedtuples, so a structural walk
+    suffices and works on both concrete and eval_shape trees.
+    """
+    if isinstance(opt_state, ScalerState):
+        return opt_state
+    if isinstance(opt_state, (tuple, list)):
+        for item in opt_state:
+            found = find_scaler_state(item)
+            if found is not None:
+                return found
+    return None
+
+
+def scaler_from_config(cfg, inner: optax.GradientTransformation):
+    """Apply the reference's flag bundle (arguments fp16 group +
+    optimizer/__init__.py:99-122 scaler selection)."""
+    t = cfg.training
+    if t.params_dtype != "float16":
+        return inner
+    if t.loss_scale is not None:
+        return with_loss_scaling(
+            inner, initial_scale=t.loss_scale, constant=True
+        )
+    return with_loss_scaling(
+        inner,
+        initial_scale=t.initial_loss_scale,
+        min_scale=t.min_loss_scale,
+        growth_interval=t.loss_scale_window,
+        hysteresis=t.hysteresis,
+    )

@@ -68,7 +68,13 @@ def get_optimizer(cfg, params: Any) -> optax.GradientTransformation:
             optax.add_decayed_weights(weight_decay=wd, mask=_no_weight_decay_mask(params))
         )
     chain.append(optax.scale_by_learning_rate(lr_fn))
-    return optax.chain(*chain)
+    opt = optax.chain(*chain)
+    # fp16 wraps the whole chain in loss-scale bookkeeping + skip-on-overflow
+    # (grad_scaler.py + MixedPrecisionOptimizer.step semantics); bf16/fp32
+    # return the chain untouched.
+    from megatron_llm_tpu.optimizer.grad_scaler import scaler_from_config
+
+    return scaler_from_config(cfg, opt)
 
 
 def init_optimizer_state(cfg, params: Any):

@@ -142,13 +142,13 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
 
 def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
                      dropout_key=None, deterministic=True, rope=None,
-                     sp_constraint=None):
+                     sp_constraint=None, num_micro=None):
     """Full pipelined loss over the global batch (microbatched).
 
     batch leaves [gbs, s]; gbs = M * mb. Embedding/head run outside the
     pipeline (see module docstring).
     """
-    M = cfg.parallel.num_micro_batches or 1
+    M = num_micro or cfg.parallel.num_micro_batches or 1
     gbs = batch["tokens"].shape[0]
     assert gbs % M == 0
     mb = gbs // M

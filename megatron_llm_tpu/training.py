@@ -70,12 +70,23 @@ def _train_valid_test_num_samples(cfg):
     return train_samples, eval_samples, t.eval_iters * gbs
 
 
+def _loader_granularity(cfg) -> int:
+    """Batches the loader yields: the full global batch normally, or one
+    micro_batch*dp chunk under batch-size ramp-up (the loop then pulls
+    gbs_t/chunk chunks per iteration as the ramp grows, microbatches.py)."""
+    if cfg.training.rampup_batch_size is not None:
+        return cfg.training.micro_batch_size * (
+            cfg.parallel.data_parallel_size or 1
+        )
+    return cfg.training.global_batch_size
+
+
 def _make_loader_factory(cfg, collate):
     from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
 
-    def loader(ds, consumed):
+    def loader(ds, consumed, batch_size=None):
         return build_pretraining_data_loader(
-            ds, consumed, cfg.training.global_batch_size,
+            ds, consumed, batch_size or _loader_granularity(cfg),
             cfg.data.dataloader_type, cfg.training.seed, collate_fn=collate,
         )
 
@@ -160,11 +171,43 @@ def build_data_iterators(cfg, tokenizer):
 
 def make_eval_step(cfg):
     sp_c = make_sp_constraint(cfg)
+    names = list(cfg.logging.metrics or [])
 
     def eval_step(params, batch):
-        loss, metrics = loss_from_batch(
-            cfg, params, batch, deterministic=True, sp_constraint=sp_c
+        if not names:
+            loss, metrics = loss_from_batch(
+                cfg, params, batch, deterministic=True, sp_constraint=sp_c
+            )
+            return metrics
+        # --metrics path (reference metrics registry computed in loss_func
+        # during validation, finetune.py:183-187): keep the logits around
+        # for argmax metrics.
+        from megatron_llm_tpu.metrics import (
+            MetricInput,
+            compute_metrics,
+            needs_logits,
         )
+        from megatron_llm_tpu.models.language_model import model_forward
+        from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+
+        import jax.numpy as jnp
+
+        logits, _ = model_forward(
+            cfg, params, batch["tokens"],
+            position_ids=batch.get("position_ids"),
+            segment_ids=batch.get("segment_ids"),
+            token_idx=batch.get("token_idx"),
+            deterministic=True, sp_constraint=sp_c,
+        )
+        per_token = softmax_cross_entropy(logits, batch["labels"])
+        mask = batch["loss_mask"].astype(jnp.float32)
+        loss = (per_token * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        inp = MetricInput(
+            batch=batch, per_token_loss=per_token,
+            logits=logits if needs_logits(names) else None,
+        )
+        metrics = {"lm loss": loss}
+        metrics.update(compute_metrics(names, inp))
         return metrics
 
     return jax.jit(eval_step)
@@ -188,10 +231,11 @@ def evaluate(cfg, params, eval_step, data_iterator, max_iters: Optional[int] = N
 
 
 def training_log(cfg, metrics, iteration, step_time, writer, timers,
-                 consumed_samples):
+                 consumed_samples, global_batch_size=None):
     """training_log analog (training.py:462-641)."""
     t = cfg.training
-    tokens_per_step = t.global_batch_size * cfg.data.seq_length
+    gbs = global_batch_size or t.global_batch_size
+    tokens_per_step = gbs * cfg.data.seq_length
     tps = tokens_per_step / step_time if step_time > 0 else 0.0
     flops = model_flops_per_token(cfg) * tps
     loss = float(metrics.get("lm loss", float("nan")))
@@ -201,10 +245,13 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
         f"iteration {iteration:8d}/{t.train_iters or 0:8d} | "
         f"consumed samples: {consumed_samples:12d} | "
         f"elapsed time per iteration (ms): {step_time * 1000:.1f} | "
-        f"learning rate: {lr:.3E} | global batch size: {t.global_batch_size:5d} | "
+        f"learning rate: {lr:.3E} | global batch size: {gbs:5d} | "
         f"lm loss: {loss:.6E} | grad norm: {gnorm:.3f} | "
         f"tokens/sec: {tps:,.0f} | TFLOP/s (model): {flops / 1e12:.1f}"
     )
+    if "loss_scale" in metrics:
+        msg += (f" | loss scale: {float(metrics['loss_scale']):.1f} | "
+                f"skipped iterations: {int(metrics['skipped_iterations']):4d}")
     print(msg, flush=True)
     if writer is not None:
         writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
@@ -212,7 +259,7 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
             writer.add_scalar("learning-rate/learning-rate", lr, iteration)
         writer.add_scalar("grad-norm/grad-norm", gnorm, iteration)
         writer.add_scalar("throughput/tokens-per-sec", tps, iteration)
-        writer.add_scalar("batch-size/batch-size", t.global_batch_size, iteration)
+        writer.add_scalar("batch-size/batch-size", gbs, iteration)
         if cfg.logging.log_timers_to_tensorboard and timers is not None:
             timers.write(writer, iteration)
     if timers is not None and cfg.logging.timing_log_level > 0:
@@ -272,6 +319,7 @@ def pretrain(
                 print(f"WARNING: {e}; training from scratch")
 
         # ---- data ----
+        rebuild_full_loader = None
         if data_iterators_provider is not None:
             train_iter, valid_iter_factory = data_iterators_provider(
                 cfg, tokenizer, consumed_samples
@@ -280,14 +328,25 @@ def pretrain(
             loader, (train_ds, valid_ds, _) = build_data_iterators(cfg, tokenizer)
             train_iter = loader(train_ds, consumed_samples)
             valid_iter_factory = (lambda: loader(valid_ds, 0)) if valid_ds else None
+            # once a batch-size ramp completes, drop back to full-global-batch
+            # loading (no per-iteration chunk concatenation)
+            rebuild_full_loader = lambda consumed: loader(  # noqa: E731
+                train_ds, consumed, cfg.training.global_batch_size
+            )
         else:
             raise ValueError("no data: set cfg.data.data_path or pass a provider")
 
         eval_step = make_eval_step(cfg)
 
         # ---- train loop (_train analog, training.py:654-770) ----
+        from megatron_llm_tpu.microbatches import build_num_microbatches_calculator
+
         t = cfg.training
-        gbs = t.global_batch_size
+        calc = build_num_microbatches_calculator(cfg)
+        rampup = t.rampup_batch_size is not None
+        chunk = _loader_granularity(cfg)
+        # one compiled step per num-microbatches stage (constant: exactly one)
+        step_cache = {cfg.parallel.num_micro_batches or 1: step_fn}
         train_iters = t.train_iters or 0
         exit_reason = "train_iters reached"
         metrics: Dict[str, Any] = {}
@@ -296,9 +355,32 @@ def pretrain(
         while iteration < train_iters:
             if t.skip_train:
                 break
+            calc.update(consumed_samples)
+            gbs = calc.get_current_global_batch_size()
+            num_micro = calc.get()
+            if rampup and gbs == t.global_batch_size and rebuild_full_loader:
+                # ramp finished: switch to full-global-batch loading so the
+                # steady state pays no per-iteration chunk concatenation
+                train_iter = rebuild_full_loader(consumed_samples)
+                rampup = False
+            if num_micro not in step_cache:
+                step_cache[num_micro] = make_jitted_train_step(
+                    cfg, mesh, params, num_micro=num_micro,
+                    optimizer=optimizer, opt_state=opt_state,
+                )[0]
+            cur_step_fn = step_cache[num_micro]
             try:
                 timers("batch-generator", 1).start()
-                batch = next(train_iter)
+                if rampup:
+                    chunks = [next(train_iter) for _ in range(gbs // chunk)]
+                    # token_idx is batch-invariant [s] — never concatenated
+                    batch = {
+                        k: (chunks[0][k] if k == "token_idx"
+                            else np.concatenate([c[k] for c in chunks]))
+                        for k in chunks[0]
+                    }
+                else:
+                    batch = next(train_iter)
                 timers("batch-generator").stop()
             except StopIteration:
                 exit_reason = "data exhausted"
@@ -308,7 +390,7 @@ def pretrain(
             step_start = time.time()
             if iteration not in (t.skip_iters or []):
                 # --skip_iters skips the update (training.py:397-399)
-                params, opt_state, metrics = step_fn(
+                params, opt_state, metrics = cur_step_fn(
                     params, opt_state, shardings["place_batch"](batch),
                     iteration,
                 )
@@ -322,7 +404,7 @@ def pretrain(
             if iteration % cfg.logging.log_interval == 0:
                 avg = float(np.mean(step_times[-cfg.logging.log_interval:]))
                 training_log(cfg, metrics, iteration, avg, writer, timers,
-                             consumed_samples)
+                             consumed_samples, global_batch_size=gbs)
 
             if (cfg.training.eval_interval and valid_iter_factory
                     and iteration % cfg.training.eval_interval == 0):

@@ -67,16 +67,21 @@ def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
 
 
 def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
-                    mesh: Optional[Mesh] = None):
+                    mesh: Optional[Mesh] = None,
+                    num_micro: Optional[int] = None):
     """Build the pure train_step(params, opt_state, batch, iteration, seed).
 
     Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
     (params, opt_state) — the reference's train_step contract
     (training.py:393: loss dict, skipped-iter flag, grad_norm, num_zeros).
+
+    ``num_micro`` overrides cfg.parallel.num_micro_batches (batch-size
+    ramp-up builds one step per stage, microbatches.py semantics).
     """
     sp_constraint = make_sp_constraint(cfg)
     lr_fn = lr_schedule(cfg)
-    num_micro = cfg.parallel.num_micro_batches or 1
+    if num_micro is None:
+        num_micro = cfg.parallel.num_micro_batches or 1
 
     def micro_loss(params, mb, dropout_key, rope):
         deterministic = (
@@ -98,8 +103,18 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
         rope = make_rope_cache(cfg)
         base_key = rng_mod.dropout_key(cfg.training.seed, iteration)
 
+        # fp16: multiply the loss by the current scale (read from the scaler
+        # state inside opt_state); grads are un-scaled in the optimizer wrapper
+        # (optimizer/grad_scaler.py).
+        from megatron_llm_tpu.optimizer.grad_scaler import find_scaler_state
+
+        scaler = find_scaler_state(opt_state)
+        scale = scaler.loss_scale if scaler is not None else jnp.float32(1.0)
+        inv_scale = 1.0 / scale
+
         grad_fn = jax.value_and_grad(
             lambda p, mb, k: micro_loss(p, mb, k, rope)[0]
+            * jax.lax.stop_gradient(scale)
         )
 
         if pp > 1:
@@ -115,8 +130,8 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     cfg, mesh, p, batch,
                     dropout_key=None if deterministic else base_key,
                     deterministic=deterministic, rope=rope,
-                    sp_constraint=sp_constraint,
-                )[0]
+                    sp_constraint=sp_constraint, num_micro=num_micro,
+                )[0] * jax.lax.stop_gradient(scale)
             )(params)
         elif num_micro == 1:
             loss, grads = grad_fn(params, batch, base_key)
@@ -138,7 +153,8 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             grads = jax.tree.map(lambda g: g * inv, g_sum)
             loss = loss_sum * inv
 
-        grad_norm = global_grad_norm(grads)
+        loss = loss * inv_scale  # report the un-scaled loss
+        grad_norm = global_grad_norm(grads) * inv_scale
         updates, new_opt_state = opt.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         metrics = {
@@ -146,19 +162,31 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             "grad_norm": grad_norm,
             "learning_rate": lr_fn(iteration),
         }
+        if scaler is not None:
+            new_scaler = find_scaler_state(new_opt_state)
+            metrics["loss_scale"] = new_scaler.loss_scale
+            metrics["skipped_iterations"] = new_scaler.skipped_total
+            metrics["skipped_iter"] = new_scaler.last_skipped.astype(jnp.int32)
         return new_params, new_opt_state, metrics
 
     return train_step
 
 
-def make_jitted_train_step(cfg, mesh: Mesh, params: Any):
+def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
+                           num_micro: Optional[int] = None,
+                           optimizer: Optional[optax.GradientTransformation] = None,
+                           opt_state: Any = None):
     """Bind shardings and jit. Returns (step_fn, optimizer, shardings dict).
 
     Donates params/opt_state (the XLA analog of the reference's in-place
     param update + contiguous grad buffer reuse, distributed.py:111-157).
+    ``num_micro``/``optimizer``/``opt_state`` overrides support batch-size
+    ramp-up (one compiled step per stage, sharing one optimizer/state).
     """
-    optimizer = get_optimizer(cfg, params)
-    opt_state = optimizer.init(params)
+    if optimizer is None:
+        optimizer = get_optimizer(cfg, params)
+    if opt_state is None:
+        opt_state = optimizer.init(params)
 
     p_shard = param_shardings(mesh, params)
     o_shard = opt_state_shardings(cfg, mesh, params, opt_state)
@@ -166,7 +194,7 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any):
     b_shard = NamedSharding(mesh, data_spec(cp))
     scalar = NamedSharding(mesh, P())
 
-    step = make_train_step(cfg, optimizer, mesh=mesh)
+    step = make_train_step(cfg, optimizer, mesh=mesh, num_micro=num_micro)
     # batch in_sharding is UNSPECIFIED (follows the committed input): batches
     # may carry the [s] token_idx vector whose sharding differs per key —
     # callers place batches with place_batch / batch_shardings.

@@ -1,0 +1,286 @@
+"""fp16 loss scaling, batch-size ramp-up, metrics registry, recompute parity.
+
+Reference analogs: optimizer/grad_scaler.py semantics (growth/backoff/
+hysteresis), megatron/microbatches.py calculators, megatron/metrics.py
+registry, and activation recompute (core/tensor_parallel/random.py:175-245:
+recompute must not change numerics).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from megatron_llm_tpu.models import init_model_params, make_config
+from megatron_llm_tpu.optimizer.grad_scaler import (
+    ScalerState,
+    find_scaler_state,
+    with_loss_scaling,
+)
+
+
+# ---------------------------------------------------------------------------
+# Grad scaler unit tests
+# ---------------------------------------------------------------------------
+
+
+def _params():
+    return {"w": jnp.ones((4,), jnp.float32)}
+
+
+def test_scaler_skips_and_backs_off_on_overflow():
+    opt = with_loss_scaling(
+        optax.sgd(0.1), initial_scale=16.0, min_scale=1.0,
+        hysteresis=2, growth_interval=100,
+    )
+    params = _params()
+    state = opt.init(params)
+    bad = {"w": jnp.full((4,), jnp.inf, jnp.float32)}
+
+    # 1st overflow: hysteresis 2->1, no backoff yet, update zeroed
+    updates, state = opt.update(bad, state, params)
+    s = find_scaler_state(state)
+    assert float(s.loss_scale) == 16.0
+    assert int(s.hysteresis_left) == 1
+    assert bool(s.last_skipped)
+    assert np.all(np.asarray(updates["w"]) == 0.0)
+
+    # 2nd overflow: hysteresis exhausted -> scale halves (tracker is NOT
+    # replenished — only the growth branch resets it, reference
+    # grad_scaler.py:88-106)
+    updates, state = opt.update(bad, state, params)
+    s = find_scaler_state(state)
+    assert float(s.loss_scale) == 8.0
+    assert int(s.hysteresis_left) == 0
+    assert int(s.skipped_total) == 2
+
+    # 3rd consecutive overflow: backs off again immediately
+    updates, state = opt.update(bad, state, params)
+    s = find_scaler_state(state)
+    assert float(s.loss_scale) == 4.0
+
+    # good step: applies the (unscaled) update
+    good = {"w": jnp.full((4,), 4.0 * 2.0, jnp.float32)}  # scaled grads = 2
+    updates, state = opt.update(good, state, params)
+    s = find_scaler_state(state)
+    assert not bool(s.last_skipped)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.1 * 2.0, rtol=1e-6)
+
+
+def test_scaler_growth_after_interval():
+    opt = with_loss_scaling(
+        optax.sgd(0.1), initial_scale=4.0, growth_interval=3, hysteresis=1,
+    )
+    params = _params()
+    state = opt.init(params)
+    good = {"w": jnp.ones((4,), jnp.float32)}
+    for _ in range(3):
+        _, state = opt.update(good, state, params)
+    s = find_scaler_state(state)
+    assert float(s.loss_scale) == 8.0  # doubled after 3 finite steps
+    assert int(s.growth_tracker) == 0
+
+
+def test_scaler_inner_state_frozen_on_skip():
+    opt = with_loss_scaling(optax.adam(0.1), initial_scale=2.0, hysteresis=1)
+    params = _params()
+    state = opt.init(params)
+    good = {"w": jnp.ones((4,), jnp.float32)}
+    _, state = opt.update(good, state, params)
+    mu_before = np.asarray(jax.tree_util.tree_leaves(state[1])[1])
+    bad = {"w": jnp.full((4,), jnp.nan, jnp.float32)}
+    _, state = opt.update(bad, state, params)
+    mu_after = np.asarray(jax.tree_util.tree_leaves(state[1])[1])
+    np.testing.assert_array_equal(mu_before, mu_after)
+
+
+def _tiny_cfg(**kw):
+    defaults = dict(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, vocab_size=256, seq_length=32,
+        max_position_embeddings=64, params_dtype="float32",
+        use_flash_attn=False,
+    )
+    defaults.update(kw)
+    return make_config("llama2", **defaults)
+
+
+def test_fp16_train_step_end_to_end():
+    """fp16 + dynamic scaling: initial 2^32 scale overflows, backs off, and
+    training proceeds with finite reported loss."""
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.training_step import make_jitted_train_step
+
+    cfg = _tiny_cfg(params_dtype="float16")
+    cfg.training.initial_loss_scale = 2.0 ** 20
+    cfg.training.hysteresis = 1
+    cfg.finalize(n_devices=1)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 256)
+    batch = {
+        "tokens": np.asarray(tok[:, :-1]),
+        "labels": np.asarray(tok[:, 1:]),
+        "loss_mask": np.ones((2, 32), np.float32),
+    }
+    with global_mesh(mesh):
+        step, _o, sh = make_jitted_train_step(cfg, mesh, params)
+        p = jax.device_put(params, sh["params"])
+        o = jax.device_put(sh["opt_state_value"], sh["opt_state"])
+        b = sh["place_batch"](batch)
+        scales, losses = [], []
+        for i in range(12):
+            p, o, m = step(p, o, b, jnp.asarray(i))
+            scales.append(float(m["loss_scale"]))
+            losses.append(float(m["lm loss"]))
+    # fp16 at 2^20 scale overflows at least once -> scale backed off
+    assert min(scales) < 2.0 ** 20
+    assert np.isfinite(losses[-1])
+    # un-skipped steps actually train
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Microbatch calculators
+# ---------------------------------------------------------------------------
+
+
+def test_constant_calculator():
+    from megatron_llm_tpu.microbatches import ConstantNumMicroBatches
+
+    c = ConstantNumMicroBatches(16, 2, 2)
+    assert c.get() == 4
+    assert c.get_current_global_batch_size() == 16
+
+
+def test_rampup_calculator_stages():
+    from megatron_llm_tpu.microbatches import RampupBatchsizeNumMicroBatches
+
+    # start 4, +4 per stage, over 80 samples, target 12: stages 4 -> 8 -> 12
+    c = RampupBatchsizeNumMicroBatches(4, 4, 80, 12, 2, 2)
+    assert c.get_current_global_batch_size() == 4
+    assert c.get() == 1
+    c.update(40)
+    assert c.get_current_global_batch_size() == 8
+    assert c.get() == 2
+    c.update(80)
+    assert c.get_current_global_batch_size() == 12
+    c.update(10_000)
+    assert c.get_current_global_batch_size() == 12
+    assert c.get() == 3
+
+
+def test_pretrain_with_rampup(tmp_path):
+    """Driver integration: gbs ramps 4->8, consumed samples accounted."""
+    from megatron_llm_tpu.data.indexed_dataset import make_builder
+    from megatron_llm_tpu.training import pretrain
+
+    prefix = str(tmp_path / "corpus_text_document")
+    rng = np.random.RandomState(0)
+    builder = make_builder(prefix + ".bin", vocab_size=250)
+    for _ in range(80):
+        builder.add_doc(rng.randint(1, 250, size=rng.randint(40, 100)))
+    builder.finalize(prefix + ".idx")
+
+    cfg = _tiny_cfg(vocab_size=256)
+    cfg.data.seq_length = 32
+    cfg.data.data_path = [prefix]
+    cfg.data.tokenizer_type = "NullTokenizer"
+    cfg.training.micro_batch_size = 4
+    cfg.training.global_batch_size = 8
+    cfg.training.rampup_batch_size = (4, 4, 12)  # 4 for 12 samples, then 8
+    cfg.training.train_iters = 6
+    cfg.training.eval_interval = 100
+    cfg.logging.log_interval = 2
+    cfg.finalize(n_devices=1)
+    result = pretrain(cfg)
+    assert result["iteration"] == 6
+    # iterations 1-3 at gbs 4 (0,4,8 consumed), iteration 4+ at gbs 8
+    assert result["consumed_samples"] == 4 * 3 + 8 * 3
+    assert np.isfinite(float(result["last_metrics"]["lm loss"]))
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_values():
+    from megatron_llm_tpu.metrics import MetricInput, compute_metrics
+
+    batch = {
+        "labels": jnp.asarray([[1, 2, 3, 4]]),
+        "loss_mask": jnp.asarray([[1.0, 1.0, 0.0, 1.0]]),
+    }
+    logits = jnp.full((1, 4, 8), -10.0)
+    # argmax correct at positions 0 and 3, wrong at 1 (pos 2 is masked out)
+    logits = logits.at[0, 0, 1].set(10.0)
+    logits = logits.at[0, 1, 7].set(10.0)
+    logits = logits.at[0, 2, 3].set(10.0)
+    logits = logits.at[0, 3, 4].set(10.0)
+    per_token = jnp.asarray([[0.5, 1.0, 99.0, 0.25]])
+    inp = MetricInput(batch=batch, per_token_loss=per_token, logits=logits)
+    out = compute_metrics(["ppl", "accuracy", "count"], inp)
+    np.testing.assert_allclose(
+        float(out["ppl"]), np.exp((0.5 + 1.0 + 0.25) / 3), rtol=1e-6
+    )
+    np.testing.assert_allclose(float(out["accuracy"]), 2.0 / 3.0, rtol=1e-6)
+    np.testing.assert_allclose(float(out["count"]), 3.0, rtol=1e-6)
+
+
+def test_eval_step_with_metrics():
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.training import make_eval_step
+
+    cfg = _tiny_cfg()
+    cfg.logging.metrics = ["ppl", "accuracy"]
+    cfg.finalize(n_devices=1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 256)
+    batch = {
+        "tokens": tok[:, :-1],
+        "labels": tok[:, 1:],
+        "loss_mask": jnp.ones((2, 32), jnp.float32),
+    }
+    with global_mesh(build_mesh(devices=jax.devices()[:1])):
+        eval_step = make_eval_step(cfg)
+        m = eval_step(params, batch)
+    assert set(m) >= {"lm loss", "ppl", "accuracy"}
+    np.testing.assert_allclose(
+        float(m["ppl"]), np.exp(float(m["lm loss"])), rtol=1e-5
+    )
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Activation recompute parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("granularity", ["full", "selective"])
+def test_recompute_grads_match_no_recompute(granularity):
+    from megatron_llm_tpu.models.language_model import loss_from_batch
+
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 256)
+    batch = {
+        "tokens": tok[:, :-1],
+        "labels": tok[:, 1:],
+        "loss_mask": jnp.ones((2, 32), jnp.float32),
+    }
+
+    def grads_for(gran):
+        cfg = _tiny_cfg()
+        cfg.parallel.recompute_granularity = gran
+        cfg.finalize(n_devices=1)
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        return jax.grad(lambda p: loss_from_batch(cfg, p, batch)[0])(params)
+
+    g_ref = grads_for(None)
+    g_remat = grads_for(granularity)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
