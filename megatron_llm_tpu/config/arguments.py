@@ -66,8 +66,14 @@ class ModelConfig:
     use_scaled_init_method: bool = True
     # LIMA per-layer dropout: linearly ramp hidden_dropout from 0 to value.
     lima_dropout: bool = False
-    # use learned absolute position embeddings in addition (bert/gpt legacy)
+    # BERT next-sentence/sentence-order binary head (bert_model.py:125)
     bert_binary_head: bool = False
+    # bidirectional (non-causal) self-attention — BERT / T5 encoder
+    bidirectional: bool = False
+    # number of token-type (segment) embeddings; 0 disables (BERT uses 2)
+    num_tokentypes: int = 0
+    # T5: decoder depth (None = num_layers); decoder layers get cross-attention
+    decoder_num_layers: Optional[int] = None
 
     def finalize(self) -> None:
         if self.kv_channels is None:
@@ -200,6 +206,7 @@ class DataConfig:
     valid_data_path: List[str] = field(default_factory=list)
     test_data_path: List[str] = field(default_factory=list)
     seq_length: int = 2048
+    decoder_seq_length: Optional[int] = None  # T5 decoder length
     num_workers: int = 2
     tokenizer_type: str = "SentencePieceTokenizer"
     vocab_file: Optional[str] = None
@@ -375,6 +382,25 @@ ARCH_DEFAULTS = {
         tie_embed_logits=True,
         position_embedding_type="rotary",
         parallel_attn=True,
+    ),
+    # bert_model.py: bidirectional, learned positions, tokentypes, binary head
+    "bert": dict(
+        use_rms_norm=False,
+        glu_activation=None,
+        use_bias=True,
+        tie_embed_logits=True,
+        position_embedding_type="absolute",
+        bidirectional=True,
+        num_tokentypes=2,
+        bert_binary_head=True,
+    ),
+    # t5_model.py: encoder-decoder, learned positions, tied embeddings
+    "t5": dict(
+        use_rms_norm=False,
+        glu_activation=None,
+        use_bias=True,
+        tie_embed_logits=True,
+        position_embedding_type="absolute",
     ),
     # mistral_model.py:30: llama2 bundle + sliding window 4096
     "mistral": dict(

@@ -1,0 +1,159 @@
+"""BERT pretraining dataset: masked LM + next/random-sentence pairs.
+
+Reference: megatron/data/bert_dataset.py (BertDataset, build_training_sample)
++ megatron/data/dataset_utils.py:187-420 (create_masked_lm_predictions —
+15% selection, 80% [MASK] / 10% random / 10% keep — and pair packing with
+[CLS]/[SEP] + tokentypes). Simplification vs reference: segments are split
+from token-level documents at a random pivot rather than re-binned from a
+sentence index — the masking/pair semantics and output schema (text, types,
+labels, is_random, loss_mask, padding_mask) are identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def create_masked_lm_predictions(
+    tokens: np.ndarray,
+    vocab_size: int,
+    mask_id: int,
+    rng: np.random.RandomState,
+    masked_lm_prob: float = 0.15,
+    max_predictions_per_seq: int = 20,
+    special_ids: Sequence[int] = (),
+):
+    """dataset_utils.py:187-333 semantics: choose ~15% of non-special
+    positions; replace 80% with [MASK], 10% with a random token, keep 10%.
+
+    Returns (output_tokens, masked_positions, masked_labels).
+    """
+    special = set(int(t) for t in special_ids)
+    cand = [i for i, t in enumerate(tokens) if int(t) not in special]
+    rng.shuffle(cand)
+    num_to_predict = min(
+        max_predictions_per_seq,
+        max(1, int(round(len(cand) * masked_lm_prob))),
+    )
+    picked = sorted(cand[:num_to_predict])
+    out = tokens.copy()
+    labels = []
+    for pos in picked:
+        labels.append(int(tokens[pos]))
+        r = rng.random_sample()
+        if r < 0.8:
+            out[pos] = mask_id
+        elif r < 0.9:
+            out[pos] = rng.randint(0, vocab_size)
+        # else: keep original
+    return out, np.asarray(picked, np.int64), np.asarray(labels, np.int64)
+
+
+def build_training_sample(
+    tokens_a: np.ndarray,
+    tokens_b: np.ndarray,
+    is_random: bool,
+    max_seq_length: int,
+    vocab_size: int,
+    cls_id: int,
+    sep_id: int,
+    mask_id: int,
+    pad_id: int,
+    rng: np.random.RandomState,
+    masked_lm_prob: float = 0.15,
+    binary_head: bool = True,
+) -> Dict[str, np.ndarray]:
+    """bert_dataset.py build_training_sample analog: pack
+    [CLS] A [SEP] B [SEP], types 0/1, mask, pad."""
+    max_tokens = max_seq_length - (3 if binary_head else 2)
+    # truncate the longer segment first (dataset_utils truncate_segments)
+    a, b = list(tokens_a), list(tokens_b) if binary_head else []
+    while len(a) + len(b) > max_tokens:
+        (a if len(a) >= len(b) else b).pop()
+    tokens = [cls_id] + a + [sep_id] + (b + [sep_id] if binary_head else [])
+    types = [0] * (len(a) + 2) + ([1] * (len(b) + 1) if binary_head else [])
+    tokens = np.asarray(tokens, np.int64)
+
+    max_pred = max(1, int(round(masked_lm_prob * len(tokens))))
+    out, positions, masked_labels = create_masked_lm_predictions(
+        tokens, vocab_size, mask_id, rng,
+        masked_lm_prob=masked_lm_prob,
+        max_predictions_per_seq=max_pred,
+        special_ids=(cls_id, sep_id),
+    )
+
+    n = len(out)
+    pad = max_seq_length - n
+    text = np.full((max_seq_length,), pad_id, np.int64)
+    text[:n] = out
+    types_arr = np.zeros((max_seq_length,), np.int64)
+    types_arr[:n] = types
+    labels = np.full((max_seq_length,), -1, np.int64)
+    loss_mask = np.zeros((max_seq_length,), np.float32)
+    labels[positions] = masked_labels
+    loss_mask[positions] = 1.0
+    padding_mask = np.zeros((max_seq_length,), np.float32)
+    padding_mask[:n] = 1.0
+    return {
+        "text": text,
+        "types": types_arr,
+        # -1 ignore-labels clamp to 0 for the CE gather; loss_mask zeroes them
+        "labels": np.maximum(labels, 0),
+        "loss_mask": loss_mask,
+        "padding_mask": padding_mask,
+        "is_random": np.int64(is_random),
+        "truncated": np.int64(pad < 0),
+    }
+
+
+class BertDataset:
+    """Masked-LM dataset over an indexed token dataset.
+
+    Each sample: segment A = first part of doc i, segment B = rest of doc i
+    (50%) or a slice of a random other doc (50%, is_random=1) — the NSP pair
+    construction of bert_dataset.py:get_samples_mapping + build_training_sample.
+    """
+
+    def __init__(self, indexed, num_samples: int, max_seq_length: int,
+                 vocab_size: int, cls_id: int, sep_id: int, mask_id: int,
+                 pad_id: int, seed: int = 1234, masked_lm_prob: float = 0.15,
+                 binary_head: bool = True):
+        self.indexed = indexed
+        self.num_samples = num_samples
+        self.max_seq_length = max_seq_length
+        self.vocab_size = vocab_size
+        self.cls_id, self.sep_id = cls_id, sep_id
+        self.mask_id, self.pad_id = mask_id, pad_id
+        self.seed = seed
+        self.masked_lm_prob = masked_lm_prob
+        self.binary_head = binary_head
+        self.num_docs = len(indexed)
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rng = np.random.RandomState(self.seed + int(idx))
+        doc = np.asarray(self.indexed[int(idx) % self.num_docs])
+        if len(doc) < 4:
+            doc = np.resize(doc, (4,))
+        pivot = rng.randint(1, len(doc))
+        a = doc[:pivot]
+        is_random = False
+        if self.binary_head and (rng.random_sample() < 0.5 or pivot == len(doc)):
+            other = np.asarray(
+                self.indexed[rng.randint(0, self.num_docs)]
+            )
+            if len(other) < 2:
+                other = np.resize(other, (2,))
+            b = other[rng.randint(0, len(other) - 1):]
+            is_random = True
+        else:
+            b = doc[pivot:]
+        return build_training_sample(
+            a, b, is_random, self.max_seq_length, self.vocab_size,
+            self.cls_id, self.sep_id, self.mask_id, self.pad_id, rng,
+            masked_lm_prob=self.masked_lm_prob, binary_head=self.binary_head,
+        )

@@ -235,6 +235,40 @@ def build_train_valid_test_datasets(
     )
 
 
+class DocRangeView:
+    """Document-level view over an indexed dataset restricted to a doc range
+    (the BERT/T5 datasets sample whole documents, not token windows)."""
+
+    def __init__(self, indexed, documents: np.ndarray):
+        self.indexed = indexed
+        self.documents = documents
+
+    def __len__(self):
+        return len(self.documents)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return np.asarray(self.indexed[int(self.documents[int(idx)])])
+
+
+def get_split_indexed_datasets(data_prefix: Sequence[str], splits_string: str,
+                               data_impl: str = "mmap"):
+    """Split an indexed dataset's documents into train/valid/test doc views
+    (dataset_utils.py:get_train_valid_test_split_ applied at doc level, the
+    entry path of the BERT/T5 dataset builders, dataset_utils.py:421)."""
+    assert len(data_prefix) == 1, "BERT/T5 datasets take a single data prefix"
+    indexed = make_dataset(data_prefix[0], data_impl, skip_warmup=True)
+    total_docs = indexed.doc_idx.shape[0] - 1
+    splits = get_train_valid_test_split_(splits_string, total_docs)
+    out = []
+    for i in range(3):
+        if splits[i + 1] > splits[i]:
+            docs = np.arange(splits[i], splits[i + 1], dtype=np.int64)
+            out.append(DocRangeView(indexed, docs))
+        else:
+            out.append(None)
+    return tuple(out)
+
+
 def _normalize_blend(data_prefix, nums):
     assert len(data_prefix) % 2 == 0, "blend list must be [w, path, w, path, ...]"
     weights = np.array([float(w) for w in data_prefix[::2]])

@@ -1,0 +1,140 @@
+"""T5 span-corruption dataset.
+
+Reference: megatron/data/t5_dataset.py (T5Dataset, build_training_sample with
+masked-span prediction over sentinel tokens) via
+dataset_utils.create_masked_lm_predictions(max_ngrams=10, geometric-ish span
+lengths). Schema matches the reference batch keys: text_enc, text_dec,
+labels, loss_mask, enc_mask, dec_mask.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def corrupt_spans(
+    tokens: np.ndarray,
+    sentinel_ids: List[int],
+    rng: np.random.RandomState,
+    noise_density: float = 0.15,
+    mean_span_length: float = 3.0,
+):
+    """Select ~noise_density of tokens in spans (mean length ~3) and replace
+    each span with one sentinel; returns (enc_input, target).
+
+    target = [sentinel_0, span_0 ..., sentinel_1, span_1 ..., ...]
+    """
+    n = len(tokens)
+    num_noise = max(1, int(round(n * noise_density)))
+    num_spans = max(1, int(round(num_noise / mean_span_length)))
+    num_spans = min(num_spans, len(sentinel_ids), num_noise)
+
+    # choose span start positions/lengths without overlap: pick distinct
+    # positions, merge adjacent
+    starts = np.sort(rng.choice(n, size=num_spans, replace=False))
+    spans = []
+    budget = num_noise
+    for i, st in enumerate(starts):
+        if spans and st <= spans[-1][1]:
+            continue
+        remaining_spans = num_spans - len(spans)
+        ln = max(1, int(round(budget / max(remaining_spans, 1))))
+        end = min(st + ln, n)
+        if i + 1 < len(starts):
+            end = min(end, starts[i + 1])
+        spans.append((st, end))
+        budget -= end - st
+        if budget <= 0:
+            break
+
+    enc, target = [], []
+    cursor = 0
+    for si, (st, end) in enumerate(spans):
+        enc.extend(tokens[cursor:st].tolist())
+        enc.append(sentinel_ids[si])
+        target.append(sentinel_ids[si])
+        target.extend(tokens[st:end].tolist())
+        cursor = end
+    enc.extend(tokens[cursor:].tolist())
+    return np.asarray(enc, np.int64), np.asarray(target, np.int64)
+
+
+def build_training_sample(
+    tokens: np.ndarray,
+    max_seq_length: int,
+    max_seq_length_dec: int,
+    sentinel_ids: List[int],
+    bos_id: int,
+    eos_id: int,
+    pad_id: int,
+    rng: np.random.RandomState,
+    noise_density: float = 0.15,
+    mean_span_length: float = 3.0,
+) -> Dict[str, np.ndarray]:
+    tokens = tokens[: max_seq_length - len(sentinel_ids) - 1]
+    enc, target = corrupt_spans(
+        tokens, sentinel_ids, rng,
+        noise_density=noise_density, mean_span_length=mean_span_length,
+    )
+    target = target[: max_seq_length_dec - 1]
+    dec_in = np.concatenate([[bos_id], target])
+    labels = np.concatenate([target, [eos_id]])
+
+    def pad_to(a, ln):
+        out = np.full((ln,), pad_id, np.int64)
+        out[: len(a)] = a[:ln]
+        return out
+
+    enc_mask = np.zeros((max_seq_length,), np.float32)
+    enc_mask[: len(enc)] = 1.0
+    dec_mask = np.zeros((max_seq_length_dec,), np.float32)
+    dec_mask[: len(dec_in)] = 1.0
+    loss_mask = np.zeros((max_seq_length_dec,), np.float32)
+    loss_mask[: len(labels)] = 1.0
+    return {
+        "text_enc": pad_to(enc, max_seq_length),
+        "text_dec": pad_to(dec_in, max_seq_length_dec),
+        "labels": pad_to(labels, max_seq_length_dec),
+        "loss_mask": loss_mask,
+        "enc_mask": enc_mask,
+        "dec_mask": dec_mask,
+    }
+
+
+class T5Dataset:
+    """Span-corruption dataset over an indexed token dataset
+    (t5_dataset.py:T5Dataset analog; sentinels = the --vocab_extra_ids range,
+    tokenizer.py additional special tokens)."""
+
+    def __init__(self, indexed, num_samples: int, max_seq_length: int,
+                 max_seq_length_dec: int, sentinel_ids: List[int],
+                 bos_id: int, eos_id: int, pad_id: int, seed: int = 1234,
+                 noise_density: float = 0.15, mean_span_length: float = 3.0):
+        assert sentinel_ids, "T5 needs sentinel ids (--vocab_extra_ids)"
+        self.indexed = indexed
+        self.num_samples = num_samples
+        self.max_seq_length = max_seq_length
+        self.max_seq_length_dec = max_seq_length_dec
+        self.sentinel_ids = list(sentinel_ids)
+        self.bos_id, self.eos_id, self.pad_id = bos_id, eos_id, pad_id
+        self.seed = seed
+        self.noise_density = noise_density
+        self.mean_span_length = mean_span_length
+        self.num_docs = len(indexed)
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        rng = np.random.RandomState(self.seed + int(idx))
+        doc = np.asarray(self.indexed[int(idx) % self.num_docs])
+        if len(doc) < 8:
+            doc = np.resize(doc, (8,))
+        return build_training_sample(
+            doc, self.max_seq_length, self.max_seq_length_dec,
+            self.sentinel_ids, self.bos_id, self.eos_id, self.pad_id, rng,
+            noise_density=self.noise_density,
+            mean_span_length=self.mean_span_length,
+        )

@@ -1,0 +1,134 @@
+"""BERT: bidirectional masked-LM with NSP/SOP binary head.
+
+Reference: megatron/model/bert_model.py — ``BertLMHead``:47 (dense h->h +
+gelu + LN + tied-embedding logits + vocab bias), ``BertModel``:125 (pooler +
+binary head, bert_extended_attention_mask), loss in pretrain_bert.py
+(masked-LM CE + sentence-order binary CE). TPU-native: pure functions over a
+params pytree; padding is an explicit additive attention bias (no 4D byte
+mask materialization).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.models.language_model import (
+    _compute_dtype,
+    embed_tokens,
+    init_model_params,
+)
+from megatron_llm_tpu.models.transformer import transformer_forward
+from megatron_llm_tpu.ops.attention import NEG_INF
+from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.norms import init_norm_params, norm
+
+Params = Dict[str, Any]
+
+
+def init_bert_params(cfg, key: jax.Array) -> Params:
+    m = cfg.model
+    params = init_model_params(cfg, key)
+    h = m.hidden_size
+    v = params["embedding"]["word_embeddings"].shape[0]
+    k1, k2, k3 = jax.random.split(jax.random.fold_in(key, 7), 3)
+    std = m.init_method_std
+    # BertLMHead (bert_model.py:47-90): transform + LN + vocab bias; logits
+    # come through the tied word-embedding matrix.
+    params["mlm_head"] = {
+        "dense": {
+            "kernel": std * jax.random.normal(k1, (h, h), jnp.float32),
+            "bias": jnp.zeros((h,), jnp.float32),
+        },
+        "norm": init_norm_params(h, m.use_rms_norm),
+        "vocab_bias": jnp.zeros((v,), jnp.float32),
+    }
+    if m.bert_binary_head:
+        # Pooler (language_model.py pooler) + binary head (bert_model.py:162)
+        params["pooler"] = {
+            "kernel": std * jax.random.normal(k2, (h, h), jnp.float32),
+            "bias": jnp.zeros((h,), jnp.float32),
+        }
+        params["binary_head"] = {
+            "kernel": std * jax.random.normal(k3, (h, 2), jnp.float32),
+            "bias": jnp.zeros((2,), jnp.float32),
+        }
+    return params
+
+
+def padding_bias(padding_mask: jax.Array) -> jax.Array:
+    """[b, s] 1=real/0=pad -> additive bias [b, 1, 1, s]: every query may
+    attend to every non-pad key (bert_extended_attention_mask semantics)."""
+    keep = padding_mask.astype(bool)[:, None, None, :]
+    return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def bert_forward(
+    cfg,
+    params: Params,
+    tokens: jax.Array,             # [b, s]
+    padding_mask: jax.Array,       # [b, s] 1=real token
+    tokentype_ids: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Returns (lm_logits [b, s, v], binary_logits [b, 2] or None)."""
+    m = cfg.model
+    hidden = embed_tokens(cfg, params, tokens, tokentype_ids=tokentype_ids)
+    bias = padding_bias(padding_mask)
+    hidden, _ = transformer_forward(
+        cfg, params["layers"], hidden,
+        attn_bias=bias,
+        dropout_key=dropout_key, deterministic=deterministic,
+    )
+    hidden = norm(hidden, params["final_norm"], m.layernorm_epsilon,
+                  m.use_rms_norm)
+
+    # MLM head
+    head = params["mlm_head"]
+    x = hidden @ head["dense"]["kernel"].astype(hidden.dtype)
+    x = x + head["dense"]["bias"].astype(hidden.dtype)
+    x = jax.nn.gelu(x, approximate=False)
+    x = norm(x, head["norm"], m.layernorm_epsilon, m.use_rms_norm)
+    emb = params["embedding"]["word_embeddings"].astype(x.dtype)
+    lm_logits = x @ emb.T + head["vocab_bias"].astype(x.dtype)
+
+    binary_logits = None
+    if m.bert_binary_head:
+        pooled = jnp.tanh(
+            hidden[:, 0] @ params["pooler"]["kernel"].astype(hidden.dtype)
+            + params["pooler"]["bias"].astype(hidden.dtype)
+        )
+        binary_logits = (
+            pooled @ params["binary_head"]["kernel"].astype(pooled.dtype)
+            + params["binary_head"]["bias"].astype(pooled.dtype)
+        )
+    return lm_logits, binary_logits
+
+
+def bert_loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
+                         dropout_key=None, deterministic=True,
+                         rope_cache=None, sp_constraint=None):
+    """pretrain_bert.py loss: masked-LM CE over masked positions + binary
+    sentence-order CE (forward_step at pretrain_bert.py:40-80)."""
+    lm_logits, binary_logits = bert_forward(
+        cfg, params, batch["text"], batch["padding_mask"],
+        tokentype_ids=batch.get("types"),
+        dropout_key=dropout_key, deterministic=deterministic,
+    )
+    per_token = softmax_cross_entropy(lm_logits, batch["labels"])
+    mask = batch["loss_mask"].astype(jnp.float32)
+    lm_loss = (per_token * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    metrics = {"lm loss": lm_loss}
+    loss = lm_loss
+    if binary_logits is not None and "is_random" in batch:
+        logp = jax.nn.log_softmax(binary_logits.astype(jnp.float32), axis=-1)
+        sop = -jnp.take_along_axis(
+            logp, batch["is_random"][:, None].astype(jnp.int32), axis=-1
+        ).mean()
+        metrics["sop loss"] = sop
+        loss = loss + sop
+    metrics["loss"] = loss
+    return loss, metrics

@@ -61,6 +61,13 @@ def init_model_params(cfg, key: jax.Array) -> Params:
         params["embedding"]["position_embeddings"] = m.init_method_std * (
             jax.random.normal(k_pos, (m.max_position_embeddings, h), jnp.float32)
         )
+    if m.num_tokentypes > 0:
+        # BERT segment embeddings (reference Embedding tokentype path,
+        # language_model.py:173-183)
+        k_tt = jax.random.fold_in(k_pos, 1)
+        params["embedding"]["tokentype_embeddings"] = m.init_method_std * (
+            jax.random.normal(k_tt, (m.num_tokentypes, h), jnp.float32)
+        )
     if not m.tie_embed_logits:
         # untied lm_head (language_model.py:436-457)
         params["lm_head"] = {
@@ -83,13 +90,19 @@ def make_rope_cache(cfg) -> Optional[Tuple[jax.Array, jax.Array]]:
 
 
 def embed_tokens(
-    cfg, params: Params, tokens: jax.Array, position_ids: Optional[jax.Array]
+    cfg, params: Params, tokens: jax.Array,
+    position_ids: Optional[jax.Array] = None,
+    tokentype_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     emb = params["embedding"]["word_embeddings"]
     hidden = jnp.take(emb, tokens, axis=0)
     if cfg.model.position_embedding_type == "absolute":
         pos = position_ids if position_ids is not None else jnp.arange(tokens.shape[1])[None]
         hidden = hidden + jnp.take(params["embedding"]["position_embeddings"], pos, axis=0)
+    if tokentype_ids is not None:
+        hidden = hidden + jnp.take(
+            params["embedding"]["tokentype_embeddings"], tokentype_ids, axis=0
+        )
     return hidden.astype(_compute_dtype(cfg))
 
 

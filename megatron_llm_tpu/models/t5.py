@@ -1,0 +1,125 @@
+"""T5: encoder-decoder span-corruption model.
+
+Reference: megatron/model/t5_model.py — ``T5LMHead``:40 (tied-embedding
+logits + bias), ``T5Model``:70 (encoder + decoder with cross-attention,
+attention masks from t5_model.py:21-37). TPU-native: the encoder and decoder
+are two stacked-layer scans sharing one embedding table; masking is explicit
+additive biases (bidirectional+pad for the encoder, causal+pad for the
+decoder self-attention, pad-only for cross attention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.models.bert import padding_bias
+from megatron_llm_tpu.models.language_model import (
+    embed_tokens,
+    init_model_params,
+)
+from megatron_llm_tpu.models.transformer import (
+    init_stacked_layers,
+    transformer_forward,
+)
+from megatron_llm_tpu.ops.attention import NEG_INF
+from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.norms import init_norm_params, norm
+
+Params = Dict[str, Any]
+
+
+def init_t5_params(cfg, key: jax.Array) -> Params:
+    """Encoder stack reuses init_model_params' layers; the decoder stack is a
+    second scan with cross-attention blocks."""
+    m = cfg.model
+    params = init_model_params(cfg, key)
+    k_dec, k_head = jax.random.split(jax.random.fold_in(key, 11))
+    dec_layers = m.decoder_num_layers or m.num_layers
+    params["decoder_layers"] = init_stacked_layers(
+        cfg, k_dec, num_layers=dec_layers, cross_attention=True
+    )
+    params["decoder_final_norm"] = init_norm_params(
+        m.hidden_size, m.use_rms_norm
+    )
+    v = params["embedding"]["word_embeddings"].shape[0]
+    # T5LMHead bias (t5_model.py:40-66); logits via tied embedding
+    params["lm_head_bias"] = jnp.zeros((v,), jnp.float32)
+    return params
+
+
+def causal_padding_bias(padding_mask: jax.Array) -> jax.Array:
+    """[b, s] -> additive bias [b, 1, s, s]: causal AND non-pad
+    (t5_model.py:21-30 attention mask composition)."""
+    s = padding_mask.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    keep = causal[None] & padding_mask.astype(bool)[:, None, :]
+    return jnp.where(keep[:, None], 0.0, NEG_INF).astype(jnp.float32)
+
+
+def cross_bias(dec_mask: jax.Array, enc_mask: jax.Array) -> jax.Array:
+    """[b, sd], [b, se] -> [b, 1, sd, se]: decoder queries attend non-pad
+    encoder keys."""
+    keep = enc_mask.astype(bool)[:, None, None, :]
+    return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def t5_forward(
+    cfg,
+    params: Params,
+    encoder_tokens: jax.Array,   # [b, se]
+    decoder_tokens: jax.Array,   # [b, sd]
+    encoder_padding_mask: jax.Array,  # [b, se] 1=real
+    decoder_padding_mask: jax.Array,  # [b, sd]
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Returns decoder lm_logits [b, sd, v]."""
+    m = cfg.model
+    if dropout_key is not None:
+        dk_enc, dk_dec = jax.random.split(dropout_key)
+    else:
+        dk_enc = dk_dec = None
+
+    # ---- encoder (bidirectional + pad bias) ----
+    enc_hidden = embed_tokens(cfg, params, encoder_tokens)
+    enc_hidden, _ = transformer_forward(
+        cfg, params["layers"], enc_hidden,
+        attn_bias=padding_bias(encoder_padding_mask),
+        dropout_key=dk_enc, deterministic=deterministic,
+    )
+    enc_hidden = norm(enc_hidden, params["final_norm"], m.layernorm_epsilon,
+                      m.use_rms_norm)
+
+    # ---- decoder (causal self-attn + cross-attn over encoder) ----
+    dec_hidden = embed_tokens(cfg, params, decoder_tokens)
+    dec_hidden, _ = transformer_forward(
+        cfg, params["decoder_layers"], dec_hidden,
+        attn_bias=causal_padding_bias(decoder_padding_mask),
+        encoder_hidden=enc_hidden,
+        enc_bias=cross_bias(decoder_padding_mask, encoder_padding_mask),
+        dropout_key=dk_dec, deterministic=deterministic,
+    )
+    dec_hidden = norm(dec_hidden, params["decoder_final_norm"],
+                      m.layernorm_epsilon, m.use_rms_norm)
+
+    emb = params["embedding"]["word_embeddings"].astype(dec_hidden.dtype)
+    return dec_hidden @ emb.T + params["lm_head_bias"].astype(dec_hidden.dtype)
+
+
+def t5_loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
+                       dropout_key=None, deterministic=True,
+                       rope_cache=None, sp_constraint=None):
+    """pretrain_t5.py loss: CE over decoder targets at loss-masked positions."""
+    logits = t5_forward(
+        cfg, params,
+        batch["text_enc"], batch["text_dec"],
+        batch["enc_mask"], batch["dec_mask"],
+        dropout_key=dropout_key, deterministic=deterministic,
+    )
+    per_token = softmax_cross_entropy(logits, batch["labels"])
+    mask = batch["loss_mask"].astype(jnp.float32)
+    loss = (per_token * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss, {"lm loss": loss}

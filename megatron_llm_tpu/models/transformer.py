@@ -55,7 +55,7 @@ def _normal(key, shape, std, dtype=jnp.float32):
     return std * jax.random.normal(key, shape, dtype=dtype)
 
 
-def init_layer_params(cfg, key: jax.Array) -> Params:
+def init_layer_params(cfg, key: jax.Array, cross_attention: bool = False) -> Params:
     m = cfg.model
     h = m.hidden_size
     d = m.kv_channels
@@ -67,7 +67,7 @@ def init_layer_params(cfg, key: jax.Array) -> Params:
     # (reference model/utils.py scaled_init_method_normal)
     out_std = std / (2.0 * m.num_layers) ** 0.5 if m.use_scaled_init_method else std
 
-    k = jax.random.split(key, 4)
+    k = jax.random.split(key, 7)
     p: Params = {
         "input_norm": init_norm_params(h, m.use_rms_norm),
         "attention": {
@@ -87,6 +87,20 @@ def init_layer_params(cfg, key: jax.Array) -> Params:
         p["post_norm"] = init_norm_params(h, m.use_rms_norm)
     if m.parallel_layernorm:
         p["mlp_norm"] = init_norm_params(h, m.use_rms_norm)
+    if cross_attention:
+        # T5 decoder inter-attention (reference t5_model.py via
+        # ParallelAttention attn_type=cross, transformer.py:280): separate Q
+        # and fused-KV projections over the encoder output.
+        p["cross_attention"] = {
+            "q": {"kernel": _normal(k[4], (h, n * d), std)},
+            "kv": {"kernel": _normal(k[5], (h, 2 * nkv * d), std)},
+            "dense": {"kernel": _normal(k[6], (n * d, h), out_std)},
+        }
+        p["cross_norm"] = init_norm_params(h, m.use_rms_norm)
+        if m.use_bias:
+            p["cross_attention"]["q"]["bias"] = jnp.zeros((n * d,), jnp.float32)
+            p["cross_attention"]["kv"]["bias"] = jnp.zeros((2 * nkv * d,), jnp.float32)
+            p["cross_attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
     if m.use_bias:
         p["attention"]["qkv"]["bias"] = jnp.zeros(((n + 2 * nkv) * d,), jnp.float32)
         p["attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
@@ -95,11 +109,14 @@ def init_layer_params(cfg, key: jax.Array) -> Params:
     return p
 
 
-def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None) -> Params:
+def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None,
+                        cross_attention: bool = False) -> Params:
     """Stack per-layer params on axis 0 (for lax.scan / per-stage pipelines)."""
     L = num_layers if num_layers is not None else cfg.model.num_layers
     keys = jax.random.split(key, L)
-    return jax.vmap(lambda kk: init_layer_params(cfg, kk))(keys)
+    return jax.vmap(
+        lambda kk: init_layer_params(cfg, kk, cross_attention=cross_attention)
+    )(keys)
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +156,7 @@ def attention_sublayer(
     kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
     cache_index: Optional[jax.Array] = None,
     token_idx: Optional[jax.Array] = None,
+    attn_bias: Optional[jax.Array] = None,
 ):
     """ParallelAttention analog (transformer.py:280-657).
 
@@ -182,10 +200,11 @@ def attention_sublayer(
     else:
         ctx = attn_ops.attention(
             q, k, v,
-            causal=True,
+            causal=not m.bidirectional,
             sliding_window=m.sliding_window_size,
             segment_ids=segment_ids,
             token_idx=token_idx,
+            bias=attn_bias,
             scale=scale,
             use_flash=cfg.training.use_flash_attn,
             dropout_rate=0.0 if deterministic else m.attention_dropout,
@@ -194,6 +213,34 @@ def attention_sublayer(
 
     out = _linear(p["dense"], ctx.reshape(b, s, n * d))
     return out, new_cache
+
+
+def cross_attention_sublayer(
+    cfg,
+    p: Params,
+    x: jax.Array,            # [b, sq, h] (post cross-norm)
+    encoder_hidden: jax.Array,  # [b, skv, h]
+    enc_bias: Optional[jax.Array],  # [b or 1, 1, sq, skv] additive bias
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+):
+    """T5 decoder inter-attention (reference ParallelAttention with
+    attn_type=cross_attn, transformer.py:280-343): Q from the decoder stream,
+    K/V from the encoder output, full (non-causal) attention."""
+    m = cfg.model
+    b, sq, _ = x.shape
+    n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
+    q = _linear(p["q"], x).reshape(b, sq, n, d)
+    kv = _linear(p["kv"], encoder_hidden)
+    skv = encoder_hidden.shape[1]
+    kv = kv.reshape(b, skv, nkv, 2, d)
+    k, v = kv[..., 0, :], kv[..., 1, :]
+    ctx = attn_ops.xla_attention(
+        q, k, v, bias=enc_bias, scale=1.0 / (d ** 0.5),
+        dropout_rate=0.0 if deterministic else m.attention_dropout,
+        dropout_key=dropout_key,
+    )
+    return _linear(p["dense"], ctx.reshape(b, sq, n * d))
 
 
 def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
@@ -230,6 +277,9 @@ def block_forward(
     position_ids=None,
     segment_ids=None,
     token_idx=None,
+    attn_bias=None,
+    encoder_hidden=None,
+    enc_bias=None,
     dropout_key=None,
     deterministic: bool = True,
     hidden_dropout_rate: Optional[float] = None,
@@ -248,18 +298,23 @@ def block_forward(
     eps = m.layernorm_epsilon
     rate = m.hidden_dropout if hidden_dropout_rate is None else hidden_dropout_rate
     if dropout_key is not None:
-        dk_attn, dk_h1, dk_h2 = jax.random.split(dropout_key, 3)
+        dk_attn, dk_h1, dk_h2, dk_x, dk_hx = jax.random.split(dropout_key, 5)
     else:
-        dk_attn = dk_h1 = dk_h2 = None
+        dk_attn = dk_h1 = dk_h2 = dk_x = dk_hx = None
     _sp = sp_constraint if sp_constraint is not None else (lambda t: t)
 
     ln1 = norm(hidden, p["input_norm"], eps, m.use_rms_norm)
     attn_out, new_cache = attention_sublayer(
         cfg, p["attention"], ln1, rope, position_ids, segment_ids,
         dk_attn, deterministic, kv_cache, cache_index, token_idx=token_idx,
+        attn_bias=attn_bias,
     )
 
     if m.parallel_attn:
+        assert "cross_attention" not in p, (
+            "cross-attention layers (T5 decoder) require the sequential "
+            "block; parallel_attn would silently skip the encoder attention"
+        )
         mlp_in = norm(hidden, p["mlp_norm"], eps, m.use_rms_norm) if m.parallel_layernorm else ln1
         mlp_out = mlp_sublayer(cfg, p["mlp"], mlp_in)
         out = hidden + rng_mod.dropout(dk_h1, rate, attn_out, deterministic or dk_h1 is None) \
@@ -268,6 +323,18 @@ def block_forward(
     else:
         resid = hidden + rng_mod.dropout(dk_h1, rate, attn_out, deterministic or dk_h1 is None)
         resid = _sp(resid)
+        if "cross_attention" in p:
+            # decoder inter-attention block (LayerType.decoder,
+            # transformer.py:838-850)
+            lnx = norm(resid, p["cross_norm"], eps, m.use_rms_norm)
+            x_out = cross_attention_sublayer(
+                cfg, p["cross_attention"], lnx, encoder_hidden, enc_bias,
+                dk_x, deterministic,
+            )
+            resid = resid + rng_mod.dropout(
+                dk_hx, rate, x_out, deterministic or dk_hx is None
+            )
+            resid = _sp(resid)
         ln2 = norm(resid, p["post_norm"], eps, m.use_rms_norm)
         mlp_out = mlp_sublayer(cfg, p["mlp"], ln2)
         out = resid + rng_mod.dropout(dk_h2, rate, mlp_out, deterministic or dk_h2 is None)
@@ -304,6 +371,9 @@ def transformer_forward(
     position_ids=None,
     segment_ids=None,
     token_idx=None,
+    attn_bias=None,
+    encoder_hidden=None,
+    enc_bias=None,
     dropout_key=None,
     deterministic: bool = True,
     kv_caches=None,        # stacked [L, ...] pair, or None
@@ -329,6 +399,8 @@ def transformer_forward(
             cfg, layer_params, carry_hidden,
             rope=rope, position_ids=position_ids, segment_ids=segment_ids,
             token_idx=token_idx,
+            attn_bias=attn_bias,
+            encoder_hidden=encoder_hidden, enc_bias=enc_bias,
             dropout_key=dk, deterministic=deterministic,
             hidden_dropout_rate=rate,
             kv_cache=cache, cache_index=cache_index,

@@ -50,14 +50,24 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
 
     if "word_embeddings" in names:
         return P(TP_AXIS, None)  # vocab-parallel (VocabParallelEmbedding)
-    if "position_embeddings" in names:
+    if "position_embeddings" in names or "tokentype_embeddings" in names:
         return P(None, None)
+    if "lm_head_bias" in names or "vocab_bias" in names:
+        return P(TP_AXIS)  # vocab-parallel logits bias (BERT/T5 heads)
+    if "mlm_head" in names or "pooler" in names or "binary_head" in names:
+        # BERT transform/pooler/binary heads: small [h, h]-ish, replicated
+        return P(*lead, *([None] * (ndim - len(lead))))
     if "lm_head" in names:
         return P(None, TP_AXIS)  # column-parallel output head
     if "qkv" in names:
         if names[-1] == "kernel":
             return spec(None, TP_AXIS)  # column-parallel: shard fused head dim
         return spec(TP_AXIS)  # bias
+    if "cross_attention" in names and names[-2] in ("q", "kv"):
+        # T5 decoder inter-attention projections: column-parallel over heads
+        if names[-1] == "kernel":
+            return spec(None, TP_AXIS)
+        return spec(TP_AXIS)
     if "dense" in names:
         if names[-1] == "kernel":
             return spec(TP_AXIS, None)  # row-parallel: shard input (head) dim
@@ -84,7 +94,7 @@ def param_partition_specs(params: Any) -> Any:
 
     def rule(path, leaf):
         names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-        stacked = "layers" in names
+        stacked = "layers" in names or "decoder_layers" in names
         return _spec_for_path(path, leaf.ndim, stacked)
 
     return jax.tree_util.tree_map_with_path(rule, params)
@@ -127,14 +137,25 @@ def data_spec(context_parallel: bool = False) -> P:
 
 
 def batch_shardings(cfg, mesh: Mesh, batch: Any) -> Any:
-    """Per-key shardings for a batch dict ([b, s] tensors; ``token_idx`` is
-    the [s] zigzag index vector, sharded over cp only)."""
+    """Per-key shardings for a batch dict: [b, s] tensors get the data spec,
+    rank-1 per-sample tensors (e.g. BERT ``is_random``) shard over dp only,
+    and ``token_idx`` (the [s] zigzag index vector) shards over cp."""
+    import numpy as np
+
     cp = cfg.parallel.context_parallel_size > 1
     d = NamedSharding(mesh, data_spec(cp))
+    per_sample = NamedSharding(mesh, P(DP_AXIS))
     idx = NamedSharding(mesh, P(CP_AXIS) if cp else P(None))
-    return {
-        k: (idx if k == "token_idx" else d) for k in batch
-    }
+
+    def spec_for(k, v):
+        if k == "token_idx":
+            return idx
+        ndim = getattr(v, "ndim", None)
+        if ndim is None:
+            ndim = np.asarray(v).ndim
+        return per_sample if ndim == 1 else d
+
+    return {k: spec_for(k, v) for k, v in batch.items()}
 
 
 def make_sp_constraint(cfg, mesh: Optional[Mesh] = None):

@@ -169,13 +169,21 @@ def build_data_iterators(cfg, tokenizer):
     return build_gpt_data_iterators(cfg, tokenizer)
 
 
-def make_eval_step(cfg):
+def make_eval_step(cfg, loss_fn=None):
     sp_c = make_sp_constraint(cfg)
     names = list(cfg.logging.metrics or [])
+    if loss_fn is None:
+        loss_fn = loss_from_batch
+
+    if names and loss_fn is not loss_from_batch:
+        raise ValueError(
+            "--metrics currently supports the GPT-family LM loss path only "
+            f"(requested {names} with a custom loss_fn)"
+        )
 
     def eval_step(params, batch):
         if not names:
-            loss, metrics = loss_from_batch(
+            loss, metrics = loss_fn(
                 cfg, params, batch, deterministic=True, sp_constraint=sp_c
             )
             return metrics
@@ -272,6 +280,7 @@ def pretrain(
     cfg,
     data_iterators_provider: Optional[Callable] = None,
     params_provider: Optional[Callable] = None,
+    loss_fn: Optional[Callable] = None,
 ) -> Dict[str, Any]:
     """End-to-end training (pretrain analog, training.py:55-196).
 
@@ -297,7 +306,9 @@ def pretrain(
         p_shardings = param_shardings(mesh, shapes)
         timers("model-setup", 0).start()
         params = jax.jit(init_fn, out_shardings=p_shardings)(key)
-        step_fn, optimizer, shardings = make_jitted_train_step(cfg, mesh, params)
+        step_fn, optimizer, shardings = make_jitted_train_step(
+            cfg, mesh, params, loss_fn=loss_fn
+        )
         opt_state = shardings["opt_state_value"]
         timers("model-setup").stop()
 
@@ -321,13 +332,24 @@ def pretrain(
         # ---- data ----
         rebuild_full_loader = None
         if data_iterators_provider is not None:
+            if cfg.training.rampup_batch_size is not None:
+                raise ValueError(
+                    "rampup_batch_size requires the built-in data path: "
+                    "provider loaders yield fixed global_batch_size batches, "
+                    "which the ramp's chunked accounting would mis-count"
+                )
             train_iter, valid_iter_factory = data_iterators_provider(
                 cfg, tokenizer, consumed_samples
             )
         elif cfg.data.data_path or cfg.data.train_data_path:
             loader, (train_ds, valid_ds, _) = build_data_iterators(cfg, tokenizer)
             train_iter = loader(train_ds, consumed_samples)
-            valid_iter_factory = (lambda: loader(valid_ds, 0)) if valid_ds else None
+            # validation always runs at the FULL global batch size (the ramp
+            # only chunks the training loader)
+            valid_iter_factory = (
+                (lambda: loader(valid_ds, 0, cfg.training.global_batch_size))
+                if valid_ds else None
+            )
             # once a batch-size ramp completes, drop back to full-global-batch
             # loading (no per-iteration chunk concatenation)
             rebuild_full_loader = lambda consumed: loader(  # noqa: E731
@@ -336,7 +358,7 @@ def pretrain(
         else:
             raise ValueError("no data: set cfg.data.data_path or pass a provider")
 
-        eval_step = make_eval_step(cfg)
+        eval_step = make_eval_step(cfg, loss_fn=loss_fn)
 
         # ---- train loop (_train analog, training.py:654-770) ----
         from megatron_llm_tpu.microbatches import build_num_microbatches_calculator
@@ -366,7 +388,7 @@ def pretrain(
             if num_micro not in step_cache:
                 step_cache[num_micro] = make_jitted_train_step(
                     cfg, mesh, params, num_micro=num_micro,
-                    optimizer=optimizer, opt_state=opt_state,
+                    optimizer=optimizer, opt_state=opt_state, loss_fn=loss_fn,
                 )[0]
             cur_step_fn = step_cache[num_micro]
             try:

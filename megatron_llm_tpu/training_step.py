@@ -68,7 +68,8 @@ def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
 
 def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
                     mesh: Optional[Mesh] = None,
-                    num_micro: Optional[int] = None):
+                    num_micro: Optional[int] = None,
+                    loss_fn=None):
     """Build the pure train_step(params, opt_state, batch, iteration, seed).
 
     Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
@@ -82,12 +83,16 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
     lr_fn = lr_schedule(cfg)
     if num_micro is None:
         num_micro = cfg.parallel.num_micro_batches or 1
+    # pluggable loss (BERT/T5 entry points pass bert_loss_from_batch /
+    # t5_loss_from_batch; default is the GPT-family LM loss)
+    if loss_fn is None:
+        loss_fn = loss_from_batch
 
     def micro_loss(params, mb, dropout_key, rope):
         deterministic = (
             cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0
         ) or dropout_key is None
-        return loss_from_batch(
+        return loss_fn(
             cfg, params, mb,
             dropout_key=dropout_key,
             deterministic=deterministic,
@@ -119,6 +124,10 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
 
         if pp > 1:
             # pipelined path: the microbatch loop lives inside the pipeline
+            assert loss_fn is loss_from_batch, (
+                "pipeline parallelism currently supports the GPT-family LM "
+                "loss only"
+            )
             from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
 
             deterministic = (
@@ -175,7 +184,8 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
 def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
                            num_micro: Optional[int] = None,
                            optimizer: Optional[optax.GradientTransformation] = None,
-                           opt_state: Any = None):
+                           opt_state: Any = None,
+                           loss_fn=None):
     """Bind shardings and jit. Returns (step_fn, optimizer, shardings dict).
 
     Donates params/opt_state (the XLA analog of the reference's in-place
@@ -194,7 +204,8 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
     b_shard = NamedSharding(mesh, data_spec(cp))
     scalar = NamedSharding(mesh, P())
 
-    step = make_train_step(cfg, optimizer, mesh=mesh, num_micro=num_micro)
+    step = make_train_step(cfg, optimizer, mesh=mesh, num_micro=num_micro,
+                           loss_fn=loss_fn)
     # batch in_sharding is UNSPECIFIED (follows the committed input): batches
     # may carry the [s] token_idx vector whose sharding differs per key —
     # callers place batches with place_batch / batch_shardings.

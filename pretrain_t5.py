@@ -1,0 +1,90 @@
+"""T5 pretraining CLI (reference pretrain_t5.py analog).
+
+Span corruption over an indexed token corpus; sentinel tokens come from the
+top of the vocabulary (the reference reserves them via --vocab_extra_ids):
+
+    python pretrain_t5.py --model_name t5 --data_path corpus_text_document \
+        --tokenizer_type BertWordPieceLowerCase --vocab_file vocab.txt \
+        --seq_length 512 --decoder_seq_length 128 --vocab_extra_ids 100 \
+        --micro_batch_size 4 --global_batch_size 32 --train_iters 10000
+"""
+
+from __future__ import annotations
+
+import jax
+
+from megatron_llm_tpu.config import parse_args
+from megatron_llm_tpu.models.t5 import init_t5_params, t5_loss_from_batch
+from megatron_llm_tpu.training import pretrain
+
+
+def t5_data_provider(cfg, tokenizer, consumed_samples):
+    from megatron_llm_tpu.data.gpt_dataset import get_split_indexed_datasets
+    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
+    from megatron_llm_tpu.data.t5_dataset import T5Dataset
+
+    splits = get_split_indexed_datasets(cfg.data.data_path, cfg.data.split)
+    t = cfg.training
+    v = cfg.model.vocab_size
+    n_sent = max(cfg.data.vocab_extra_ids, 8)
+    sentinel_ids = list(range(v - n_sent, v))
+
+    def tok_id(name, default):
+        try:
+            val = getattr(tokenizer, name, None)
+            return int(val) if val is not None else default
+        except NotImplementedError:
+            return default
+
+    bos = tok_id("bos_token_id", v - n_sent - 2)
+    eos = tok_id("eod", v - n_sent - 1)
+    pad = tok_id("pad", 0)
+    dec_len = getattr(cfg.data, "decoder_seq_length", None) or max(
+        cfg.data.seq_length // 4, 32
+    )
+    num_train = (t.train_iters or 0) * t.global_batch_size
+    num_eval = t.eval_iters * t.global_batch_size * (
+        1 + (t.train_iters or 0) // max(t.eval_interval, 1)
+    )
+
+    def make(ds, n):
+        if ds is None or n == 0:
+            return None
+        return T5Dataset(
+            ds, n, cfg.data.seq_length, dec_len, sentinel_ids,
+            bos, eos, pad, seed=t.seed,
+        )
+
+    train_ds = make(splits[0], max(num_train, 1))
+    valid_ds = make(splits[1], max(num_eval, 1))
+    train_iter = build_pretraining_data_loader(
+        train_ds, consumed_samples, t.global_batch_size,
+        cfg.data.dataloader_type, t.seed,
+    )
+    valid_factory = (
+        (lambda: build_pretraining_data_loader(
+            valid_ds, 0, t.global_batch_size, cfg.data.dataloader_type, t.seed
+        )) if valid_ds else None
+    )
+    return train_iter, valid_factory
+
+
+def main():
+    import sys
+
+    argv = sys.argv[1:]
+    if "--model_name" not in argv:
+        argv = ["--model_name", "t5"] + argv
+    cfg = parse_args(argv, n_devices=len(jax.devices()))
+    result = pretrain(
+        cfg,
+        data_iterators_provider=t5_data_provider,
+        params_provider=lambda key: init_t5_params(cfg, key),
+        loss_fn=t5_loss_from_batch,
+    )
+    print(f"training done: {result['iteration']} iterations "
+          f"({result['exit_reason']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,281 @@
+"""BERT and T5 model families: forward shapes, masking semantics, datasets,
+and end-to-end pretraining (reference analogs: model/bert_model.py,
+model/t5_model.py, data/bert_dataset.py, data/t5_dataset.py,
+pretrain_bert.py, pretrain_t5.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.models import make_config
+from megatron_llm_tpu.models.bert import (
+    bert_forward,
+    bert_loss_from_batch,
+    init_bert_params,
+)
+from megatron_llm_tpu.models.t5 import (
+    init_t5_params,
+    t5_forward,
+    t5_loss_from_batch,
+)
+
+
+def bert_cfg(**kw):
+    defaults = dict(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, vocab_size=256, seq_length=32,
+        max_position_embeddings=64, params_dtype="float32",
+        use_flash_attn=False,
+    )
+    defaults.update(kw)
+    return make_config("bert", **defaults)
+
+
+def t5_cfg(**kw):
+    defaults = dict(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, vocab_size=256, seq_length=32,
+        max_position_embeddings=64, params_dtype="float32",
+        use_flash_attn=False,
+    )
+    defaults.update(kw)
+    return make_config("t5", **defaults)
+
+
+def test_bert_forward_shapes():
+    cfg = bert_cfg()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 250)
+    pad = jnp.ones((2, 32))
+    types = jnp.zeros((2, 32), jnp.int32)
+    lm_logits, binary_logits = bert_forward(cfg, params, tokens, pad, types)
+    v = params["embedding"]["word_embeddings"].shape[0]
+    assert lm_logits.shape == (2, 32, v)
+    assert binary_logits.shape == (2, 2)
+
+
+def test_bert_attention_is_bidirectional_and_pad_masked():
+    """Changing a LATER non-pad token changes an earlier position's logits
+    (bidirectional); changing a PAD token changes nothing."""
+    cfg = bert_cfg()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, 250))
+    pad = np.ones((1, 32), np.float32)
+    pad[0, 28:] = 0.0  # last 4 are padding
+
+    base, _ = bert_forward(cfg, params, jnp.asarray(tokens), jnp.asarray(pad))
+    t2 = tokens.copy()
+    t2[0, 20] = (t2[0, 20] + 1) % 250  # later real token
+    later, _ = bert_forward(cfg, params, jnp.asarray(t2), jnp.asarray(pad))
+    assert not np.allclose(np.asarray(base[0, 5]), np.asarray(later[0, 5]))
+
+    t3 = tokens.copy()
+    t3[0, 30] = (t3[0, 30] + 7) % 250  # pad position
+    padded, _ = bert_forward(cfg, params, jnp.asarray(t3), jnp.asarray(pad))
+    np.testing.assert_allclose(
+        np.asarray(base[0, :28]), np.asarray(padded[0, :28]), atol=1e-6
+    )
+
+
+def test_bert_loss_trains():
+    from megatron_llm_tpu.data.bert_dataset import BertDataset
+
+    class Docs:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return rng.randint(1, 250, size=40)
+
+    ds = BertDataset(Docs(), 4, 32, 256, cls_id=252, sep_id=253,
+                     mask_id=254, pad_id=0)
+    batch = {k: jnp.asarray(np.stack([ds[i][k] for i in range(4)]))
+             for k in ds[0]}
+    cfg = bert_cfg()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+
+    loss_fn = jax.jit(lambda p: bert_loss_from_batch(cfg, p, batch)[0])
+    grad_fn = jax.jit(jax.grad(lambda p: bert_loss_from_batch(cfg, p, batch)[0]))
+    l0 = float(loss_fn(params))
+    for _ in range(60):
+        g = grad_fn(params)
+        params = jax.tree.map(lambda w, gg: w - 0.1 * gg, params, g)
+    l1 = float(loss_fn(params))
+    assert np.isfinite(l0) and l1 < l0, (l0, l1)
+
+
+def test_bert_dataset_masking_stats():
+    from megatron_llm_tpu.data.bert_dataset import BertDataset
+
+    class Docs:
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(100 + i)
+            return rng.randint(1, 250, size=60)
+
+    ds = BertDataset(Docs(), 200, 64, 256, cls_id=252, sep_id=253,
+                     mask_id=254, pad_id=0)
+    n_masked, n_tokens, n_random = 0, 0, 0
+    for i in range(200):
+        s = ds[i]
+        real = int(s["padding_mask"].sum())
+        masked = int(s["loss_mask"].sum())
+        n_tokens += real
+        n_masked += masked
+        n_random += int(s["is_random"])
+        # masked positions carry the ORIGINAL token as label
+        pos = np.nonzero(s["loss_mask"])[0]
+        assert np.all(s["labels"][pos] >= 0)
+        # [CLS] (position 0) is never selected for masking, and no masked
+        # label is a special token (the 10% random replacement MAY write a
+        # special id into text, matching the reference's full-vocab sampling)
+        assert 0 not in pos
+        assert not set(s["labels"][pos].tolist()) & {252, 253}
+    frac = n_masked / n_tokens
+    assert 0.10 < frac < 0.20, frac           # ~15% masking
+    assert 0.3 < n_random / 200 < 0.7          # ~50% random-next pairs
+
+
+def test_t5_forward_shapes_and_cross_attention():
+    cfg = t5_cfg()
+    params = init_t5_params(cfg, jax.random.PRNGKey(0))
+    enc = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 250)
+    dec = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 250)
+    em = jnp.ones((2, 32))
+    dm = jnp.ones((2, 16))
+    logits = t5_forward(cfg, params, enc, dec, em, dm)
+    v = params["embedding"]["word_embeddings"].shape[0]
+    assert logits.shape == (2, 16, v)
+
+    # changing the encoder input changes decoder logits (cross attention live)
+    enc2 = enc.at[0, 5].set((enc[0, 5] + 1) % 250)
+    logits2 = t5_forward(cfg, params, enc2, dec, em, dm)
+    assert not np.allclose(np.asarray(logits[0]), np.asarray(logits2[0]))
+
+    # decoder self-attention is causal: changing a later decoder token leaves
+    # earlier positions unchanged
+    dec2 = dec.at[0, 10].set((dec[0, 10] + 1) % 250)
+    logits3 = t5_forward(cfg, params, enc, dec2, em, dm)
+    np.testing.assert_allclose(
+        np.asarray(logits[0, :10]), np.asarray(logits3[0, :10]), atol=1e-6
+    )
+
+
+def test_t5_span_corruption_roundtrip():
+    from megatron_llm_tpu.data.t5_dataset import corrupt_spans
+
+    rng = np.random.RandomState(0)
+    tokens = np.arange(1, 101)
+    sentinels = [250, 251, 252, 253, 254, 255]
+    enc, target = corrupt_spans(tokens, sentinels, rng)
+    # every corrupted token appears exactly once in enc or target
+    enc_real = [t for t in enc if t not in sentinels]
+    tgt_real = [t for t in target if t not in sentinels]
+    assert sorted(enc_real + tgt_real) == tokens.tolist()
+    # ~15% of tokens are in the target spans
+    assert 0.05 <= len(tgt_real) / len(tokens) <= 0.30
+    # sentinels pair up: each sentinel in enc appears in target
+    enc_sent = [t for t in enc if t in sentinels]
+    tgt_sent = [t for t in target if t in sentinels]
+    assert enc_sent == tgt_sent
+
+
+def test_t5_loss_trains():
+    from megatron_llm_tpu.data.t5_dataset import T5Dataset
+
+    class Docs:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return rng.randint(1, 240, size=50)
+
+    ds = T5Dataset(Docs(), 4, 32, 16, sentinel_ids=[250, 251, 252, 253],
+                   bos_id=248, eos_id=249, pad_id=0)
+    batch = {k: jnp.asarray(np.stack([ds[i][k] for i in range(4)]))
+             for k in ds[0]}
+    cfg = t5_cfg()
+    params = init_t5_params(cfg, jax.random.PRNGKey(0))
+    loss_fn = jax.jit(lambda p: t5_loss_from_batch(cfg, p, batch)[0])
+    grad_fn = jax.jit(jax.grad(lambda p: t5_loss_from_batch(cfg, p, batch)[0]))
+    l0 = float(loss_fn(params))
+    for _ in range(60):
+        g = grad_fn(params)
+        params = jax.tree.map(lambda w, gg: w - 0.1 * gg, params, g)
+    l1 = float(loss_fn(params))
+    assert np.isfinite(l0) and l1 < l0, (l0, l1)
+
+
+def test_bert_tp_sharding_matches_single(eight_devices):
+    """BERT logits under tp=4 == single device (param sharding rules cover
+    the new mlm_head/pooler/binary_head/tokentype params)."""
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.parallel.tp import param_shardings
+
+    cfg = bert_cfg()
+    params = init_bert_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 250)
+    pad = jnp.ones((2, 32))
+    ref, ref_bin = bert_forward(cfg, params, tokens, pad)
+
+    cfgN = bert_cfg(tensor_model_parallel_size=4)
+    mesh = build_mesh(tensor_model_parallel_size=4, devices=eight_devices[:4])
+    with global_mesh(mesh):
+        sharded = jax.device_put(params, param_shardings(mesh, params))
+        got, got_bin = jax.jit(
+            lambda p, t: bert_forward(cfgN, p, t, pad)
+        )(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(ref_bin), np.asarray(got_bin),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pretrain_bert_cli_end_to_end(tmp_path):
+    """pretrain_bert entry path: corpus -> provider -> pretrain loop."""
+    from megatron_llm_tpu.config import Config, apply_architecture
+    from megatron_llm_tpu.data.indexed_dataset import make_builder
+    from megatron_llm_tpu.models.bert import bert_loss_from_batch, init_bert_params
+    from megatron_llm_tpu.training import pretrain
+    from pretrain_bert import bert_data_provider
+
+    prefix = str(tmp_path / "corpus_text_document")
+    rng = np.random.RandomState(0)
+    b = make_builder(prefix + ".bin", vocab_size=250)
+    for _ in range(40):
+        b.add_doc(rng.randint(1, 250, size=rng.randint(30, 80)))
+    b.finalize(prefix + ".idx")
+
+    cfg = Config()
+    apply_architecture(cfg, "bert")
+    cfg.model.num_layers = 2
+    cfg.model.hidden_size = 64
+    cfg.model.num_attention_heads = 4
+    cfg.model.vocab_size = 256
+    cfg.model.max_position_embeddings = 64
+    cfg.data.seq_length = 32
+    cfg.data.data_path = [prefix]
+    cfg.data.tokenizer_type = "NullTokenizer"
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    cfg.training.micro_batch_size = 4
+    cfg.training.global_batch_size = 4
+    cfg.training.train_iters = 4
+    cfg.training.eval_iters = 1
+    cfg.training.eval_interval = 2
+    cfg.logging.log_interval = 2
+    cfg.finalize(n_devices=1)
+
+    result = pretrain(
+        cfg,
+        data_iterators_provider=bert_data_provider,
+        params_provider=lambda key: init_bert_params(cfg, key),
+        loss_fn=bert_loss_from_batch,
+    )
+    assert result["iteration"] == 4
+    assert np.isfinite(float(result["last_metrics"]["lm loss"]))
