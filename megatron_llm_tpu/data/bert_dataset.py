@@ -70,6 +70,7 @@ def build_training_sample(
     max_tokens = max_seq_length - (3 if binary_head else 2)
     # truncate the longer segment first (dataset_utils truncate_segments)
     a, b = list(tokens_a), list(tokens_b) if binary_head else []
+    truncated = len(a) + len(b) > max_tokens
     while len(a) + len(b) > max_tokens:
         (a if len(a) >= len(b) else b).pop()
     tokens = [cls_id] + a + [sep_id] + (b + [sep_id] if binary_head else [])
@@ -104,7 +105,7 @@ def build_training_sample(
         "loss_mask": loss_mask,
         "padding_mask": padding_mask,
         "is_random": np.int64(is_random),
-        "truncated": np.int64(pad < 0),
+        "truncated": np.int64(truncated),
     }
 
 
@@ -136,16 +137,22 @@ class BertDataset:
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         rng = np.random.RandomState(self.seed + int(idx))
-        doc = np.asarray(self.indexed[int(idx) % self.num_docs])
+        doc_id = int(idx) % self.num_docs
+        doc = np.asarray(self.indexed[doc_id])
         if len(doc) < 4:
             doc = np.resize(doc, (4,))
-        pivot = rng.randint(1, len(doc))
+        pivot = rng.randint(1, len(doc))  # 1 <= pivot <= len(doc)-1
         a = doc[:pivot]
         is_random = False
-        if self.binary_head and (rng.random_sample() < 0.5 or pivot == len(doc)):
-            other = np.asarray(
-                self.indexed[rng.randint(0, self.num_docs)]
-            )
+        if self.binary_head and rng.random_sample() < 0.5:
+            # random-next pair: draw a DIFFERENT document (the reference
+            # re-draws until the doc differs, bert_dataset.py pair sampling)
+            other_id = doc_id
+            for _ in range(10):
+                other_id = rng.randint(0, self.num_docs)
+                if other_id != doc_id or self.num_docs == 1:
+                    break
+            other = np.asarray(self.indexed[other_id])
             if len(other) < 2:
                 other = np.resize(other, (2,))
             b = other[rng.randint(0, len(other) - 1):]

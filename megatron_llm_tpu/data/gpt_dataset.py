@@ -237,7 +237,12 @@ def build_train_valid_test_datasets(
 
 class DocRangeView:
     """Document-level view over an indexed dataset restricted to a doc range
-    (the BERT/T5 datasets sample whole documents, not token windows)."""
+    (the BERT/T5 datasets sample whole documents, not token windows).
+
+    ``doc_idx[d]:doc_idx[d+1]`` is a range of SEQUENCES (sentence-split
+    corpora store several sequences per document, indexed_dataset.py doc_idx
+    semantics) — a document read concatenates them.
+    """
 
     def __init__(self, indexed, documents: np.ndarray):
         self.indexed = indexed
@@ -247,7 +252,13 @@ class DocRangeView:
         return len(self.documents)
 
     def __getitem__(self, idx: int) -> np.ndarray:
-        return np.asarray(self.indexed[int(self.documents[int(idx)])])
+        d = int(self.documents[int(idx)])
+        lo = int(self.indexed.doc_idx[d])
+        hi = int(self.indexed.doc_idx[d + 1])
+        if hi <= lo:
+            return np.zeros((0,), np.int64)
+        parts = [np.asarray(self.indexed[s]) for s in range(lo, hi)]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 def get_split_indexed_datasets(data_prefix: Sequence[str], splits_string: str,

@@ -73,7 +73,17 @@ def build_training_sample(
     noise_density: float = 0.15,
     mean_span_length: float = 3.0,
 ) -> Dict[str, np.ndarray]:
-    tokens = tokens[: max_seq_length - len(sentinel_ids) - 1]
+    # reserve room for the sentinels that will actually be inserted
+    # (~noise_density/mean_span_length of the tokens), not one slot per
+    # available sentinel id
+    est_spans = (
+        int(round(noise_density * max_seq_length / mean_span_length)) + 2
+    )
+    budget = max_seq_length - min(est_spans, len(sentinel_ids)) - 1
+    assert budget >= 8, (
+        f"seq_length {max_seq_length} too short for span corruption"
+    )
+    tokens = tokens[:budget]
     enc, target = corrupt_spans(
         tokens, sentinel_ids, rng,
         noise_density=noise_density, mean_span_length=mean_span_length,

@@ -1,0 +1,119 @@
+"""Sequence classification and multiple-choice heads over the BERT backbone.
+
+Reference: megatron/model/classification.py (Classification:~30 — BERT
+backbone + pooler + dropout + [h, num_classes] head) and
+megatron/model/multiple_choice.py (MultipleChoice — flatten [b, choices, s],
+score each choice with a [h, 1] head). Used by the tasks/ harness (GLUE,
+RACE finetuning, tasks/finetune_utils.py:309).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.models.bert import padding_bias
+from megatron_llm_tpu.models.language_model import (
+    embed_tokens,
+    init_model_params,
+)
+from megatron_llm_tpu.models.transformer import transformer_forward
+from megatron_llm_tpu.ops.norms import norm
+
+Params = Dict[str, Any]
+
+
+def init_classification_params(cfg, key: jax.Array, num_classes: int) -> Params:
+    """BERT backbone + pooler + classification head (classification.py)."""
+    m = cfg.model
+    params = init_model_params(cfg, key)
+    h = m.hidden_size
+    k1, k2 = jax.random.split(jax.random.fold_in(key, 13))
+    std = m.init_method_std
+    params["pooler"] = {
+        "kernel": std * jax.random.normal(k1, (h, h), jnp.float32),
+        "bias": jnp.zeros((h,), jnp.float32),
+    }
+    params["classification_head"] = {
+        "kernel": std * jax.random.normal(k2, (h, num_classes), jnp.float32),
+        "bias": jnp.zeros((num_classes,), jnp.float32),
+    }
+    return params
+
+
+def _pooled(cfg, params, tokens, padding_mask, tokentype_ids,
+            dropout_key, deterministic):
+    m = cfg.model
+    hidden = embed_tokens(cfg, params, tokens, tokentype_ids=tokentype_ids)
+    hidden, _ = transformer_forward(
+        cfg, params["layers"], hidden,
+        attn_bias=padding_bias(padding_mask),
+        dropout_key=dropout_key, deterministic=deterministic,
+    )
+    hidden = norm(hidden, params["final_norm"], m.layernorm_epsilon,
+                  m.use_rms_norm)
+    return jnp.tanh(
+        hidden[:, 0] @ params["pooler"]["kernel"].astype(hidden.dtype)
+        + params["pooler"]["bias"].astype(hidden.dtype)
+    )
+
+
+def classification_forward(
+    cfg,
+    params: Params,
+    tokens: jax.Array,        # [b, s]
+    padding_mask: jax.Array,  # [b, s]
+    tokentype_ids: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Returns class logits [b, num_classes]."""
+    pooled = _pooled(cfg, params, tokens, padding_mask, tokentype_ids,
+                     dropout_key, deterministic)
+    head = params["classification_head"]
+    return (pooled @ head["kernel"].astype(pooled.dtype)
+            + head["bias"].astype(pooled.dtype)).astype(jnp.float32)
+
+
+def multiple_choice_forward(
+    cfg,
+    params: Params,
+    tokens: jax.Array,        # [b, num_choices, s]
+    padding_mask: jax.Array,  # [b, num_choices, s]
+    tokentype_ids: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Score every choice with the [h, 1] head; returns [b, num_choices]
+    (multiple_choice.py flatten-and-score)."""
+    b, c, s = tokens.shape
+    flat = lambda x: None if x is None else x.reshape(b * c, s)
+    logits = classification_forward(
+        cfg, params, flat(tokens), flat(padding_mask), flat(tokentype_ids),
+        dropout_key, deterministic,
+    )  # [b*c, 1]
+    return logits.reshape(b, c)
+
+
+def classification_loss_from_batch(cfg, params, batch, *, dropout_key=None,
+                                   deterministic=True, rope_cache=None,
+                                   sp_constraint=None):
+    """CE over class logits; batch keys text/types/padding_mask/label
+    (finetune_utils.py _cross_entropy_forward_step)."""
+    if batch["text"].ndim == 3:
+        logits = multiple_choice_forward(
+            cfg, params, batch["text"], batch["padding_mask"],
+            batch.get("types"), dropout_key, deterministic,
+        )
+    else:
+        logits = classification_forward(
+            cfg, params, batch["text"], batch["padding_mask"],
+            batch.get("types"), dropout_key, deterministic,
+        )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    labels = batch["label"].astype(jnp.int32)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+    return loss, {"lm loss": loss, "accuracy": acc}

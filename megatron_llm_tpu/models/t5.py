@@ -59,9 +59,13 @@ def causal_padding_bias(padding_mask: jax.Array) -> jax.Array:
     return jnp.where(keep[:, None], 0.0, NEG_INF).astype(jnp.float32)
 
 
-def cross_bias(dec_mask: jax.Array, enc_mask: jax.Array) -> jax.Array:
-    """[b, sd], [b, se] -> [b, 1, sd, se]: decoder queries attend non-pad
-    encoder keys."""
+def cross_bias(enc_mask: jax.Array) -> jax.Array:
+    """[b, se] -> [b, 1, 1, se]: decoder queries attend non-pad encoder keys.
+
+    Pad decoder QUERIES are not masked here — their outputs are discarded by
+    the loss mask downstream (same asymmetry as the reference's
+    enc_dec_attn_mask, t5_model.py:21-37).
+    """
     keep = enc_mask.astype(bool)[:, None, None, :]
     return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
 
@@ -99,7 +103,7 @@ def t5_forward(
         cfg, params["decoder_layers"], dec_hidden,
         attn_bias=causal_padding_bias(decoder_padding_mask),
         encoder_hidden=enc_hidden,
-        enc_bias=cross_bias(decoder_padding_mask, encoder_padding_mask),
+        enc_bias=cross_bias(encoder_padding_mask),
         dropout_key=dk_dec, deterministic=deterministic,
     )
     dec_hidden = norm(dec_hidden, params["decoder_final_norm"],

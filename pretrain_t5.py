@@ -18,6 +18,24 @@ from megatron_llm_tpu.models.t5 import init_t5_params, t5_loss_from_batch
 from megatron_llm_tpu.training import pretrain
 
 
+def extend_vocab_for_t5(cfg) -> None:
+    """Reserve sentinel + bos/eos ids ABOVE the tokenizer vocabulary.
+
+    The reference reserves sentinels via --vocab_extra_ids added to the
+    tokenizer (tokenizer.py additional special tokens); here the model vocab
+    is extended so sentinel ids never alias real corpus tokens. Must run
+    before params are initialized.
+    """
+    assert cfg.model.vocab_size is not None, (
+        "set --vocab_size (or a tokenizer that provides it) before T5 setup"
+    )
+    n_extra = cfg.data.vocab_extra_ids or 100
+    cfg.data.vocab_extra_ids = n_extra
+    # [base, base+n_extra) = sentinels; base+n_extra = bos; +1 = eos
+    cfg.model.t5_base_vocab = cfg.model.vocab_size
+    cfg.model.vocab_size += n_extra + 2
+
+
 def t5_data_provider(cfg, tokenizer, consumed_samples):
     from megatron_llm_tpu.data.gpt_dataset import get_split_indexed_datasets
     from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
@@ -25,20 +43,19 @@ def t5_data_provider(cfg, tokenizer, consumed_samples):
 
     splits = get_split_indexed_datasets(cfg.data.data_path, cfg.data.split)
     t = cfg.training
-    v = cfg.model.vocab_size
-    n_sent = max(cfg.data.vocab_extra_ids, 8)
-    sentinel_ids = list(range(v - n_sent, v))
+    base = getattr(cfg.model, "t5_base_vocab", None)
+    assert base is not None, "call extend_vocab_for_t5(cfg) first"
+    n_sent = cfg.data.vocab_extra_ids
+    sentinel_ids = list(range(base, base + n_sent))
 
-    def tok_id(name, default):
-        try:
-            val = getattr(tokenizer, name, None)
-            return int(val) if val is not None else default
-        except NotImplementedError:
-            return default
-
-    bos = tok_id("bos_token_id", v - n_sent - 2)
-    eos = tok_id("eod", v - n_sent - 1)
-    pad = tok_id("pad", 0)
+    # bos/eos always use the reserved slots (a tokenizer "eod" of 0 would
+    # collide with pad); pad falls back to 0
+    bos = base + n_sent
+    eos = base + n_sent + 1
+    try:
+        pad = int(getattr(tokenizer, "pad", 0) or 0)
+    except NotImplementedError:
+        pad = 0
     dec_len = getattr(cfg.data, "decoder_seq_length", None) or max(
         cfg.data.seq_length // 4, 32
     )
@@ -76,6 +93,11 @@ def main():
     if "--model_name" not in argv:
         argv = ["--model_name", "t5"] + argv
     cfg = parse_args(argv, n_devices=len(jax.devices()))
+    if cfg.model.vocab_size is None:
+        from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+
+        build_tokenizer(cfg)  # sets cfg.model.vocab_size
+    extend_vocab_for_t5(cfg)
     result = pretrain(
         cfg,
         data_iterators_provider=t5_data_provider,

@@ -1,0 +1,140 @@
+"""Shared task-finetuning machinery (reference tasks/finetune_utils.py:309).
+
+``finetune_classification`` drives the standard pretrain loop with the
+classification loss and a dataset-pair provider — epochs become train_iters
+(the reference's epoch loop with best-checkpoint tracking collapses into the
+driver's eval/save cadence).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def pack_pair(
+    tokens_a,
+    tokens_b,
+    max_seq_length: int,
+    cls_id: int,
+    sep_id: int,
+    pad_id: int,
+):
+    """[CLS] a [SEP] (b [SEP]) with 0/1 tokentypes + padding mask
+    (reference build_tokens_types_paddings_from_ids, glue/data.py)."""
+    a = list(tokens_a)
+    b = list(tokens_b) if tokens_b is not None else []
+    budget = max_seq_length - (3 if b else 2)
+    while len(a) + len(b) > budget:
+        (a if len(a) >= len(b) else b).pop()
+    ids = [cls_id] + a + [sep_id] + (b + [sep_id] if b else [])
+    types = [0] * (len(a) + 2) + [1] * (len(b) + 1 if b else 0)
+    n = len(ids)
+    text = np.full((max_seq_length,), pad_id, np.int64)
+    text[:n] = ids
+    types_arr = np.zeros((max_seq_length,), np.int64)
+    types_arr[:n] = types
+    pad = np.zeros((max_seq_length,), np.float32)
+    pad[:n] = 1.0
+    return text, types_arr, pad
+
+
+class ClassificationDataset:
+    """(text_a, text_b, label) records -> packed classification samples."""
+
+    def __init__(self, records, tokenize: Callable, max_seq_length: int,
+                 cls_id: int, sep_id: int, pad_id: int):
+        self.records = list(records)
+        self.tokenize = tokenize
+        self.max_seq_length = max_seq_length
+        self.cls_id, self.sep_id, self.pad_id = cls_id, sep_id, pad_id
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, idx):
+        text_a, text_b, label = self.records[int(idx)]
+        a = self.tokenize(text_a)
+        b = self.tokenize(text_b) if text_b else None
+        text, types, pad = pack_pair(
+            a, b, self.max_seq_length, self.cls_id, self.sep_id, self.pad_id
+        )
+        return {"text": text, "types": types, "padding_mask": pad,
+                "label": np.int64(label)}
+
+
+class MultipleChoiceDataset:
+    """(context, question, choices, label) -> [num_choices, s] samples
+    (reference tasks/race/data.py)."""
+
+    def __init__(self, records, tokenize: Callable, max_seq_length: int,
+                 cls_id: int, sep_id: int, pad_id: int):
+        self.records = list(records)
+        self.tokenize = tokenize
+        self.max_seq_length = max_seq_length
+        self.cls_id, self.sep_id, self.pad_id = cls_id, sep_id, pad_id
+
+    def __len__(self):
+        return len(self.records)
+
+    def __getitem__(self, idx):
+        context, question, choices, label = self.records[int(idx)]
+        ctx = self.tokenize(context)
+        texts, types, pads = [], [], []
+        for choice in choices:
+            qa = self.tokenize(question + " " + choice)
+            t, ty, pd = pack_pair(
+                ctx, qa, self.max_seq_length,
+                self.cls_id, self.sep_id, self.pad_id,
+            )
+            texts.append(t), types.append(ty), pads.append(pd)
+        return {
+            "text": np.stack(texts),
+            "types": np.stack(types),
+            "padding_mask": np.stack(pads),
+            "label": np.int64(label),
+        }
+
+
+def dataset_provider(train_ds, valid_ds):
+    """Adapt (train, valid) datasets to pretrain's data_iterators_provider."""
+    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
+
+    def provider(cfg, tokenizer, consumed_samples):
+        t = cfg.training
+        train_iter = build_pretraining_data_loader(
+            train_ds, consumed_samples % max(len(train_ds), 1),
+            t.global_batch_size, "cyclic", t.seed,
+        )
+        valid_factory = (
+            (lambda: build_pretraining_data_loader(
+                valid_ds, 0, t.global_batch_size, "single", t.seed
+            )) if valid_ds is not None else None
+        )
+        return train_iter, valid_factory
+
+    return provider
+
+
+def finetune_classification(cfg, train_ds, valid_ds, num_classes: int):
+    """Run classification finetuning end-to-end; returns the pretrain result
+    dict (reference finetune() loop, finetune_utils.py:309)."""
+    from megatron_llm_tpu.models.classification import (
+        classification_loss_from_batch,
+        init_classification_params,
+    )
+    from megatron_llm_tpu.training import pretrain
+
+    if cfg.training.train_iters is None and cfg.training.train_samples:
+        cfg.training.train_iters = (
+            cfg.training.train_samples // cfg.training.global_batch_size
+        )
+    return pretrain(
+        cfg,
+        data_iterators_provider=dataset_provider(train_ds, valid_ds),
+        params_provider=lambda key: init_classification_params(
+            cfg, key, num_classes
+        ),
+        loss_fn=classification_loss_from_batch,
+    )

@@ -1,0 +1,52 @@
+"""GLUE task processors (reference tasks/glue/mnli.py, qqp.py, data.py).
+
+TSV row conventions match the reference's GLUE downloads:
+MNLI train/dev: sentence_a col 8, sentence_b col 9, gold label last column;
+QQP train: question1 col 3, question2 col 4, is_duplicate col 5.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import List, Tuple
+
+
+def _read_tsv(path: str) -> List[List[str]]:
+    with open(path, newline="") as f:
+        return list(csv.reader(f, delimiter="\t", quotechar=None))
+
+
+class MNLIProcessor:
+    name = "MNLI"
+    LABELS = {"contradiction": 0, "entailment": 1, "neutral": 2}
+    num_classes = 3
+
+    def records(self, path: str) -> List[Tuple[str, str, int]]:
+        rows = _read_tsv(path)[1:]  # header
+        out = []
+        for row in rows:
+            if len(row) < 10:
+                continue
+            label = row[-1].strip()
+            if label not in self.LABELS:
+                continue
+            out.append((row[8], row[9], self.LABELS[label]))
+        return out
+
+
+class QQPProcessor:
+    name = "QQP"
+    num_classes = 2
+
+    def records(self, path: str) -> List[Tuple[str, str, int]]:
+        rows = _read_tsv(path)[1:]
+        out = []
+        for row in rows:
+            if len(row) == 6 and row[5] in ("0", "1"):
+                out.append((row[3], row[4], int(row[5])))
+            elif len(row) == 3 and row[2] in ("0", "1"):  # test-style rows
+                out.append((row[0], row[1], int(row[2])))
+        return out
+
+
+PROCESSORS = {"MNLI": MNLIProcessor, "QQP": QQPProcessor}

@@ -1,0 +1,173 @@
+"""Downstream-task harness dispatcher (reference tasks/main.py:14-94).
+
+    python tasks/main.py --task MNLI  --train_data train.tsv --valid_data dev.tsv ...
+    python tasks/main.py --task RACE  --train_data RACE/train ...
+    python tasks/main.py --task WIKITEXT103 --valid_data wiki.test.tokens --load ckpt
+    python tasks/main.py --task LAMBADA --valid_data lambada.jsonl --load ckpt
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from megatron_llm_tpu.config import parse_args
+
+
+def get_tasks_args(parser):
+    group = parser.add_argument_group("tasks")
+    group.add_argument("--task", type=str, required=True,
+                       help="MNLI|QQP|RACE|WIKITEXT103|LAMBADA")
+    group.add_argument("--train_data", type=str, default=None)
+    group.add_argument("--valid_data", type=str, default=None)
+    group.add_argument("--epochs", type=int, default=3)
+    group.add_argument("--strict_lambada", action="store_true")
+    return parser
+
+
+def _special_ids(tokenizer, vocab_size: int):
+    """cls/sep/pad ids with top-of-vocab fallbacks for tokenizers without
+    BERT specials (pretrain_bert.py convention)."""
+
+    def get(name, default):
+        try:
+            v = getattr(tokenizer, name, None)
+            return int(v) if v is not None else default
+        except NotImplementedError:
+            return default
+
+    return dict(
+        cls_id=get("cls", vocab_size - 4),
+        sep_id=get("sep", vocab_size - 3),
+        pad_id=get("pad", 0),
+    )
+
+
+def _load_params_for_eval(cfg):
+    """Initialize + load checkpoint params (zero-shot path)."""
+    from megatron_llm_tpu.checkpointing import load_checkpoint
+    from megatron_llm_tpu.core.parallel_state import (
+        build_mesh_from_config,
+        global_mesh,
+    )
+    from megatron_llm_tpu.models import init_model_params
+    from megatron_llm_tpu.parallel.tp import param_shardings
+
+    mesh = build_mesh_from_config(cfg)
+    with global_mesh(mesh):
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        if cfg.checkpoint.load:
+            shard = param_shardings(mesh, params)
+            params, *_ = load_checkpoint(
+                cfg, cfg.checkpoint.load, params, None, shard, None
+            )
+    return mesh, params
+
+
+def run_zeroshot(cfg, extra):
+    from megatron_llm_tpu.core.parallel_state import global_mesh
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.zeroshot_gpt.evaluate import (
+        evaluate_lambada,
+        evaluate_wikitext_ppl,
+        load_lambada_jsonl,
+    )
+
+    tokenizer = build_tokenizer(cfg)
+    mesh, params = _load_params_for_eval(cfg)
+    with global_mesh(mesh):
+        if extra.task == "WIKITEXT103":
+            with open(extra.valid_data) as f:
+                text = f.read()
+            num_original = len(text.split())
+            tokens = tokenizer.tokenize(text)
+            result = evaluate_wikitext_ppl(
+                cfg, params, tokens, num_original_tokens=num_original
+            )
+        else:  # LAMBADA
+            samples = load_lambada_jsonl(extra.valid_data, tokenizer.tokenize)
+            result = evaluate_lambada(cfg, params, samples)
+    print({extra.task: result})
+    return result
+
+
+def run_glue(cfg, extra):
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.finetune_utils import (
+        ClassificationDataset,
+        finetune_classification,
+    )
+    from tasks.glue.data import PROCESSORS
+
+    proc = PROCESSORS[extra.task]()
+    tokenizer = build_tokenizer(cfg)
+    ids = _special_ids(tokenizer, cfg.model.vocab_size)
+    train_ds = ClassificationDataset(
+        proc.records(extra.train_data), tokenizer.tokenize,
+        cfg.data.seq_length, **ids,
+    )
+    valid_ds = (
+        ClassificationDataset(
+            proc.records(extra.valid_data), tokenizer.tokenize,
+            cfg.data.seq_length, **ids,
+        ) if extra.valid_data else None
+    )
+    if cfg.training.train_iters is None:
+        cfg.training.train_iters = (
+            extra.epochs * len(train_ds) // cfg.training.global_batch_size
+        )
+    return finetune_classification(cfg, train_ds, valid_ds, proc.num_classes)
+
+
+def run_race(cfg, extra):
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.finetune_utils import (
+        MultipleChoiceDataset,
+        finetune_classification,
+    )
+    from tasks.race.data import read_race_records
+
+    tokenizer = build_tokenizer(cfg)
+    ids = _special_ids(tokenizer, cfg.model.vocab_size)
+    train_ds = MultipleChoiceDataset(
+        read_race_records(extra.train_data), tokenizer.tokenize,
+        cfg.data.seq_length, **ids,
+    )
+    valid_ds = (
+        MultipleChoiceDataset(
+            read_race_records(extra.valid_data), tokenizer.tokenize,
+            cfg.data.seq_length, **ids,
+        ) if extra.valid_data else None
+    )
+    if cfg.training.train_iters is None:
+        cfg.training.train_iters = (
+            extra.epochs * len(train_ds) // cfg.training.global_batch_size
+        )
+    # multiple choice scores each option with a 1-logit head
+    return finetune_classification(cfg, train_ds, valid_ds, num_classes=1)
+
+
+def main():
+    import argparse
+
+    # pull the task args off argv, pass the rest to the standard parser
+    task_parser = get_tasks_args(argparse.ArgumentParser(allow_abbrev=False))
+    extra, rest = task_parser.parse_known_args()
+    cfg = parse_args(rest, n_devices=len(jax.devices()), finalize=False)
+    cfg.finalize(n_devices=len(jax.devices()))
+
+    if extra.task in ("WIKITEXT103", "LAMBADA"):
+        return run_zeroshot(cfg, extra)
+    if extra.task in ("MNLI", "QQP"):
+        return run_glue(cfg, extra)
+    if extra.task == "RACE":
+        return run_race(cfg, extra)
+    raise ValueError(f"unknown task {extra.task}")
+
+
+if __name__ == "__main__":
+    main()

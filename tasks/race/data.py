@@ -1,0 +1,34 @@
+"""RACE reading-comprehension data (reference tasks/race/data.py).
+
+Each RACE json file: {"article": ..., "questions": [...], "options":
+[[4 strings], ...], "answers": ["A".."D", ...]} — one multiple-choice record
+per question.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Tuple
+
+
+def read_race_records(path: str) -> List[Tuple[str, str, List[str], int]]:
+    """path: a directory of RACE json files (searched recursively) or one
+    file. Returns (article, question, options, label) records."""
+    if os.path.isdir(path):
+        files = sorted(
+            glob.glob(os.path.join(path, "**", "*.txt"), recursive=True)
+            + glob.glob(os.path.join(path, "**", "*.json"), recursive=True)
+        )
+    else:
+        files = [path]
+    out = []
+    for fp in files:
+        with open(fp) as f:
+            doc = json.load(f)
+        for q, opts, ans in zip(
+            doc["questions"], doc["options"], doc["answers"]
+        ):
+            out.append((doc["article"], q, list(opts), ord(ans) - ord("A")))
+    return out
