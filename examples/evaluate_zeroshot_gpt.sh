@@ -1,0 +1,9 @@
+#!/bin/bash
+# Zero-shot wikitext perplexity / LAMBADA accuracy (reference
+# examples/evaluate_zeroshot_gpt.sh analog).
+TASK=${TASK:-WIKITEXT103}   # or LAMBADA
+python tasks/main.py --task $TASK \
+    --valid_data ${VALID:-wiki.test.tokens} \
+    --model_name llama2 --load ${CKPT:-ckpts/llama2-7b} \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model ${TOK:-tok.model} \
+    --seq_length 2048 --micro_batch_size 8 --global_batch_size 8

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Canonical Llama-2-7B finetune (reference examples/finetune.sh analog).
+# One process drives the whole TPU slice; tp x pp x cp x dp must divide chips.
+
+MODEL=${MODEL:-llama2-7b}
+DATA=${DATA:-/data/corpus_text_document}
+TOK=${TOK:-/data/tokenizer.model}
+CKPT_IN=${CKPT_IN:-ckpts/llama2-7b}
+CKPT_OUT=${CKPT_OUT:-ckpts/llama2-7b-ft}
+
+python finetune.py \
+    --model_name $MODEL \
+    --load $CKPT_IN --finetune \
+    --data_path $DATA \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model $TOK \
+    --seq_length 4096 \
+    --tensor_model_parallel_size 4 --pipeline_model_parallel_size 1 \
+    --sequence_parallel --use_distributed_optimizer \
+    --micro_batch_size 2 --global_batch_size 1000 \
+    --train_iters 500 --lr 3e-5 --lr_warmup_iters 10 --lr_decay_style cosine \
+    --weight_decay 0.1 --clip_grad 1.0 \
+    --save $CKPT_OUT --save_interval 100 --eval_interval 100 --eval_iters 10 \
+    --log_interval 10 --tensorboard_dir logs/finetune

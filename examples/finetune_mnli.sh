@@ -1,0 +1,10 @@
+#!/bin/bash
+# GLUE MNLI finetune over the BERT backbone.
+python tasks/main.py --task MNLI \
+    --train_data ${GLUE:-glue}/MNLI/train.tsv \
+    --valid_data ${GLUE:-glue}/MNLI/dev_matched.tsv \
+    --epochs 3 \
+    --model_name bert --load ${CKPT:-ckpts/bert} --finetune \
+    --tokenizer_type HFTokenizer --tokenizer_model bert-base-uncased \
+    --seq_length 128 --micro_batch_size 32 --global_batch_size 128 \
+    --lr 5e-5 --lr_warmup_fraction 0.065 --eval_interval 500 --log_interval 50

@@ -1,0 +1,10 @@
+#!/bin/bash
+# RACE multiple-choice finetune.
+python tasks/main.py --task RACE \
+    --train_data ${RACE:-RACE}/train/middle \
+    --valid_data ${RACE:-RACE}/dev/middle \
+    --epochs 3 \
+    --model_name bert --load ${CKPT:-ckpts/bert} --finetune \
+    --tokenizer_type HFTokenizer --tokenizer_model bert-base-uncased \
+    --seq_length 512 --micro_batch_size 4 --global_batch_size 32 \
+    --lr 1e-5 --lr_warmup_fraction 0.06 --eval_interval 500 --log_interval 50

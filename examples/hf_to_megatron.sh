@@ -1,0 +1,4 @@
+#!/bin/bash
+# Download + convert an HF model to a native checkpoint.
+python weights_conversion/hf_to_megatron.py --model ${MODEL:-llama2} \
+    --hf_model ${HF:-meta-llama/Llama-2-7b-hf} --save_dir ckpts/${MODEL:-llama2}

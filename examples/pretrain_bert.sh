@@ -1,0 +1,9 @@
+#!/bin/bash
+# BERT masked-LM + sentence-order pretraining.
+python pretrain_bert.py \
+    --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+    --data_path ${DATA:-/data/corpus_text_document} \
+    --tokenizer_type HFTokenizer --tokenizer_model bert-base-uncased \
+    --seq_length 512 --micro_batch_size 8 --global_batch_size 256 \
+    --train_iters 1000000 --lr 1e-4 --lr_warmup_fraction 0.01 \
+    --save ckpts/bert --save_interval 5000 --log_interval 100

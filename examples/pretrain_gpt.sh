@@ -1,0 +1,11 @@
+#!/bin/bash
+# GPT-family pretraining from scratch.
+python finetune.py \
+    --model_name llama2 \
+    --num_layers 24 --hidden_size 2048 --num_attention_heads 16 \
+    --data_path ${DATA:-/data/corpus_text_document} \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model ${TOK:-tok.model} \
+    --seq_length 2048 --micro_batch_size 4 --global_batch_size 256 \
+    --rampup_batch_size 32 32 1000000 \
+    --train_iters 100000 --lr 3e-4 --min_lr 3e-5 --lr_warmup_iters 2000 \
+    --save ckpts/gpt --save_interval 1000 --log_interval 100

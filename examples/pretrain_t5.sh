@@ -1,0 +1,10 @@
+#!/bin/bash
+# T5 span-corruption pretraining.
+python pretrain_t5.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --data_path ${DATA:-/data/corpus_text_document} \
+    --tokenizer_type HFTokenizer --tokenizer_model t5-base \
+    --seq_length 512 --decoder_seq_length 128 --vocab_extra_ids 100 \
+    --micro_batch_size 16 --global_batch_size 512 \
+    --train_iters 1000000 --lr 1e-4 --lr_warmup_iters 1000 \
+    --save ckpts/t5 --save_interval 5000 --log_interval 100

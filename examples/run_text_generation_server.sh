@@ -1,0 +1,7 @@
+#!/bin/bash
+# REST generation server (PUT /api) + CLI client.
+python tools/run_text_generation_server.py \
+    --model_name llama2 --load ${CKPT:-ckpts/llama2-7b} \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model ${TOK:-tok.model} \
+    --tensor_model_parallel_size 4 --port 5000
+# then: python tools/text_generation_cli.py localhost:5000

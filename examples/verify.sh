@@ -1,0 +1,6 @@
+#!/bin/bash
+# Logit-parity check vs HuggingFace (the correctness gate).
+python verify_correctness.py --model_name ${MODEL:-llama2} \
+    --load ${CKPT:-ckpts/llama2-7b} --hf_model ${HF:-meta-llama/Llama-2-7b-hf} \
+    --data_path ${DATA:-/data/corpus_text_document} \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model ${TOK:-tok.model}

@@ -51,6 +51,38 @@ def create_masked_lm_predictions(
     return out, np.asarray(picked, np.int64), np.asarray(labels, np.int64)
 
 
+def pack_pair(
+    tokens_a,
+    tokens_b,
+    max_seq_length: int,
+    cls_id: int,
+    sep_id: int,
+    pad_id: int,
+):
+    """[CLS] a [SEP] (b [SEP]) with 0/1 tokentypes + padding mask, truncating
+    the longer segment first (dataset_utils truncate_segments +
+    build_tokens_types_paddings_from_ids semantics). The single canonical
+    packing — the GLUE/RACE task datasets use it too (tasks/finetune_utils).
+
+    Returns (text [s], types [s], padding_mask [s]).
+    """
+    a = list(tokens_a)
+    b = list(tokens_b) if tokens_b is not None else []
+    budget = max_seq_length - (3 if b else 2)
+    while len(a) + len(b) > budget:
+        (a if len(a) >= len(b) else b).pop()
+    ids = [cls_id] + a + [sep_id] + (b + [sep_id] if b else [])
+    types = [0] * (len(a) + 2) + ([1] * (len(b) + 1) if b else [])
+    n = len(ids)
+    text = np.full((max_seq_length,), pad_id, np.int64)
+    text[:n] = ids
+    types_arr = np.zeros((max_seq_length,), np.int64)
+    types_arr[:n] = types
+    pad = np.zeros((max_seq_length,), np.float32)
+    pad[:n] = 1.0
+    return text, types_arr, pad
+
+
 def build_training_sample(
     tokens_a: np.ndarray,
     tokens_b: np.ndarray,
@@ -67,36 +99,31 @@ def build_training_sample(
 ) -> Dict[str, np.ndarray]:
     """bert_dataset.py build_training_sample analog: pack
     [CLS] A [SEP] B [SEP], types 0/1, mask, pad."""
-    max_tokens = max_seq_length - (3 if binary_head else 2)
-    # truncate the longer segment first (dataset_utils truncate_segments)
-    a, b = list(tokens_a), list(tokens_b) if binary_head else []
-    truncated = len(a) + len(b) > max_tokens
-    while len(a) + len(b) > max_tokens:
-        (a if len(a) >= len(b) else b).pop()
-    tokens = [cls_id] + a + [sep_id] + (b + [sep_id] if binary_head else [])
-    types = [0] * (len(a) + 2) + ([1] * (len(b) + 1) if binary_head else [])
-    tokens = np.asarray(tokens, np.int64)
+    overhead = 3 if binary_head else 2
+    b_in = tokens_b if binary_head else None
+    truncated = (
+        len(tokens_a) + (len(tokens_b) if binary_head else 0)
+        > max_seq_length - overhead
+    )
+    text, types_arr, padding_mask = pack_pair(
+        tokens_a, b_in, max_seq_length, cls_id, sep_id, pad_id
+    )
+    n = int(padding_mask.sum())
+    tokens = text[:n].copy()
 
-    max_pred = max(1, int(round(masked_lm_prob * len(tokens))))
+    max_pred = max(1, int(round(masked_lm_prob * n)))
     out, positions, masked_labels = create_masked_lm_predictions(
         tokens, vocab_size, mask_id, rng,
         masked_lm_prob=masked_lm_prob,
         max_predictions_per_seq=max_pred,
         special_ids=(cls_id, sep_id),
     )
-
-    n = len(out)
-    pad = max_seq_length - n
-    text = np.full((max_seq_length,), pad_id, np.int64)
     text[:n] = out
-    types_arr = np.zeros((max_seq_length,), np.int64)
-    types_arr[:n] = types
+
     labels = np.full((max_seq_length,), -1, np.int64)
     loss_mask = np.zeros((max_seq_length,), np.float32)
     labels[positions] = masked_labels
     loss_mask[positions] = 1.0
-    padding_mask = np.zeros((max_seq_length,), np.float32)
-    padding_mask[:n] = 1.0
     return {
         "text": text,
         "types": types_arr,

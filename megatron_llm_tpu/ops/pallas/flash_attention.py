@@ -107,8 +107,8 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bkv]
-        seg_q = segq_ref[0] if segmented else None
-        seg_kv = segkv_ref[0] if segmented else None
+        seg_q = segq_ref[0, 0] if segmented else None
+        seg_kv = segkv_ref[0, 0] if segmented else None
         if causal or sliding_window is not None or segmented:
             s = s + _mask(q_off, kv_off, block_q, block_kv, causal,
                           sliding_window, seg_q, seg_kv)
@@ -133,7 +133,8 @@ def _fwd_kernel(
         l = l_s[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
         o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_s[:, 0] + jnp.log(l_safe)).astype(jnp.float32)
+        # trailing singleton keeps the (sublane, lane) tile legal on TPU
+        lse_ref[0, 0, :, 0] = (m_s[:, 0] + jnp.log(l_safe)).astype(jnp.float32)
 
 
 def _fwd(
@@ -161,9 +162,11 @@ def _fwd(
     args = [q, k, v]
     segmented = seg_q is not None
     if segmented:
+        # [b, 1, s] layout: the unit middle dim keeps the block's
+        # second-to-last dimension equal to the array's (TPU tiling rule)
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh // n, qi)),
-            pl.BlockSpec((1, block_kv), lambda bh, qi, ki: (bh // n, ki)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh // n, 0, qi)),
+            pl.BlockSpec((1, 1, block_kv), lambda bh, qi, ki: (bh // n, 0, ki)),
         ]
         args += [seg_q, seg_kv]
 
@@ -178,12 +181,12 @@ def _fwd(
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bh, qi, ki: (bh // n, bh % n, qi)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, n, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -226,13 +229,13 @@ def _bwd_dq_kernel(
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        seg_q = segq_ref[0] if segmented else None
-        seg_kv = segkv_ref[0] if segmented else None
+        seg_q = segq_ref[0, 0] if segmented else None
+        seg_kv = segkv_ref[0, 0] if segmented else None
         if causal or sliding_window is not None or segmented:
             s = s + _mask(q_off, kv_off, block_q, block_kv, causal,
                           sliding_window, seg_q, seg_kv)
@@ -279,13 +282,13 @@ def _bwd_dkv_kernel(
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        seg_q = segq_ref[0] if segmented else None
-        seg_kv = segkv_ref[0] if segmented else None
+        seg_q = segq_ref[0, 0] if segmented else None
+        seg_kv = segkv_ref[0, 0] if segmented else None
         if causal or sliding_window is not None or segmented:
             s = s + _mask(q_off, kv_off, block_q, block_kv, causal,
                           sliding_window, seg_q, seg_kv)
@@ -320,7 +323,9 @@ def _bwd(
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [b, n, sq, 1] — same tiled layout as lse
 
     segmented = seg_q is not None
 
@@ -331,14 +336,16 @@ def _bwd(
         pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // n, (bh % n) // g, ki, 0)),
         pl.BlockSpec((1, 1, block_kv, d), lambda bh, qi, ki: (bh // n, (bh % n) // g, ki, 0)),
         pl.BlockSpec((1, 1, block_q, d), lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh // n, bh % n, qi)),
-        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh // n, bh % n, qi)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if segmented:
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh // n, qi)),
-            pl.BlockSpec((1, block_kv), lambda bh, qi, ki: (bh // n, ki)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh // n, 0, qi)),
+            pl.BlockSpec((1, 1, block_kv), lambda bh, qi, ki: (bh // n, 0, ki)),
         ]
         args += [seg_q, seg_kv]
     dq = pl.pallas_call(
@@ -367,16 +374,18 @@ def _bwd(
                      lambda bh, ki, gi, qi: (bh // nkv, bh % nkv, ki, 0)),
         pl.BlockSpec((1, 1, block_q, d),
                      lambda bh, ki, gi, qi: (bh // nkv, (bh % nkv) * g + gi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q),
-                     lambda bh, ki, gi, qi: (bh // nkv, (bh % nkv) * g + gi, qi)),
-        pl.BlockSpec((1, 1, block_q),
-                     lambda bh, ki, gi, qi: (bh // nkv, (bh % nkv) * g + gi, qi)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bh, ki, gi, qi: (bh // nkv, (bh % nkv) * g + gi, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bh, ki, gi, qi: (bh // nkv, (bh % nkv) * g + gi, qi, 0)),
     ]
     args = [q, k, v, do, lse, delta]
     if segmented:
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda bh, ki, gi, qi: (bh // nkv, qi)),
-            pl.BlockSpec((1, block_kv), lambda bh, ki, gi, qi: (bh // nkv, ki)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, ki, gi, qi: (bh // nkv, 0, qi)),
+            pl.BlockSpec((1, 1, block_kv),
+                         lambda bh, ki, gi, qi: (bh // nkv, 0, ki)),
         ]
         args += [seg_q, seg_kv]
     dk, dv = pl.pallas_call(
@@ -463,7 +472,10 @@ def flash_attention(
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
-    seg = segment_ids.astype(jnp.int32) if segment_ids is not None else None
+    seg = (
+        segment_ids.astype(jnp.int32)[:, None, :]
+        if segment_ids is not None else None
+    )
     out = _flash(qh, kh, vh, seg, seg, scale, causal, sliding_window,
                  block_q, block_kv, interpret)
     return out.transpose(0, 2, 1, 3)

@@ -12,32 +12,9 @@ from typing import Callable, Optional
 
 import numpy as np
 
-
-def pack_pair(
-    tokens_a,
-    tokens_b,
-    max_seq_length: int,
-    cls_id: int,
-    sep_id: int,
-    pad_id: int,
-):
-    """[CLS] a [SEP] (b [SEP]) with 0/1 tokentypes + padding mask
-    (reference build_tokens_types_paddings_from_ids, glue/data.py)."""
-    a = list(tokens_a)
-    b = list(tokens_b) if tokens_b is not None else []
-    budget = max_seq_length - (3 if b else 2)
-    while len(a) + len(b) > budget:
-        (a if len(a) >= len(b) else b).pop()
-    ids = [cls_id] + a + [sep_id] + (b + [sep_id] if b else [])
-    types = [0] * (len(a) + 2) + [1] * (len(b) + 1 if b else 0)
-    n = len(ids)
-    text = np.full((max_seq_length,), pad_id, np.int64)
-    text[:n] = ids
-    types_arr = np.zeros((max_seq_length,), np.int64)
-    types_arr[:n] = types
-    pad = np.zeros((max_seq_length,), np.float32)
-    pad[:n] = 1.0
-    return text, types_arr, pad
+# canonical packing lives with the BERT data pipeline; re-exported here for
+# the task datasets (one copy of the truncation/type layout)
+from megatron_llm_tpu.data.bert_dataset import pack_pair
 
 
 class ClassificationDataset:
@@ -104,8 +81,7 @@ def dataset_provider(train_ds, valid_ds):
     def provider(cfg, tokenizer, consumed_samples):
         t = cfg.training
         train_iter = build_pretraining_data_loader(
-            train_ds, consumed_samples % max(len(train_ds), 1),
-            t.global_batch_size, "cyclic", t.seed,
+            train_ds, consumed_samples, t.global_batch_size, "cyclic", t.seed,
         )
         valid_factory = (
             (lambda: build_pretraining_data_loader(

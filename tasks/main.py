@@ -90,65 +90,55 @@ def run_zeroshot(cfg, extra):
             )
         else:  # LAMBADA
             samples = load_lambada_jsonl(extra.valid_data, tokenizer.tokenize)
-            result = evaluate_lambada(cfg, params, samples)
+            result = evaluate_lambada(
+                cfg, params, samples, strict=extra.strict_lambada
+            )
     print({extra.task: result})
     return result
 
 
-def run_glue(cfg, extra):
+def _run_finetune(cfg, extra, dataset_cls, read_records, num_classes):
+    """Shared GLUE/RACE flow: tokenizer -> datasets -> epochs -> finetune."""
     from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
-    from tasks.finetune_utils import (
-        ClassificationDataset,
-        finetune_classification,
-    )
+    from tasks.finetune_utils import finetune_classification
+
+    tokenizer = build_tokenizer(cfg)
+    ids = _special_ids(tokenizer, cfg.model.vocab_size)
+
+    def make(path):
+        if not path:
+            return None
+        return dataset_cls(
+            read_records(path), tokenizer.tokenize, cfg.data.seq_length, **ids
+        )
+
+    train_ds = make(extra.train_data)
+    valid_ds = make(extra.valid_data)
+    if cfg.training.train_iters is None:
+        cfg.training.train_iters = max(
+            1, extra.epochs * len(train_ds) // cfg.training.global_batch_size
+        )
+    return finetune_classification(cfg, train_ds, valid_ds, num_classes)
+
+
+def run_glue(cfg, extra):
+    from tasks.finetune_utils import ClassificationDataset
     from tasks.glue.data import PROCESSORS
 
     proc = PROCESSORS[extra.task]()
-    tokenizer = build_tokenizer(cfg)
-    ids = _special_ids(tokenizer, cfg.model.vocab_size)
-    train_ds = ClassificationDataset(
-        proc.records(extra.train_data), tokenizer.tokenize,
-        cfg.data.seq_length, **ids,
+    return _run_finetune(
+        cfg, extra, ClassificationDataset, proc.records, proc.num_classes
     )
-    valid_ds = (
-        ClassificationDataset(
-            proc.records(extra.valid_data), tokenizer.tokenize,
-            cfg.data.seq_length, **ids,
-        ) if extra.valid_data else None
-    )
-    if cfg.training.train_iters is None:
-        cfg.training.train_iters = (
-            extra.epochs * len(train_ds) // cfg.training.global_batch_size
-        )
-    return finetune_classification(cfg, train_ds, valid_ds, proc.num_classes)
 
 
 def run_race(cfg, extra):
-    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
-    from tasks.finetune_utils import (
-        MultipleChoiceDataset,
-        finetune_classification,
-    )
+    from tasks.finetune_utils import MultipleChoiceDataset
     from tasks.race.data import read_race_records
 
-    tokenizer = build_tokenizer(cfg)
-    ids = _special_ids(tokenizer, cfg.model.vocab_size)
-    train_ds = MultipleChoiceDataset(
-        read_race_records(extra.train_data), tokenizer.tokenize,
-        cfg.data.seq_length, **ids,
-    )
-    valid_ds = (
-        MultipleChoiceDataset(
-            read_race_records(extra.valid_data), tokenizer.tokenize,
-            cfg.data.seq_length, **ids,
-        ) if extra.valid_data else None
-    )
-    if cfg.training.train_iters is None:
-        cfg.training.train_iters = (
-            extra.epochs * len(train_ds) // cfg.training.global_batch_size
-        )
     # multiple choice scores each option with a 1-logit head
-    return finetune_classification(cfg, train_ds, valid_ds, num_classes=1)
+    return _run_finetune(
+        cfg, extra, MultipleChoiceDataset, read_race_records, num_classes=1
+    )
 
 
 def main():
@@ -157,8 +147,7 @@ def main():
     # pull the task args off argv, pass the rest to the standard parser
     task_parser = get_tasks_args(argparse.ArgumentParser(allow_abbrev=False))
     extra, rest = task_parser.parse_known_args()
-    cfg = parse_args(rest, n_devices=len(jax.devices()), finalize=False)
-    cfg.finalize(n_devices=len(jax.devices()))
+    cfg = parse_args(rest, n_devices=len(jax.devices()))
 
     if extra.task in ("WIKITEXT103", "LAMBADA"):
         return run_zeroshot(cfg, extra)

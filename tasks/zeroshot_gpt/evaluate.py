@@ -46,17 +46,26 @@ def evaluate_wikitext_ppl(
     """
     seq = cfg.data.seq_length
     stream = np.asarray(token_stream, np.int32)
-    n_windows = (len(stream) - 1) // seq
-    assert n_windows > 0, "token stream shorter than one window"
+    assert len(stream) > 1, "token stream too short"
     score = _score_fn(cfg)
 
+    # full windows plus one zero-padded tail window, so total_loss covers the
+    # ENTIRE stream (the reference scores every token; dropping the tail
+    # would bias PPL low against num_original_tokens)
+    windows = []  # (row [seq+1], n_valid_targets)
+    pos = 0
+    while pos + 1 < len(stream):
+        chunk = stream[pos: pos + seq + 1]
+        row = np.zeros((seq + 1,), np.int32)
+        row[: len(chunk)] = chunk
+        windows.append((row, len(chunk) - 1))
+        pos += seq
+
     total_loss, total_tokens = 0.0, 0
-    for start in range(0, n_windows, batch_size):
-        rows = []
-        for w in range(start, min(start + batch_size, n_windows)):
-            rows.append(stream[w * seq: w * seq + seq + 1])
-        block = np.stack(rows)
-        pad_rows = batch_size - len(rows)
+    for start in range(0, len(windows), batch_size):
+        batch_rows = windows[start: start + batch_size]
+        block = np.stack([r for r, _ in batch_rows])
+        pad_rows = batch_size - len(batch_rows)
         if pad_rows:
             block = np.concatenate(
                 [block, np.zeros((pad_rows, seq + 1), np.int32)]
@@ -64,8 +73,9 @@ def evaluate_wikitext_ppl(
         per_token = np.asarray(
             score(params, jnp.asarray(block[:, :-1]), jnp.asarray(block[:, 1:]))
         )
-        total_loss += float(per_token[: len(rows)].sum())
-        total_tokens += len(rows) * seq
+        for i, (_, n_valid) in enumerate(batch_rows):
+            total_loss += float(per_token[i, :n_valid].sum())
+            total_tokens += n_valid
 
     denom = num_original_tokens or total_tokens
     ppl = float(np.exp(min(20.0, total_loss / denom)))
@@ -81,11 +91,14 @@ def evaluate_lambada(
     params,
     samples: Sequence[Tuple[Sequence[int], Sequence[int]]],
     batch_size: int = 8,
+    strict: bool = True,
 ) -> Dict[str, float]:
-    """Strict last-word accuracy: every token of the target word must be the
-    argmax prediction (reference evaluate.py LAMBADA branch, strict_lambada).
+    """LAMBADA cloze accuracy (reference evaluate.py LAMBADA branch).
 
-    ``samples``: (context_tokens, target_tokens) pairs.
+    ``strict`` (--strict_lambada): every token of the target word must be the
+    argmax prediction; non-strict scores only the first target token.
+    ``samples``: (context_tokens, target_tokens) pairs; empty-context samples
+    score as incorrect (nothing to condition on).
     """
     seq = cfg.data.seq_length
 
@@ -115,9 +128,11 @@ def evaluate_lambada(
             np.asarray(logits_fn(params, jnp.asarray(block[:, :-1]))), axis=-1
         )
         for i, (lo, hi) in enumerate(spans):
-            # prediction at position p-1 forecasts token p
-            ok = all(
-                preds[i, p - 1] == block[i, p] for p in range(lo, hi)
+            # prediction at position p-1 forecasts token p; lo == 0 means the
+            # context was empty (or fully truncated) — deterministic miss
+            end = hi if strict else min(lo + 1, hi)
+            ok = lo > 0 and all(
+                preds[i, p - 1] == block[i, p] for p in range(lo, end)
             )
             n_correct += int(ok)
             n_total += 1

@@ -1,0 +1,81 @@
+"""Corpus-cleaning tools (reference tools/openwebtext analogs)."""
+
+import json
+import subprocess
+import sys
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def run(script, *args):
+    return subprocess.run(
+        [sys.executable, f"{REPO}/tools/openwebtext/{script}", *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_blacklist_urls(tmp_path):
+    urls = tmp_path / "urls.txt"
+    urls.write_text(
+        "http://good.example.org/page\n"
+        "http://bad.example.com/x\n"
+        "http://sub.bad.example.com/y\n"       # subdomain of blacklisted
+        "http://good.example.org/page\n"        # duplicate
+        "http://other.org/casino-games\n"       # keyword
+    )
+    (tmp_path / "domains.txt").write_text("bad.example.com\n")
+    (tmp_path / "keywords.txt").write_text("casino\n")
+    out = tmp_path / "clean.txt"
+    r = run("blacklist_urls.py", str(urls), str(out),
+            "--domain_blacklist", str(tmp_path / "domains.txt"),
+            "--keyword_blacklist", str(tmp_path / "keywords.txt"))
+    assert r.returncode == 0, r.stderr
+    assert out.read_text().splitlines() == ["http://good.example.org/page"]
+
+
+def test_find_duplicates(tmp_path):
+    base = "the quick brown fox jumps over the lazy dog " * 20
+    docs = [
+        {"id": "a", "text": base},
+        {"id": "b", "text": base + "extra tail words here"},  # near-dup of a
+        {"id": "c", "text": "completely different content " * 30},
+    ]
+    src = tmp_path / "corpus.jsonl"
+    src.write_text("\n".join(json.dumps(d) for d in docs) + "\n")
+    out = tmp_path / "dups.txt"
+    r = run("find_duplicates.py", str(src), str(out), "--threshold", "0.5")
+    assert r.returncode == 0, r.stderr
+    groups = [set(line.split("\t")) for line in out.read_text().splitlines()]
+    assert {"a", "b"} in groups
+    assert all("c" not in g for g in groups)
+
+
+def test_filter_ngrams(tmp_path):
+    task = tmp_path / "task.jsonl"
+    task.write_text(json.dumps(
+        {"text": "the secret evaluation answer is forty two exactly"}
+    ) + "\n")
+    corpus = tmp_path / "corpus.jsonl"
+    corpus.write_text(
+        json.dumps({"text": "clean document " * 20}) + "\n"
+        + json.dumps({"text": "leaked: the secret evaluation answer is forty "
+                              "two exactly, plus more"}) + "\n"
+    )
+    out = tmp_path / "clean.jsonl"
+    r = run("filter_ngrams.py", str(corpus), str(out),
+            "--task_files", str(task), "--ngram_n", "5")
+    assert r.returncode == 0, r.stderr
+    lines = out.read_text().splitlines()
+    assert len(lines) == 1 and "clean document" in lines[0]
+
+
+def test_cleanup_dataset(tmp_path):
+    corpus = tmp_path / "corpus.jsonl"
+    corpus.write_text(
+        json.dumps({"text": "word " * 200}) + "\n"
+        + json.dumps({"text": "too short"}) + "\n"
+    )
+    out = tmp_path / "clean.jsonl"
+    r = run("cleanup_dataset.py", str(corpus), str(out), "--min_words", "100")
+    assert r.returncode == 0, r.stderr
+    assert len(out.read_text().splitlines()) == 1

@@ -1,0 +1,54 @@
+"""Minimal project linter (reference tools/linter.py analog).
+
+Checks: line length, tabs, trailing whitespace, and TODO-without-owner.
+
+    python tools/linter.py megatron_llm_tpu tools tasks tests
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+MAX_LEN = 100
+TODO_RE = re.compile(r"#\s*TODO(?!\()")
+
+
+def lint_file(path: str) -> int:
+    issues = 0
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for lineno, line in enumerate(f, 1):
+            stripped = line.rstrip("\n")
+            if len(stripped) > MAX_LEN:
+                print(f"{path}:{lineno}: line too long ({len(stripped)} chars)")
+                issues += 1
+            if "\t" in stripped:
+                print(f"{path}:{lineno}: tab character")
+                issues += 1
+            if stripped != stripped.rstrip():
+                print(f"{path}:{lineno}: trailing whitespace")
+                issues += 1
+            if TODO_RE.search(stripped):
+                print(f"{path}:{lineno}: TODO without owner — use TODO(name)")
+                issues += 1
+    return issues
+
+
+def main(argv):
+    targets = argv or ["megatron_llm_tpu"]
+    total = 0
+    for target in targets:
+        if os.path.isfile(target):
+            total += lint_file(target)
+            continue
+        for root, _dirs, files in os.walk(target):
+            for name in files:
+                if name.endswith(".py"):
+                    total += lint_file(os.path.join(root, name))
+    print(f"{total} issue(s)")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,79 @@
+"""Filter a URL list against domain/keyword blacklists.
+
+Reference: tools/openwebtext/blacklist_urls.py (299 LoC of hardcoded domain
+sets + dedup). This implementation takes the blacklists as files instead of
+hardcoding them; semantics (domain match incl. subdomains, substring keyword
+match, URL dedup) are the same.
+
+Usage:
+    python blacklist_urls.py urls.txt clean_urls.txt \
+        --domain_blacklist domains.txt --keyword_blacklist keywords.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from urllib.parse import urlparse
+
+
+def load_list(path):
+    if not path:
+        return set()
+    with open(path) as f:
+        return {line.strip().lower() for line in f if line.strip()}
+
+
+def domain_of(url: str) -> str:
+    try:
+        netloc = urlparse(url if "://" in url else "http://" + url).netloc
+    except ValueError:
+        return ""
+    return netloc.lower().split(":")[0]
+
+
+def domain_blacklisted(domain: str, blacklist: set) -> bool:
+    """Match the domain or any parent domain (subdomain coverage)."""
+    parts = domain.split(".")
+    return any(".".join(parts[i:]) in blacklist for i in range(len(parts)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--domain_blacklist", default=None)
+    ap.add_argument("--keyword_blacklist", default=None)
+    ap.add_argument("--max_len", type=int, default=2048)
+    args = ap.parse_args()
+
+    domains = load_list(args.domain_blacklist)
+    keywords = load_list(args.keyword_blacklist)
+
+    seen = set()
+    kept = dropped = 0
+    with open(args.input) as fin, open(args.output, "w") as fout:
+        for line in fin:
+            url = line.strip()
+            if not url or len(url) > args.max_len:
+                dropped += 1
+                continue
+            low = url.lower()
+            if low in seen:
+                dropped += 1
+                continue
+            seen.add(low)
+            dom = domain_of(url)
+            if not dom or domain_blacklisted(dom, domains):
+                dropped += 1
+                continue
+            if any(k in low for k in keywords):
+                dropped += 1
+                continue
+            fout.write(url + "\n")
+            kept += 1
+    print(f"kept {kept}, dropped {dropped}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

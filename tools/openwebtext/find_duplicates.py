@@ -1,0 +1,120 @@
+"""Near-duplicate document detection with MinHash + LSH banding.
+
+Reference: tools/openwebtext/find_duplicates.py (292 LoC, datasketch-based).
+This implementation is dependency-free: word-shingle MinHash signatures,
+banded LSH candidate generation, exact Jaccard confirmation.
+
+Input: jsonl with {"text": ..., "url"/"id": ...} per line.
+Output: one line per duplicate group (tab-separated ids).
+
+    python find_duplicates.py corpus.jsonl dups.txt --threshold 0.7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+MERSENNE = (1 << 61) - 1
+
+
+def shingles(text: str, k: int = 5):
+    words = text.lower().split()
+    if len(words) < k:
+        return {" ".join(words)} if words else set()
+    return {" ".join(words[i: i + k]) for i in range(len(words) - k + 1)}
+
+
+def minhash_signature(sh: set, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """sig[i] = min over shingles of (a_i * h + b_i) mod p."""
+    if not sh:
+        return np.full(a.shape, MERSENNE, np.uint64)
+    hv = np.fromiter(
+        (hash(s) & 0xFFFFFFFFFFFF for s in sh), np.uint64, len(sh)
+    )
+    # [num_perm, num_shingles]
+    vals = (a[:, None] * hv[None, :] + b[:, None]) % MERSENNE
+    return vals.min(axis=1)
+
+
+def jaccard(s1: set, s2: set) -> float:
+    if not s1 or not s2:
+        return 0.0
+    return len(s1 & s2) / len(s1 | s2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--threshold", type=float, default=0.7)
+    ap.add_argument("--num_perm", type=int, default=128)
+    ap.add_argument("--bands", type=int, default=16)
+    ap.add_argument("--shingle_k", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+
+    rows = args.num_perm // args.bands
+    rng = np.random.RandomState(args.seed)
+    a = rng.randint(1, MERSENNE, size=args.num_perm, dtype=np.uint64)
+    b = rng.randint(0, MERSENNE, size=args.num_perm, dtype=np.uint64)
+
+    ids, shingle_sets = [], []
+    buckets = defaultdict(list)  # (band, hash) -> doc indices
+    with open(args.input) as f:
+        for i, line in enumerate(f):
+            doc = json.loads(line)
+            doc_id = str(doc.get("url") or doc.get("id") or i)
+            sh = shingles(doc.get("text", ""), args.shingle_k)
+            sig = minhash_signature(sh, a, b)
+            ids.append(doc_id)
+            shingle_sets.append(sh)
+            for band in range(args.bands):
+                key = (band, hash(sig[band * rows: (band + 1) * rows].tobytes()))
+                buckets[key].append(i)
+
+    # candidate pairs from shared buckets, confirmed by exact Jaccard
+    parent = list(range(len(ids)))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(x, y):
+        rx, ry = find(x), find(y)
+        if rx != ry:
+            parent[ry] = rx
+
+    checked = set()
+    for members in buckets.values():
+        if len(members) < 2:
+            continue
+        for i in range(len(members)):
+            for j in range(i + 1, len(members)):
+                pair = (members[i], members[j])
+                if pair in checked:
+                    continue
+                checked.add(pair)
+                if jaccard(shingle_sets[pair[0]], shingle_sets[pair[1]]) >= args.threshold:
+                    union(*pair)
+
+    groups = defaultdict(list)
+    for i in range(len(ids)):
+        groups[find(i)].append(i)
+    n_groups = 0
+    with open(args.output, "w") as out:
+        for root, members in groups.items():
+            if len(members) > 1:
+                out.write("\t".join(ids[m] for m in members) + "\n")
+                n_groups += 1
+    print(f"{n_groups} duplicate groups over {len(ids)} docs", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

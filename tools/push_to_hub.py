@@ -1,0 +1,43 @@
+"""Upload a converted HF-format model directory to the HuggingFace Hub.
+
+Reference: tools/push_to_hub.py. Requires `huggingface_hub` (gated import —
+not part of the baked environment) and an auth token.
+
+    python tools/push_to_hub.py ./hf-out --repo_name org/model-name
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_dir", help="directory produced by megatron_to_hf.py")
+    ap.add_argument("--repo_name", required=True, help="e.g. my-org/my-model")
+    ap.add_argument("--private", action="store_true")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--commit_message", default="upload model")
+    args = ap.parse_args()
+
+    try:
+        from huggingface_hub import HfApi
+    except ImportError:
+        print("push_to_hub requires `pip install huggingface_hub`",
+              file=sys.stderr)
+        return 1
+
+    api = HfApi(token=args.token)
+    api.create_repo(args.repo_name, private=args.private, exist_ok=True)
+    api.upload_folder(
+        folder_path=args.model_dir,
+        repo_id=args.repo_name,
+        commit_message=args.commit_message,
+    )
+    print(f"uploaded {args.model_dir} -> {args.repo_name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
