@@ -140,6 +140,219 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
     return fn(stacked_layers, hidden_mb, aux_mb, token_idx_arr)
 
 
+# ---------------------------------------------------------------------------
+# True 1F1B: gradients computed inside the tick loop, O(pp) activation memory
+# ---------------------------------------------------------------------------
+
+
+def pipeline_1f1b_loss_and_grads(
+    cfg, mesh, params, batch: Dict[str, jax.Array], *,
+    rope=None, loss_scale=None, num_micro=None,
+):
+    """One-forward-one-backward pipeline schedule (schedules.py:606-722).
+
+    Unlike :func:`pipeline_loss_fn` (GPipe-style: autodiff through the tick
+    scan, which saves one stage-input per tick — O(M) activation memory),
+    this computes gradients INSIDE the loop: at tick t, stage s runs the
+    forward for microbatch ``t - s`` and the backward (via ``jax.vjp`` on the
+    saved stage input — rematerialized, the recompute analog of the
+    reference's activation checkpointing) for microbatch ``t - 2(pp-1) + s``.
+    Saved inputs live in a ring buffer of depth 2*pp — the O(pp) in-flight
+    memory discipline the reference gets from deallocate_output_tensor +
+    1F1B ordering (schedules.py:36-88,648-720).
+
+    The embedding, final norm, LM head and loss run inside the loop on their
+    owning stages (first/last); every stage computes them SPMD-style and the
+    unused results are masked — the head matmul on non-final stages is the
+    price of lockstep SPMD (~h*v/(12*h^2*L/pp) of a tick, a few percent).
+
+    Deterministic path only (dropout=0 — the Llama/Falcon/Mistral finetune
+    default). Returns (loss, grads) with grads matching the params tree.
+    """
+    assert cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0, (
+        "1f1b schedule currently supports deterministic training only; "
+        "use pipeline_schedule='gpipe' with dropout"
+    )
+    pp = cfg.parallel.pipeline_model_parallel_size
+    M = num_micro or cfg.parallel.num_micro_batches or 1
+    gbs = batch["tokens"].shape[0]
+    assert gbs % M == 0
+    mb = gbs // M
+    if rope is None:
+        rope = lm.make_rope_cache(cfg)
+    scale = loss_scale if loss_scale is not None else jnp.float32(1.0)
+
+    def split(x):
+        return x.reshape(M, mb, *x.shape[1:])
+
+    tokens = split(batch["tokens"])
+    labels = split(batch["labels"])
+    loss_mask = split(batch["loss_mask"]).astype(jnp.float32)
+    aux_mb = {}
+    for k in ("position_ids", "segment_ids"):
+        if batch.get(k) is not None:
+            aux_mb[k] = split(batch[k])
+    token_idx = batch.get("token_idx")
+    denom = jnp.maximum(loss_mask.sum(), 1.0)  # global token count
+
+    # params split: layers are pp-sharded; everything else ("outer": embedding,
+    # final_norm, lm_head if untied) is replicated and used at the ends.
+    layers = params["layers"]
+    outer = {k: v for k, v in params.items() if k != "layers"}
+
+    def embed_fn(outer_p, tok, aux):
+        return lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
+
+    def head_loss_fn(outer_p, hidden, lbl, msk):
+        h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
+                 cfg.model.use_rms_norm)
+        logits = lm.compute_logits(cfg, outer_p, h)
+        per_token = softmax_cross_entropy(logits, lbl)
+        return (per_token * msk).sum() / denom * scale
+
+    def body(layers_local, outer_p, tokens, labels, loss_mask, aux_mb,
+             token_idx_local):
+        stage = jax.lax.axis_index(PP_AXIS)
+        last = pp - 1
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        depth = 2 * pp
+        s_local = tokens.shape[2]
+        h = cfg.model.hidden_size
+        dtype = (
+            jnp.bfloat16 if cfg.training.params_dtype == "bfloat16"
+            else jnp.float16 if cfg.training.params_dtype == "float16"
+            else jnp.float32
+        )
+
+        def stage_fwd(L, x, aux):
+            return _stage_body(
+                cfg, L, x, aux,
+                token_idx_local if token_idx is not None else None,
+                None, True, rope,
+            )
+
+        def aux_at(i):
+            return jax.tree.map(lambda a: a[i], aux_mb)
+
+        def tick(carry, t):
+            x_recv, g_recv, saved, acc_L, acc_outer, loss_acc = carry
+            f_mb = t - stage
+            b_mb = t - 2 * (pp - 1) + stage
+            do_f = jnp.logical_and(f_mb >= 0, f_mb < M)
+            do_b = jnp.logical_and(b_mb >= 0, b_mb < M)
+            f_idx = jnp.clip(f_mb, 0, M - 1)
+            b_idx = jnp.clip(b_mb, 0, M - 1)
+
+            # ---- forward: embed on stage 0, else the ppermuted stream ----
+            x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx))
+            x_in = jnp.where(stage == 0, x_emb, x_recv).astype(dtype)
+            # guard the save: during cooldown f_idx clips to M-1, whose slot
+            # may still be awaiting its backward
+            saved_upd = jax.lax.dynamic_update_index_in_dim(
+                saved, x_in, f_idx % depth, 0
+            )
+            saved = jnp.where(do_f, saved_upd, saved)
+            y = stage_fwd(layers_local, x_in, aux_at(f_idx))
+
+            # ---- head + loss on the last stage's fresh output ----
+            loss_f, head_vjp = jax.vjp(
+                lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
+                                            loss_mask[f_idx]),
+                outer_p, y,
+            )
+            use_head = jnp.logical_and(stage == last, do_f)
+            d_outer_head, dy = head_vjp(jnp.float32(1.0))
+            loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
+            acc_outer = jax.tree.map(
+                lambda a, g: a + jnp.where(use_head, g, jnp.zeros_like(g)),
+                acc_outer, d_outer_head,
+            )
+
+            # ---- backward for the older microbatch (remat from saved x) ----
+            g_in = jnp.where(stage == last, dy.astype(dtype), g_recv)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                saved, b_idx % depth, 0, keepdims=False
+            )
+            _, stage_vjp = jax.vjp(
+                lambda L, xx: stage_fwd(L, xx, aux_at(b_idx)),
+                layers_local, x_saved,
+            )
+            dlayers, dx = stage_vjp(g_in)
+            acc_L = jax.tree.map(
+                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)),
+                acc_L, dlayers,
+            )
+
+            # ---- embedding backward on stage 0 ----
+            _, emb_vjp = jax.vjp(
+                lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx)), outer_p
+            )
+            (d_outer_emb,) = emb_vjp(dx)
+            use_emb = jnp.logical_and(stage == 0, do_b)
+            acc_outer = jax.tree.map(
+                lambda a, g: a + jnp.where(use_emb, g, jnp.zeros_like(g)),
+                acc_outer, d_outer_emb,
+            )
+
+            x_next = jax.lax.ppermute(y.astype(dtype), PP_AXIS, perm_fwd)
+            g_next = jax.lax.ppermute(dx, PP_AXIS, perm_bwd)
+            return (x_next, g_next, saved, acc_L, acc_outer, loss_acc), None
+
+        zero_x = jnp.zeros((mb, s_local, h), dtype)
+        init = (
+            zero_x,
+            zero_x,
+            jnp.zeros((depth, mb, s_local, h), dtype),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         layers_local),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), outer_p),
+            jnp.float32(0.0),
+        )
+        (_, _, _, acc_L, acc_outer, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + 2 * (pp - 1))
+        )
+        # cp shards contribute partial sums over their seq chunks; pp stages
+        # hold zeros for params they do not own (outer) — psum both.
+        acc_L = jax.lax.psum(acc_L, CP_AXIS)
+        acc_outer = jax.lax.psum(
+            jax.lax.psum(acc_outer, PP_AXIS), CP_AXIS
+        )
+        loss_acc = jax.lax.psum(jax.lax.psum(loss_acc, PP_AXIS), CP_AXIS)
+        return acc_L, acc_outer, loss_acc
+
+    P = jax.sharding.PartitionSpec
+    data_spec = P(None, None, CP_AXIS)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(PP_AXIS), layers),
+            jax.tree.map(lambda _: P(), outer),
+            data_spec, data_spec, data_spec,
+            jax.tree.map(lambda _: data_spec, aux_mb),
+            P(CP_AXIS),
+        ),
+        out_specs=(
+            jax.tree.map(lambda _: P(PP_AXIS), layers),
+            jax.tree.map(lambda _: P(), outer),
+            P(),
+        ),
+        axis_names={PP_AXIS, CP_AXIS},
+        check_vma=False,
+    )
+    if token_idx is None:
+        token_idx_arr = jnp.full((tokens.shape[2],), -1, jnp.int32)
+    else:
+        token_idx_arr = token_idx
+    grads_L, grads_outer, loss = fn(
+        layers, outer, tokens, labels, loss_mask, aux_mb, token_idx_arr
+    )
+    grads = dict(grads_outer)
+    grads["layers"] = grads_L
+    return loss, grads
+
+
 def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
                      dropout_key=None, deterministic=True, rope=None,
                      sp_constraint=None, num_micro=None):

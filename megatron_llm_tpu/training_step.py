@@ -128,20 +128,34 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                 "pipeline parallelism currently supports the GPT-family LM "
                 "loss only"
             )
-            from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
-
             deterministic = (
                 cfg.model.hidden_dropout == 0.0
                 and cfg.model.attention_dropout == 0.0
             )
-            loss, grads = jax.value_and_grad(
-                lambda p: pipeline_loss_fn(
-                    cfg, mesh, p, batch,
-                    dropout_key=None if deterministic else base_key,
-                    deterministic=deterministic, rope=rope,
-                    sp_constraint=sp_constraint, num_micro=num_micro,
-                )[0] * jax.lax.stop_gradient(scale)
-            )(params)
+            if cfg.parallel.pipeline_schedule == "1f1b" and deterministic:
+                # true 1F1B: grads computed inside the tick loop, O(pp)
+                # activation memory (parallel/pipeline.py)
+                from megatron_llm_tpu.parallel.pipeline import (
+                    pipeline_1f1b_loss_and_grads,
+                )
+
+                loss, grads = pipeline_1f1b_loss_and_grads(
+                    cfg, mesh, params, batch, rope=rope,
+                    loss_scale=jax.lax.stop_gradient(scale),
+                    num_micro=num_micro,
+                )
+            else:
+                # GPipe-style: autodiff through the tick scan
+                from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
+
+                loss, grads = jax.value_and_grad(
+                    lambda p: pipeline_loss_fn(
+                        cfg, mesh, p, batch,
+                        dropout_key=None if deterministic else base_key,
+                        deterministic=deterministic, rope=rope,
+                        sp_constraint=sp_constraint, num_micro=num_micro,
+                    )[0] * jax.lax.stop_gradient(scale)
+                )(params)
         elif num_micro == 1:
             loss, grads = grad_fn(params, batch, base_key)
         else:
