@@ -13,6 +13,7 @@ Output: one line per duplicate group (tab-separated ids).
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import sys
 from collections import defaultdict
@@ -20,6 +21,36 @@ from collections import defaultdict
 import numpy as np
 
 MERSENNE = (1 << 61) - 1
+
+
+def stable_hash(s: str) -> int:
+    """Process-independent 48-bit string hash (builtin hash() is randomized
+    per interpreter via PYTHONHASHSEED, which made runs non-reproducible)."""
+    return int.from_bytes(hashlib.blake2b(s.encode(), digest_size=6).digest(),
+                          "little")
+
+
+def optimal_band_rows(threshold: float, num_perm: int) -> tuple[int, int]:
+    """Pick (bands, rows) so the LSH S-curve crosses near `threshold`.
+
+    Minimizes false-positive + false-negative probability integrals (the
+    datasketch parameter search the reference's find_duplicates.py relies on).
+    A fixed banding (e.g. 16x8) detects a pair at exactly the threshold with
+    probability 1-(1-t^r)^b, which for t=0.5, r=8 is ~9% — useless.
+    """
+    best, best_err = (16, num_perm // 16), float("inf")
+    xs = np.linspace(0, 1, 101)
+    for b in range(1, num_perm + 1):
+        if num_perm % b:
+            continue
+        r = num_perm // b
+        p_detect = 1.0 - (1.0 - xs ** r) ** b
+        fp = np.trapz(p_detect[xs < threshold], xs[xs < threshold])
+        fn = np.trapz(1.0 - p_detect[xs >= threshold], xs[xs >= threshold])
+        err = fp + fn
+        if err < best_err:
+            best, best_err = (b, r), err
+    return best
 
 
 def shingles(text: str, k: int = 5):
@@ -33,9 +64,7 @@ def minhash_signature(sh: set, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """sig[i] = min over shingles of (a_i * h + b_i) mod p."""
     if not sh:
         return np.full(a.shape, MERSENNE, np.uint64)
-    hv = np.fromiter(
-        (hash(s) & 0xFFFFFFFFFFFF for s in sh), np.uint64, len(sh)
-    )
+    hv = np.fromiter((stable_hash(s) for s in sh), np.uint64, len(sh))
     # [num_perm, num_shingles]
     vals = (a[:, None] * hv[None, :] + b[:, None]) % MERSENNE
     return vals.min(axis=1)
@@ -53,12 +82,14 @@ def main():
     ap.add_argument("output")
     ap.add_argument("--threshold", type=float, default=0.7)
     ap.add_argument("--num_perm", type=int, default=128)
-    ap.add_argument("--bands", type=int, default=16)
+    ap.add_argument("--bands", type=int, default=0,
+                    help="0 = auto (optimal for --threshold)")
     ap.add_argument("--shingle_k", type=int, default=5)
     ap.add_argument("--seed", type=int, default=1234)
     args = ap.parse_args()
 
-    rows = args.num_perm // args.bands
+    bands = args.bands or optimal_band_rows(args.threshold, args.num_perm)[0]
+    rows = args.num_perm // bands
     rng = np.random.RandomState(args.seed)
     a = rng.randint(1, MERSENNE, size=args.num_perm, dtype=np.uint64)
     b = rng.randint(0, MERSENNE, size=args.num_perm, dtype=np.uint64)
@@ -73,8 +104,8 @@ def main():
             sig = minhash_signature(sh, a, b)
             ids.append(doc_id)
             shingle_sets.append(sh)
-            for band in range(args.bands):
-                key = (band, hash(sig[band * rows: (band + 1) * rows].tobytes()))
+            for band in range(bands):
+                key = (band, sig[band * rows: (band + 1) * rows].tobytes())
                 buckets[key].append(i)
 
     # candidate pairs from shared buckets, confirmed by exact Jaccard
