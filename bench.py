@@ -1,23 +1,40 @@
 """Single-chip training benchmark — prints ONE JSON line for the driver.
 
 Metric: model FLOPs utilization (MFU) of a bf16 Llama-2-style training step
-(~470M params, seq 1024) on the local chip.
+(~470M params, micro-batch 8, seq 1024, selective recompute, Pallas flash
+attention) on the local chip.
 
 Baseline (BASELINE.md): the reference's only published number is ~7.1k tok/s
-for Llama-2-7B on one 8x A100-80GB node (DP=2 TP=4, seq 1024). That implies
-    7.1e3 tok/s * 6 * 7e9 FLOP/tok / 8 GPUs / 312e12 peak  ~= 11.9% MFU.
-``vs_baseline`` is our MFU / 11.9% — an apples-to-apples utilization ratio
+for Llama-2-7B on one 8x A100-80GB node (DP=2 TP=4, seq 1024,
+docs/guide/getting_started.md:205). With the same FLOP accounting used here
+(6*N dense + 6*L*s*h causal-attention matmul FLOPs per token):
+    7.1e3 tok/s * 41.2e9 FLOP/tok / (8 * 312e12 peak) ~= 11.7% MFU.
+``vs_baseline`` is our MFU / 11.7% — an apples-to-apples utilization ratio
 across different hardware.
+
+Robustness (the round-1 bench died with a raw traceback when the TPU tunnel
+was down, and its `block_until_ready`-based timing is unreliable through the
+axon tunnel — it understated MFU by ~3x):
+  * the backend is probed in a subprocess with a bounded timeout, falling
+    back to CPU (nominal peak) with `"backend": "cpu"` in the output;
+  * a watchdog thread emits a structured JSON error line and exits if the
+    whole run exceeds --watchdog seconds;
+  * timing forces real device->host fetches (float()), which the tunnel
+    cannot satisfy before the step has executed;
+  * compile time and steady-state step time are reported separately;
+  * any exception is reported as a structured JSON line, never a bare
+    traceback.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-
 
 PEAK_BF16_FLOPS = {
     # per-chip peak dense bf16 FLOP/s
@@ -27,12 +44,45 @@ PEAK_BF16_FLOPS = {
     "v5p": 459e12,
     "v4": 275e12,
     "v6e": 918e12,
-    "cpu": 1e12,  # nominal, so the script still runs off-TPU
+    "cpu": 1e12,  # nominal, so the script still produces a line off-TPU
 }
-BASELINE_MFU = 0.119  # reference 8xA100 node, see module docstring
+BASELINE_MFU = 0.117  # reference 8xA100 node, see module docstring
+METRIC = "train_mfu_llama_470m_seq1024_1chip"
+
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def fail(reason: str, **extra) -> None:
+    emit({"metric": METRIC, "value": 0.0, "unit": "%MFU", "vs_baseline": 0.0,
+          "error": reason, **extra})
+
+
+def probe_backend(timeout_s: float = 120.0) -> str:
+    """Return 'tpu'|'cpu': can the preset backend run a matmul end to end?
+
+    Runs in a subprocess so a wedged TPU tunnel (which hangs arbitrary jax
+    calls, including jax.devices()) cannot hang the benchmark itself.
+    """
+    probe = ("import jax, jax.numpy as jnp;"
+             "x = jnp.ones((256, 256), jnp.bfloat16);"
+             "v = float((x @ x).sum());"
+             "print(jax.devices()[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return "cpu"
+    if r.returncode != 0:
+        return "cpu"
+    plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+    return "tpu" if plat not in ("", "cpu") else "cpu"
 
 
 def peak_flops() -> float:
+    import jax
+
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", "cpu").lower().replace(" ", "")
     for key, val in PEAK_BF16_FLOPS.items():
@@ -41,26 +91,38 @@ def peak_flops() -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def main():
-    from megatron_llm_tpu.models import (
-        init_model_params,
-        make_config,
-        padded_vocab_size,
-    )
-    from megatron_llm_tpu.training_step import make_jitted_train_step
-    from megatron_llm_tpu.core.parallel_state import build_mesh
+def flops_per_token(n_params: int, num_layers: int, hidden: int, seq: int) -> float:
+    """6N dense + causal attention matmuls (QK^T and AV, fwd+bwd):
+    4*s^2*h per layer per sequence non-causal fwd, /2 causal, x3 fwd+bwd
+    => 6*L*s*h per token. Same family of formulas as the reference's FLOP
+    estimate (language_model.py:370-384), with the attention term included
+    so long-seq configs are not under-credited."""
+    return 6.0 * n_params + 6.0 * num_layers * seq * hidden
 
-    seq, mbs = 1024, 4
+
+def run_bench(iters: int, mbs: int, seq: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.core.parallel_state import build_mesh
+    from megatron_llm_tpu.models import init_model_params, make_config
+    from megatron_llm_tpu.training_step import make_jitted_train_step
+
+    layers, hidden = 24, 1024
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        # fallback exists to produce *a* line, not a meaningful number
+        iters, mbs, layers = 2, 2, 2
     cfg = make_config(
         "llama2",
-        num_layers=24,
-        hidden_size=1024,
+        num_layers=layers,
+        hidden_size=hidden,
         num_attention_heads=16,
         num_attention_heads_kv=16,
         ffn_hidden_size=4096,
         vocab_size=32000,
         seq_length=seq,
-        max_position_embeddings=2048,
+        max_position_embeddings=max(2048, seq),
         params_dtype="bfloat16",
         micro_batch_size=mbs,
         global_batch_size=mbs,
@@ -81,33 +143,108 @@ def main():
             "loss_mask": jnp.ones((mbs, seq), jnp.float32),
         })
 
-        # warmup / compile
-        params, opt_state, m = step(params, opt_state, batch, 0)
-        jax.block_until_ready(m["lm loss"])
+        # multi-step scan: one dispatch per `iters` steps, so per-call
+        # latency of the axon HTTP tunnel (100ms+, absent on a directly
+        # attached TPU) does not pollute the throughput measurement
+        def multi_step(p, o, b):
+            def body(carry, it):
+                p, o = carry
+                p, o, m = step(p, o, b, it)
+                return (p, o), m["lm loss"]
 
-        iters = 10
+            (p, o), losses = jax.lax.scan(body, (p, o), jnp.arange(iters))
+            return p, o, losses
+
+        multi_step = jax.jit(multi_step, donate_argnums=(0, 1))
+
+        # compile + warmup; float() forces a real round trip through the
+        # tunnel (block_until_ready alone returns early through axon)
         t0 = time.perf_counter()
-        for i in range(1, iters + 1):
-            params, opt_state, m = step(params, opt_state, batch, i)
-        jax.block_until_ready(m["lm loss"])
-        dt = (time.perf_counter() - t0) / iters
+        params, opt_state, losses = multi_step(params, opt_state, batch)
+        loss0 = float(losses[0])
+        compile_s = time.perf_counter() - t0
 
-    tokens_per_sec = mbs * seq / dt
-    # 6*N*T for fwd+bwd matmul FLOPs + attention term 12*L*h*s^2-ish; use the
-    # standard 6*N approximation (reference FLOP estimate,
-    # language_model.py:370-384, uses the same family of formulas).
-    model_flops = 6.0 * n_params * mbs * seq
-    mfu = (model_flops / dt) / peak_flops()
-    print(json.dumps({
-        "metric": "train_mfu_llama_470m_seq1024_1chip",
+        reps = []
+        for _ in range(1 if on_cpu else 3):
+            t0 = time.perf_counter()
+            params, opt_state, losses = multi_step(params, opt_state, batch)
+            loss = float(losses[-1])  # forced fetch = completion barrier
+            reps.append((time.perf_counter() - t0) / iters)
+        dt = min(reps)
+
+        # secondary: per-dispatch step time (what a host-driven loop sees
+        # through this tunnel; on directly attached TPUs dispatch is ~us)
+        dispatch_dt = dt
+        if not on_cpu:
+            t0 = time.perf_counter()
+            for i in range(5):
+                params, opt_state, m = step(params, opt_state, batch, i)
+            _ = float(m["lm loss"])
+            dispatch_dt = (time.perf_counter() - t0) / 5
+
+    mem = {}
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "peak_bytes_in_use" in stats:
+            mem["peak_hbm_gib"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
+    except Exception:
+        pass
+
+    mfu = flops_per_token(n_params, layers, hidden, seq) * mbs * seq / dt / peak_flops()
+    return {
+        "metric": METRIC,
         "value": round(mfu * 100, 2),
         "unit": "%MFU",
         "vs_baseline": round(mfu / BASELINE_MFU, 3),
-        "tokens_per_sec": round(tokens_per_sec, 1),
+        "tokens_per_sec": round(mbs * seq / dt, 1),
         "step_time_s": round(dt, 4),
+        "step_time_dispatch_s": round(dispatch_dt, 4),
+        "compile_time_s": round(compile_s, 1),
         "n_params": n_params,
-        "loss": round(float(m["lm loss"]), 4),
-    }))
+        "loss": round(loss, 4),
+        # sanity signal, not a gate: a valid timing is reported either way
+        "loss_descended": bool(loss < loss0),
+        "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        **mem,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--mbs", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--watchdog", type=float, default=1500.0)
+    args = ap.parse_args()
+
+    finished = threading.Event()
+
+    def on_timeout():
+        if finished.is_set():  # result already emitted; don't double-print
+            return
+        fail(f"watchdog: bench exceeded {args.watchdog}s")
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    if probe_backend(args.probe_timeout) == "cpu":
+        from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+    try:
+        result = run_bench(args.iters, args.mbs, args.seq)
+        finished.set()
+        dog.cancel()
+        emit(result)
+    except Exception as e:  # structured error, never a bare traceback
+        finished.set()
+        dog.cancel()
+        fail(f"{type(e).__name__}: {e}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+"""CPU-platform pinning for hermetic (non-TPU) runs.
+
+The axon TPU environment presets JAX_PLATFORMS=axon and registers its PJRT
+plugin at interpreter startup via sitecustomize whenever PALLAS_AXON_POOL_IPS
+is set — plugin registration wins over the env var, so an unpinned "CPU" run
+silently targets the single-chip TPU tunnel (and hangs when the tunnel is
+wedged). This is the single shared implementation of the pinning dance used
+by tests/conftest.py, __graft_entry__.py and bench.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_platform(n_devices: int | None = None) -> None:
+    """Force jax onto the host CPU backend; optionally request `n_devices`
+    virtual CPU devices. Must run before any jax backend is initialized."""
+    if n_devices is not None:
+        # Append unconditionally: the later flag wins within XLA_FLAGS, so a
+        # preset count from some other harness is overridden, not kept.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    # Plugin registration from sitecustomize beats env vars; the config pin
+    # beats the plugin as long as no backend has been initialized yet.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # If a backend was already initialized the pin is a silent no-op and the
+    # "hermetic CPU" run would target the TPU tunnel — fail loudly instead.
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            "pin_cpu_platform called after a non-CPU jax backend was "
+            f"initialized ({jax.default_backend()}); pin before any jax use")

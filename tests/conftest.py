@@ -2,24 +2,11 @@
 under torchrun — tests/test_utilities.py:6; we simulate the mesh on CPU,
 which the reference cannot do)."""
 
-import os
+# Must run before any jax backend init: tests are hermetic on an 8-device
+# virtual CPU mesh even when the axon TPU tunnel env is present.
+from megatron_llm_tpu.utils.platform import pin_cpu_platform
 
-# Must be set before jax is imported anywhere. Force (not setdefault): the
-# axon TPU tunnel env presets JAX_PLATFORMS=axon and registers the tunnel in
-# every python process via sitecustomize when PALLAS_AXON_POOL_IPS is set —
-# tests must run hermetically on the virtual CPU mesh.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-
-# The axon sitecustomize registers its PJRT plugin at interpreter startup
-# (before conftest runs), which wins over the env var — pin the platform via
-# jax.config too, which takes effect as long as no backend is initialized yet.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_platform(n_devices=8)
 
 import pytest  # noqa: E402
 

@@ -45,8 +45,9 @@ def optimal_band_rows(threshold: float, num_perm: int) -> tuple[int, int]:
             continue
         r = num_perm // b
         p_detect = 1.0 - (1.0 - xs ** r) ** b
-        fp = np.trapz(p_detect[xs < threshold], xs[xs < threshold])
-        fn = np.trapz(1.0 - p_detect[xs >= threshold], xs[xs >= threshold])
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        fp = trapezoid(p_detect[xs < threshold], xs[xs < threshold])
+        fn = trapezoid(1.0 - p_detect[xs >= threshold], xs[xs >= threshold])
         err = fp + fn
         if err < best_err:
             best, best_err = (b, r), err
