@@ -161,8 +161,8 @@ def attention(
     use_flash: bool = True,
     dropout_rate: float = 0.0,
     dropout_key: Optional[jax.Array] = None,
-    block_q: int = 512,
-    block_kv: int = 512,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
 ) -> jax.Array:
     """Dispatch between ring attention (cp > 1), the Pallas flash kernel,
     and the XLA fallback."""

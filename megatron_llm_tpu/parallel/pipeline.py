@@ -392,10 +392,28 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
         deterministic, rope, token_idx=token_idx,
     )
 
-    hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,
-                  cfg.model.use_rms_norm)
-    logits = lm.compute_logits(cfg, params, hidden)  # [M, mb, s, v]
-    per_token = softmax_cross_entropy(logits, labels)
-    mask = loss_mask.astype(jnp.float32)
-    loss = (per_token * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    # Head + CE one microbatch at a time: materializing [M, mb, s, v] logits
+    # for the whole global batch (vocab 32k, seq 4k, M=16 -> tens of GB)
+    # would defeat microbatching. Matches the non-pp path's discipline
+    # (training_step.py grad-accumulation scan).
+    # remat: without it the scan's VJP saves each iteration's logits as
+    # residuals — cumulatively the same [M, mb, s, v] footprint again
+    @functools.partial(jax.checkpoint, policy=None)
+    def ce_loss_sum(hid, lbl, msk):
+        h = norm(hid, params["final_norm"], cfg.model.layernorm_epsilon,
+                 cfg.model.use_rms_norm)
+        logits = lm.compute_logits(cfg, params, h)  # [mb, s, v]
+        per_token = softmax_cross_entropy(logits, lbl)
+        return (per_token * msk.astype(jnp.float32)).sum()
+
+    def ce_mb(carry, inp):
+        hid, lbl, msk = inp
+        loss_sum, mask_sum = carry
+        return (loss_sum + ce_loss_sum(hid, lbl, msk),
+                mask_sum + msk.astype(jnp.float32).sum()), None
+
+    (loss_sum, mask_sum), _ = jax.lax.scan(
+        ce_mb, (jnp.float32(0.0), jnp.float32(0.0)), (hidden, labels, loss_mask)
+    )
+    loss = loss_sum / jnp.maximum(mask_sum, 1.0)
     return loss, {"lm loss": loss}

@@ -65,6 +65,38 @@ def test_pp2_matches_pp1(eight_devices):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
+def test_gpipe_ce_memory_bounded(eight_devices):
+    """The pipelined path must never materialize the full [M, mb, s, vocab]
+    logits (VERDICT weak #3): per-microbatch CE + remat keeps compiled temp
+    memory well under the full-logits footprint at M=8, vocab 32k."""
+    import jax.numpy as jnp
+
+    M, mb, s, v = 8, 2, 128, 32000
+    cfg = make_config(
+        "llama2", num_layers=4, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, vocab_size=v, seq_length=s,
+        max_position_embeddings=2 * s, params_dtype="float32",
+        use_flash_attn=False, pipeline_model_parallel_size=2,
+        micro_batch_size=mb, global_batch_size=M * mb, train_iters=10, lr=1e-2,
+    )
+    cfg.parallel.num_micro_batches = M
+    cfg.parallel.pipeline_schedule = "gpipe"
+    mesh = build_mesh(pipeline_model_parallel_size=2, devices=eight_devices[:2])
+    with mesh:
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        step, _o, sh = make_jitted_train_step(cfg, mesh, params)
+        tok = jnp.zeros((M * mb, s + 1), jnp.int32)
+        batch = {"tokens": tok[:, :-1], "labels": tok[:, 1:],
+                 "loss_mask": jnp.ones((M * mb, s), jnp.float32)}
+        ma = step.lower(params, sh["opt_state_value"], batch, 0) \
+                 .compile().memory_analysis()
+    full_logits_bytes = M * mb * s * v * 4
+    assert ma.temp_size_in_bytes < full_logits_bytes, (
+        f"temp {ma.temp_size_in_bytes / 2**20:.0f} MiB >= full-logits "
+        f"{full_logits_bytes / 2**20:.0f} MiB: CE is materializing the batch"
+    )
+
+
 def test_pp4_with_tp2_matches_pp1(eight_devices):
     loss1, p1 = run_one_step(cfg_for(pp=1), eight_devices[:1])
     loss2, p2 = run_one_step(cfg_for(pp=4, tp=2, num_micro=4), eight_devices[:8])

@@ -1,0 +1,235 @@
+"""Validate + profile the Pallas kernels on real TPU hardware.
+
+The CPU test suite runs every kernel in interpret mode
+(tests/test_flash_attention.py); this tool is the hardware half of the
+reference's fused-kernel test discipline (fused_kernels/tests/
+test_fused_kernels.py): compiled-vs-interpret numerics, block-size timing
+sweeps, and a long-sequence (32K) memory-fit check.
+
+Usage (on a TPU host):
+    python tools/tpu_kernel_check.py [--quick]
+
+Prints one PASS/FAIL line per check and a timing table; exit code 0 iff all
+checks pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+FAILURES: list[str] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    line = f"{'PASS' if ok else 'FAIL'} {name}"
+    if detail:
+        line += f"  ({detail})"
+    print(line, flush=True)
+    if not ok:
+        FAILURES.append(name)
+
+
+def rand_qkv(key, b, s, n, nkv, d, dtype=jnp.bfloat16):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n, d), dtype)
+    k = jax.random.normal(kk, (b, s, nkv, d), dtype)
+    v = jax.random.normal(kv, (b, s, nkv, d), dtype)
+    return q, k, v
+
+
+def max_err(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def numerics_checks():
+    """Compiled TPU kernel vs interpret-mode ground truth, fwd + bwd."""
+    from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+
+    cases = [
+        # name, b, s, n, nkv, d, window, segmented
+        ("causal", 2, 1024, 8, 8, 128, None, False),
+        ("gqa4", 2, 1024, 8, 2, 128, None, False),
+        ("sliding256", 1, 2048, 4, 4, 128, 256, False),
+        ("segments", 1, 1024, 4, 4, 128, None, True),
+        ("gqa_sliding", 1, 2048, 8, 2, 128, 512, False),
+        ("d256", 1, 2048, 4, 4, 256, None, False),  # VMEM cap path
+    ]
+    for name, b, s, n, nkv, d, window, segmented in cases:
+        q, k, v = rand_qkv(jax.random.PRNGKey(17), b, s, n, nkv, d)
+        seg = None
+        if segmented:
+            seg = (jnp.arange(s)[None, :] >= s // 3).astype(jnp.int32)
+            seg = jnp.broadcast_to(seg, (b, s))
+
+        def f(q, k, v, interpret):
+            out = flash_attention(q, k, v, causal=True, sliding_window=window,
+                                  segment_ids=seg, interpret=interpret)
+            return (out.astype(jnp.float32) * 0.01).sum(), out
+
+        (_, out_t), grads_t = jax.value_and_grad(f, argnums=(0, 1, 2), has_aux=True)(
+            q, k, v, None)  # None = compiled on TPU, interpret on CPU
+        (_, out_i), grads_i = jax.value_and_grad(f, argnums=(0, 1, 2), has_aux=True)(
+            q, k, v, True)
+
+        e_out = max_err(out_t, out_i)
+        # bf16 inputs, fp32 internals: interpret and MXU differ by bf16 ulp
+        check(f"flash fwd {name}", e_out < 0.05, f"max_err={e_out:.2e}")
+        for gname, gt, gi in zip("dq dk dv".split(), grads_t, grads_i):
+            e = max_err(gt, gi)
+            check(f"flash bwd {name} {gname}", e < 0.05, f"max_err={e:.2e}")
+
+
+def rmsnorm_check():
+    from megatron_llm_tpu.ops.pallas.rmsnorm import fused_rms_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 1024, 2048), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(4), (2048,), jnp.float32) * 0.1 + 1.0
+
+    def f(x, w, interpret):
+        y = fused_rms_norm(x, w, interpret=interpret)
+        return (y.astype(jnp.float32) * 0.01).sum(), y
+
+    (_, y_t), g_t = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(x, w, None)
+    (_, y_i), g_i = jax.value_and_grad(f, argnums=(0, 1), has_aux=True)(x, w, True)
+    check("rmsnorm fwd", max_err(y_t, y_i) < 0.05, f"max_err={max_err(y_t, y_i):.2e}")
+    check("rmsnorm bwd dx", max_err(g_t[0], g_i[0]) < 0.05)
+    check("rmsnorm bwd dw", max_err(g_t[1], g_i[1]) < 0.5)
+
+
+def time_fn(f, *args, reps=5):
+    out = f(*args)
+    _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # forced fetch
+    best = float("inf")
+    for _i in range(reps):
+        t0 = time.perf_counter()
+        out = f(*args)
+        _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def attention_flops(b, s, n, d, causal=True):
+    # QK^T + AV, fwd only
+    f = 2 * 2 * b * n * s * s * d
+    return f / 2 if causal else f
+
+
+def block_sweep(quick: bool):
+    """Flash fwd+bwd timing vs block sizes and vs the XLA fallback."""
+    from megatron_llm_tpu.ops.attention import make_attention_bias, xla_attention
+    from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, n, nkv, d = 4, 16, 16, 128
+    seqs = [1024, 4096] if quick else [1024, 2048, 4096, 8192]
+    blocks = [(256, 256), (512, 512), (512, 1024), (1024, 512), (1024, 1024)]
+    print("\n-- fwd+bwd step time (ms) --")
+    print(f"{'seq':>6} {'xla':>8}", *[f"bq{a}/bk{c}".rjust(12) for a, c in blocks])
+    best_cfg = {}
+    for s in seqs:
+        q, k, v = rand_qkv(jax.random.PRNGKey(5), b, s, n, nkv, d)
+        row = []
+
+        bias = make_attention_bias(s, causal=True)
+
+        def loss_xla(q, k, v):
+            o = xla_attention(q, k, v, bias=bias)
+            return (o.astype(jnp.float32) * 0.01).sum()
+
+        try:
+            g = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+            t_xla = time_fn(g, q, k, v) * 1e3
+        except Exception:
+            t_xla = float("nan")
+        for bq, bk in blocks:
+            def loss(q, k, v, bq=bq, bk=bk):
+                o = flash_attention(q, k, v, causal=True, block_q=bq, block_kv=bk)
+                return (o.astype(jnp.float32) * 0.01).sum()
+
+            try:
+                g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                t = time_fn(g, q, k, v) * 1e3
+            except Exception:
+                t = float("nan")
+            row.append(t)
+        valid = [(t, blk) for t, blk in zip(row, blocks) if t == t]
+        if valid:
+            best_cfg[s] = min(valid)
+        print(f"{s:>6} {t_xla:>8.1f}", *[f"{t:>12.1f}" for t in row])
+    for s, (t, blk) in best_cfg.items():
+        flops = 3 * attention_flops(b, s, n, d)  # fwd + ~2x bwd
+        print(f"   seq {s}: best block {blk} -> {t:.1f} ms "
+              f"({flops / (t / 1e3) / 1e12:.1f} TFLOP/s attention-only)")
+    # the headline check: flash must beat XLA attention at long seq
+    s = seqs[-1]
+    if s in best_cfg:
+        check("flash >= xla at long seq", best_cfg[s][0] <= t_xla or t_xla != t_xla,
+              f"flash {best_cfg[s][0]:.1f} ms vs xla {t_xla:.1f} ms @ seq {s}")
+
+    # sliding-window: auto blocks must not lose to the old fixed 512
+    # (measured: grid overhead dominates; large blocks win even at w=256)
+    s, w = 8192, 256
+    q, k, v = rand_qkv(jax.random.PRNGKey(9), b, s, n, nkv, d)
+
+    def loss_win(q, k, v, bq=None, bk=None):
+        o = flash_attention(q, k, v, causal=True, sliding_window=w,
+                            block_q=bq, block_kv=bk)
+        return (o.astype(jnp.float32) * 0.01).sum()
+
+    t_auto = time_fn(jax.jit(jax.grad(loss_win, argnums=(0, 1, 2))), q, k, v) * 1e3
+    t_512 = time_fn(jax.jit(jax.grad(
+        lambda q, k, v: loss_win(q, k, v, 512, 512), argnums=(0, 1, 2))),
+        q, k, v) * 1e3
+    check("sliding-window auto block", t_auto <= t_512 * 1.15,
+          f"auto {t_auto:.1f} ms vs fixed-512 {t_512:.1f} ms @ seq {s} w {w}")
+
+
+def long_context_fit():
+    """32K-sequence forward+backward memory fit (VERDICT weak #5)."""
+    from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, s, n, nkv, d = 1, 32768, 8, 2, 128
+    q, k, v = rand_qkv(jax.random.PRNGKey(7), b, s, n, nkv, d)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return (o.astype(jnp.float32) * 1e-3).sum()
+
+    try:
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        t = time_fn(g, q, k, v, reps=2) * 1e3
+        flops = 3 * attention_flops(b, s, n, d)
+        check("32K-seq fwd+bwd fits", True,
+              f"{t:.0f} ms, {flops / (t / 1e3) / 1e12:.1f} TFLOP/s")
+    except Exception as e:
+        check("32K-seq fwd+bwd fits", False, f"{type(e).__name__}: {e}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    print(f"backend: {backend} ({jax.devices()[0].device_kind})")
+    if backend == "cpu":
+        print("not on TPU — numerics-only (interpret==compiled trivially); "
+              "run on a TPU host for the real check")
+    numerics_checks()
+    rmsnorm_check()
+    if backend != "cpu":
+        block_sweep(args.quick)
+        long_context_fit()
+    print(f"\n{len(FAILURES)} failures" + (f": {FAILURES}" if FAILURES else ""))
+    sys.exit(1 if FAILURES else 0)
+
+
+if __name__ == "__main__":
+    main()
