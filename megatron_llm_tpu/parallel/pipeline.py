@@ -45,17 +45,23 @@ from megatron_llm_tpu.ops.norms import norm
 
 
 def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
-                deterministic, rope):
-    """Run this stage's local layers on one microbatch of hidden states."""
-    pp = jax.lax.axis_size(PP_AXIS)
+                deterministic, rope, layer_offset=None):
+    """Run this stage's local layers on one microbatch of hidden states.
+
+    ``dropout_key`` is the per-microbatch key (the same one the pp=1 path
+    hands to transformer_forward, which folds it per *global* layer index) —
+    so with cp=1, pipelined dropout is bit-identical to the pp=1 run.
+    """
     stage = jax.lax.axis_index(PP_AXIS)
-    if dropout_key is not None:
+    if dropout_key is not None and cfg.parallel.context_parallel_size > 1:
         # distinct dropout streams per cp seq-chunk (analog of the reference's
         # per-TP-rank RNG fork inside parallel regions, random.py:144-172)
         dropout_key = jax.random.fold_in(
             dropout_key, jax.lax.axis_index(CP_AXIS)
         )
     layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
+    if layer_offset is None:
+        layer_offset = stage * layers_per_stage
     hidden, _ = transformer_forward(
         cfg, layers_local, x,
         rope=rope,
@@ -64,59 +70,144 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
         token_idx=token_idx,
         dropout_key=dropout_key,
         deterministic=deterministic,
-        layer_offset=stage * layers_per_stage,
+        layer_offset=layer_offset,
     )
     return hidden
 
 
+def microbatch_keys(base_key, M: int):
+    """Per-microbatch (embed_key, layers_key) pairs, matching the pp=1
+    grad-accumulation path exactly: fold_in(base, mb) then split for the
+    embedding dropout (model_forward:150-152)."""
+    if base_key is None:
+        return None, None
+    keys = jax.vmap(
+        lambda i: jax.random.split(jax.random.fold_in(base_key, i))
+    )(jnp.arange(M))
+    return keys[:, 0], keys[:, 1]  # [M, keydata] each
+
+
+def num_pipeline_ticks(M: int, pp: int, v: int) -> int:
+    """Tick count of the (interleaved) schedule; v=1 is plain GPipe order.
+
+    Virtual pipelining runs microbatches in groups of pp; a group occupies a
+    stage for v*pp consecutive ticks (chunk-major: chunk c of all pp members
+    before chunk c+1, ref schedules.py:253-344 model-chunk ordering), and
+    each tick does 1/v of a stage's layers — so the pipeline-fill bubble
+    shrinks from (pp-1) full-stage ticks to (pp-1) chunk ticks.
+    """
+    if v == 1:
+        return M + pp - 1
+    m_pad = -(-M // pp) * pp  # groups are pp-strided; pad the last group
+    return m_pad * v + pp - 1
+
+
+def pipeline_bubble_fraction(M: int, pp: int, v: int = 1) -> float:
+    """Idle fraction of the tick schedule: (T - M*v) / T.
+
+    Reference accounting (Megatron SC21 paper; schedules.py warmup/cooldown
+    math): bubble = (pp-1)/(M+pp-1) non-interleaved, ~(pp-1)/(M*v+pp-1)
+    interleaved."""
+    t = num_pipeline_ticks(M, pp, v)
+    return (t - M * v) / t
+
+
 def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
                    aux_mb: Dict[str, jax.Array], dropout_key, deterministic,
-                   rope, token_idx: Optional[jax.Array] = None):
+                   rope, token_idx: Optional[jax.Array] = None,
+                   mb_keys: Optional[jax.Array] = None):
     """Run the pipelined transformer body.
 
     hidden_mb: [M, mb, s, h] embedded microbatches; aux_mb leaves [M, mb, s];
-    token_idx: optional [s] zigzag index vector (parallel/ring.py).
+    token_idx: optional [s] zigzag index vector (parallel/ring.py);
+    mb_keys: optional [M, ...] per-microbatch dropout keys (microbatch_keys).
     Returns [M, mb, s, h] final hidden states (replicated over pp).
+
+    With cfg.parallel.virtual_pipeline_model_parallel_size = v > 1, each
+    stage holds v layer chunks (virtual stage k = c*pp + s holds layers
+    [k*L/(v*pp), (k+1)*L/(v*pp))) and a microbatch traverses the stage ring
+    v times — the interleaved schedule of ref schedules.py:253-502, which
+    cuts the pipeline-fill bubble by v (see pipeline_bubble_fraction).
     """
     pp = cfg.parallel.pipeline_model_parallel_size
+    v = cfg.parallel.virtual_pipeline_model_parallel_size or 1
     M = hidden_mb.shape[0]
+    L = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
+    assert L % (pp * v) == 0, (L, pp, v)
+    chunk_layers = L // (pp * v)
+    T = num_pipeline_ticks(M, pp, v)
+    if mb_keys is None and dropout_key is not None and not deterministic:
+        # direct callers passing only dropout_key get the per-microbatch
+        # derivation (the keys pipeline_loss_fn would have passed)
+        _, mb_keys = microbatch_keys(dropout_key, M)
+    use_dropout = mb_keys is not None and not deterministic
+
     if token_idx is None:
         # constant placeholder so the shard_map signature is static; the
         # sentinel -1 row is never read (selected below)
         token_idx_arr = jnp.full((hidden_mb.shape[2],), -1, jnp.int32)
     else:
         token_idx_arr = token_idx
+    if mb_keys is None:
+        mb_keys = jnp.zeros((M, 2), jnp.uint32)  # static-signature dummy
 
-    def body(layers_local, hidden_mb, aux_mb, token_idx_local):
+    # [L, ...] -> [v, pp, Lc, ...]: axis 1 shards over pp, so stage s locally
+    # holds [v, Lc, ...] = chunks {c*pp + s}. For v=1 this is the old
+    # contiguous L/pp split.
+    def chunked(a):
+        return a.reshape(v, pp, chunk_layers, *a.shape[1:])
+
+    layers_chunked = jax.tree.map(chunked, stacked_layers)
+
+    def body(layers_local, hidden_mb, aux_mb, token_idx_local, mb_keys_local):
         stage = jax.lax.axis_index(PP_AXIS)
         perm = [(i, (i + 1) % pp) for i in range(pp)]
+        layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)  # [v, Lc, ...]
 
         def tick(carry, t):
-            recv = carry
-            mb_idx = jnp.clip(t, 0, M - 1)
+            recv, out_buf = carry
+            # schedule position: stage s at tick t serves chain position
+            # u = t - s; groups of pp microbatches, chunk-major within group
+            u = t - stage
+            w = u % (v * pp)
+            c = jnp.clip(w // pp, 0, v - 1)
+            mbi = (u // (v * pp)) * pp + w % pp
+            valid = jnp.logical_and(u >= 0, mbi < M)
+            mb_idx = jnp.clip(mbi, 0, M - 1)
+
             x_in = jax.tree.map(lambda a: a[mb_idx], hidden_mb)
             aux = jax.tree.map(lambda a: a[mb_idx], aux_mb)
-            inp = jnp.where(stage == 0, x_in, recv)
-            dk = (
-                None if dropout_key is None
-                else jax.random.fold_in(dropout_key, t)
+            first_hop = jnp.logical_and(stage == 0, c == 0)
+            inp = jnp.where(first_hop, x_in, recv)
+            dk = mb_keys_local[mb_idx] if use_dropout else None
+            chunk_params = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                layers_local,
             )
             out = _stage_body(
-                cfg, layers_local, inp, aux,
+                cfg, chunk_params, inp, aux,
                 token_idx_local if token_idx is not None else None,
                 dk, deterministic, rope,
+                layer_offset=(c * pp + stage) * chunk_layers,
+            )
+            # final output for this microbatch leaves from the last virtual
+            # stage (stage pp-1, chunk v-1)
+            emit = jnp.logical_and(
+                jnp.logical_and(stage == pp - 1, c == v - 1), valid
+            )
+            prev = jax.lax.dynamic_index_in_dim(out_buf, mb_idx, 0,
+                                                keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(emit, out, prev), mb_idx, 0
             )
             nxt = jax.lax.ppermute(out, PP_AXIS, perm)
-            # last stage's output for microbatch t-(pp-1), zero elsewhere
-            y = jnp.where(stage == pp - 1, out, jnp.zeros_like(out))
-            return nxt, y
+            return (nxt, out_buf), None
 
-        init = jnp.zeros_like(hidden_mb[0])
-        _, ys = jax.lax.scan(tick, init, jnp.arange(M + pp - 1))
-        outs = ys[pp - 1:]  # [M, mb, s, h], valid only on the last stage
+        init = (jnp.zeros_like(hidden_mb[0]), jnp.zeros_like(hidden_mb))
+        (_, out_buf), _ = jax.lax.scan(tick, init, jnp.arange(T))
         # broadcast last-stage results to every stage (psum of one-hot data);
         # transpose of this psum routes dLoss back to the last stage only.
-        return jax.lax.psum(outs, PP_AXIS)
+        return jax.lax.psum(out_buf, PP_AXIS)
 
     # cp joins pp as a manual axis: hidden/aux seq dims are cp-local inside
     # the body, and the attention dispatch takes the ring_attention_manual
@@ -128,16 +219,17 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
         body,
         mesh=mesh,
         in_specs=(
-            jax.tree.map(lambda _: P(PP_AXIS), stacked_layers),
+            jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             hidden_spec,
             jax.tree.map(lambda _: aux_spec, aux_mb),
             P(CP_AXIS),
+            P(),
         ),
         out_specs=hidden_spec,
         axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
-    return fn(stacked_layers, hidden_mb, aux_mb, token_idx_arr)
+    return fn(layers_chunked, hidden_mb, aux_mb, token_idx_arr, mb_keys)
 
 
 # ---------------------------------------------------------------------------
@@ -147,7 +239,8 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
 
 def pipeline_1f1b_loss_and_grads(
     cfg, mesh, params, batch: Dict[str, jax.Array], *,
-    rope=None, loss_scale=None, num_micro=None,
+    rope=None, loss_scale=None, num_micro=None, dropout_key=None,
+    embed_fn=None, head_loss_fn=None,
 ):
     """One-forward-one-backward pipeline schedule (schedules.py:606-722).
 
@@ -166,12 +259,20 @@ def pipeline_1f1b_loss_and_grads(
     unused results are masked — the head matmul on non-final stages is the
     price of lockstep SPMD (~h*v/(12*h^2*L/pp) of a tick, a few percent).
 
-    Deterministic path only (dropout=0 — the Llama/Falcon/Mistral finetune
-    default). Returns (loss, grads) with grads matching the params tree.
+    Dropout: per-microbatch keys (``microbatch_keys``) make the vjp-recompute
+    reproduce the forward's dropout exactly — the jax analog of the
+    reference's RNG-state snapshot around activation recompute
+    (random.py:175-245). Pass ``dropout_key`` to enable.
+
+    Custom model families can override ``embed_fn(outer_params, tokens, aux,
+    key)`` and ``head_loss_fn(outer_params, hidden, labels, mask) -> scaled
+    loss`` (defaults implement the GPT/Llama family).
+
+    Returns (loss, grads) with grads matching the params tree.
     """
-    assert cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0, (
-        "1f1b schedule currently supports deterministic training only; "
-        "use pipeline_schedule='gpipe' with dropout"
+    assert (cfg.parallel.virtual_pipeline_model_parallel_size or 1) == 1, (
+        "interleaved virtual pipelining is supported on the gpipe schedule; "
+        "1f1b runs non-interleaved"
     )
     pp = cfg.parallel.pipeline_model_parallel_size
     M = num_micro or cfg.parallel.num_micro_batches or 1
@@ -200,18 +301,34 @@ def pipeline_1f1b_loss_and_grads(
     layers = params["layers"]
     outer = {k: v for k, v in params.items() if k != "layers"}
 
-    def embed_fn(outer_p, tok, aux):
-        return lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
+    use_dropout = (
+        dropout_key is not None
+        and (cfg.model.hidden_dropout > 0.0 or cfg.model.attention_dropout > 0.0)
+    )
+    embed_keys, layer_keys = microbatch_keys(
+        dropout_key if use_dropout else None, M
+    )
+    if embed_keys is None:  # static shard_map signature
+        embed_keys = jnp.zeros((M, 2), jnp.uint32)
+        layer_keys = jnp.zeros((M, 2), jnp.uint32)
 
-    def head_loss_fn(outer_p, hidden, lbl, msk):
-        h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
-                 cfg.model.use_rms_norm)
-        logits = lm.compute_logits(cfg, outer_p, h)
-        per_token = softmax_cross_entropy(logits, lbl)
-        return (per_token * msk).sum() / denom * scale
+    if embed_fn is None:
+        def embed_fn(outer_p, tok, aux, ke):
+            h = lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
+            if use_dropout:
+                h = rng_mod.dropout(ke, cfg.model.hidden_dropout, h)
+            return h
+
+    if head_loss_fn is None:
+        def head_loss_fn(outer_p, hidden, lbl, msk):
+            h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
+                     cfg.model.use_rms_norm)
+            logits = lm.compute_logits(cfg, outer_p, h)
+            per_token = softmax_cross_entropy(logits, lbl)
+            return (per_token * msk).sum() / denom * scale
 
     def body(layers_local, outer_p, tokens, labels, loss_mask, aux_mb,
-             token_idx_local):
+             token_idx_local, embed_keys, layer_keys):
         stage = jax.lax.axis_index(PP_AXIS)
         last = pp - 1
         perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
@@ -225,11 +342,11 @@ def pipeline_1f1b_loss_and_grads(
             else jnp.float32
         )
 
-        def stage_fwd(L, x, aux):
+        def stage_fwd(L, x, aux, dk):
             return _stage_body(
                 cfg, L, x, aux,
                 token_idx_local if token_idx is not None else None,
-                None, True, rope,
+                dk if use_dropout else None, not use_dropout, rope,
             )
 
         def aux_at(i):
@@ -245,7 +362,8 @@ def pipeline_1f1b_loss_and_grads(
             b_idx = jnp.clip(b_mb, 0, M - 1)
 
             # ---- forward: embed on stage 0, else the ppermuted stream ----
-            x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx))
+            x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx),
+                             embed_keys[f_idx])
             x_in = jnp.where(stage == 0, x_emb, x_recv).astype(dtype)
             # guard the save: during cooldown f_idx clips to M-1, whose slot
             # may still be awaiting its backward
@@ -253,7 +371,7 @@ def pipeline_1f1b_loss_and_grads(
                 saved, x_in, f_idx % depth, 0
             )
             saved = jnp.where(do_f, saved_upd, saved)
-            y = stage_fwd(layers_local, x_in, aux_at(f_idx))
+            y = stage_fwd(layers_local, x_in, aux_at(f_idx), layer_keys[f_idx])
 
             # ---- head + loss on the last stage's fresh output ----
             loss_f, head_vjp = jax.vjp(
@@ -275,7 +393,8 @@ def pipeline_1f1b_loss_and_grads(
                 saved, b_idx % depth, 0, keepdims=False
             )
             _, stage_vjp = jax.vjp(
-                lambda L, xx: stage_fwd(L, xx, aux_at(b_idx)),
+                lambda L, xx: stage_fwd(L, xx, aux_at(b_idx),
+                                        layer_keys[b_idx]),
                 layers_local, x_saved,
             )
             dlayers, dx = stage_vjp(g_in)
@@ -286,7 +405,9 @@ def pipeline_1f1b_loss_and_grads(
 
             # ---- embedding backward on stage 0 ----
             _, emb_vjp = jax.vjp(
-                lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx)), outer_p
+                lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx),
+                                    embed_keys[b_idx]),
+                outer_p,
             )
             (d_outer_emb,) = emb_vjp(dx)
             use_emb = jnp.logical_and(stage == 0, do_b)
@@ -332,6 +453,7 @@ def pipeline_1f1b_loss_and_grads(
             data_spec, data_spec, data_spec,
             jax.tree.map(lambda _: data_spec, aux_mb),
             P(CP_AXIS),
+            P(), P(),
         ),
         out_specs=(
             jax.tree.map(lambda _: P(PP_AXIS), layers),
@@ -346,7 +468,8 @@ def pipeline_1f1b_loss_and_grads(
     else:
         token_idx_arr = token_idx
     grads_L, grads_outer, loss = fn(
-        layers, outer, tokens, labels, loss_mask, aux_mb, token_idx_arr
+        layers, outer, tokens, labels, loss_mask, aux_mb, token_idx_arr,
+        embed_keys, layer_keys,
     )
     grads = dict(grads_outer)
     grads["layers"] = grads_L
@@ -381,15 +504,25 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     if rope is None:
         rope = lm.make_rope_cache(cfg)
 
-    # [M, mb, s, h] embeddings (vocab-parallel over tp under pjit)
-    hidden = jax.vmap(lambda t: lm.embed_tokens(cfg, params, t, None))(tokens)
-    if dropout_key is not None and not deterministic:
-        k_embed, dropout_key = jax.random.split(dropout_key)
-        hidden = rng_mod.dropout(k_embed, cfg.model.hidden_dropout, hidden)
+    use_dropout = dropout_key is not None and not deterministic
+    embed_keys, layer_keys = microbatch_keys(
+        dropout_key if use_dropout else None, M
+    )
+
+    # [M, mb, s, h] embeddings (vocab-parallel over tp under pjit); dropout
+    # keys per microbatch, matching the pp=1 path (model_forward:149-152)
+    if use_dropout:
+        hidden = jax.vmap(
+            lambda t, ke: rng_mod.dropout(
+                ke, cfg.model.hidden_dropout,
+                lm.embed_tokens(cfg, params, t, None))
+        )(tokens, embed_keys)
+    else:
+        hidden = jax.vmap(lambda t: lm.embed_tokens(cfg, params, t, None))(tokens)
 
     hidden = pipeline_apply(
         cfg, mesh, params["layers"], hidden, aux_mb, dropout_key,
-        deterministic, rope, token_idx=token_idx,
+        deterministic, rope, token_idx=token_idx, mb_keys=layer_keys,
     )
 
     # Head + CE one microbatch at a time: materializing [M, mb, s, v] logits

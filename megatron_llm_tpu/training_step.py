@@ -132,7 +132,17 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                 cfg.model.hidden_dropout == 0.0
                 and cfg.model.attention_dropout == 0.0
             )
-            if cfg.parallel.pipeline_schedule == "1f1b" and deterministic:
+            vpp = cfg.parallel.virtual_pipeline_model_parallel_size or 1
+            if cfg.parallel.pipeline_schedule == "1f1b" and vpp > 1:
+                # don't silently fall back: gpipe autodiff holds O(M·v) tick
+                # residuals where 1f1b holds O(pp) — a schedule swap behind
+                # the user's back can OOM a previously-fitting model
+                raise ValueError(
+                    "pipeline_schedule='1f1b' does not support virtual "
+                    "pipelining yet; set pipeline_schedule='gpipe' to use "
+                    "virtual_pipeline_model_parallel_size > 1"
+                )
+            if cfg.parallel.pipeline_schedule == "1f1b":
                 # true 1F1B: grads computed inside the tick loop, O(pp)
                 # activation memory (parallel/pipeline.py)
                 from megatron_llm_tpu.parallel.pipeline import (
@@ -143,6 +153,7 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     cfg, mesh, params, batch, rope=rope,
                     loss_scale=jax.lax.stop_gradient(scale),
                     num_micro=num_micro,
+                    dropout_key=None if deterministic else base_key,
                 )
             else:
                 # GPipe-style: autodiff through the tick scan

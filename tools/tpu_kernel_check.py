@@ -183,12 +183,16 @@ def block_sweep(quick: bool):
                             block_q=bq, block_kv=bk)
         return (o.astype(jnp.float32) * 0.01).sum()
 
-    t_auto = time_fn(jax.jit(jax.grad(loss_win, argnums=(0, 1, 2))), q, k, v) * 1e3
-    t_512 = time_fn(jax.jit(jax.grad(
-        lambda q, k, v: loss_win(q, k, v, 512, 512), argnums=(0, 1, 2))),
-        q, k, v) * 1e3
-    check("sliding-window auto block", t_auto <= t_512 * 1.15,
-          f"auto {t_auto:.1f} ms vs fixed-512 {t_512:.1f} ms @ seq {s} w {w}")
+    try:
+        t_auto = time_fn(jax.jit(jax.grad(loss_win, argnums=(0, 1, 2))),
+                         q, k, v) * 1e3
+        t_512 = time_fn(jax.jit(jax.grad(
+            lambda q, k, v: loss_win(q, k, v, 512, 512), argnums=(0, 1, 2))),
+            q, k, v) * 1e3
+        check("sliding-window auto block", t_auto <= t_512 * 1.15,
+              f"auto {t_auto:.1f} ms vs fixed-512 {t_512:.1f} ms @ seq {s} w {w}")
+    except Exception as e:
+        check("sliding-window auto block", False, f"{type(e).__name__}: {e}")
 
 
 def long_context_fit():
