@@ -68,30 +68,10 @@ def test_mistral_logit_parity_sliding_window():
 
 def test_hf_round_trip():
     """native -> HF -> logits identical to the original HF model."""
-    from weights_conversion.native_to_hf import (
-        hf_config_from_native,
-        to_hf_llama_state,
-    )
-
-    hf = tiny_hf_llama(nkv=2)
-    cfg = config_from_hf(hf.config, "llama2")
-    params = convert_hf_model(hf, cfg)
-    state = to_hf_llama_state(params, cfg, vocab_size=128)
-
-    from transformers import LlamaForCausalLM
-
-    hf2 = LlamaForCausalLM(hf_config_from_native(cfg, 128))
-    hf2.load_state_dict(
-        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()}
-    )
-    tokens = torch.randint(0, 128, (1, 32))
-    with torch.no_grad():
-        l1 = hf(tokens).logits.numpy()
-        l2 = hf2(tokens).logits.numpy()
-    np.testing.assert_allclose(l1, l2, atol=1e-5)
+    _round_trip(tiny_hf_llama(nkv=2), "llama2", "to_hf_llama_state")
 
 
-def test_falcon_logit_parity():
+def tiny_hf_falcon():
     from transformers import FalconConfig, FalconForCausalLM
 
     fc = FalconConfig(
@@ -101,10 +81,91 @@ def test_falcon_logit_parity():
         max_position_embeddings=128, attn_implementation="eager",
     )
     torch.manual_seed(2)
-    hf = FalconForCausalLM(fc)
-    cfg = config_from_hf(fc, "falcon")
+    return FalconForCausalLM(fc)
+
+
+def test_falcon_logit_parity():
+    hf = tiny_hf_falcon()
+    cfg = config_from_hf(hf.config, "falcon")
     cfg.training.params_dtype = "float32"
     cfg.training.use_flash_attn = False
     stats = verify(hf, cfg, batch_size=1, seq=48, iters=2)
     avg_max = np.mean([s[2] for s in stats])
     assert avg_max <= 1e-3, f"avg max logit err {avg_max}"
+
+
+# ---------------------------------------------------------------------------
+# bf16 parity at the reference's mixed-precision tolerance
+# (getting_started.md:152-155: fp32 <=0.01, bf16/fp16 <=0.1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family,builder", [
+    ("llama2", tiny_hf_llama),
+    ("mistral", tiny_hf_mistral),
+    ("falcon", tiny_hf_falcon),
+])
+def test_bf16_logit_parity(family, builder):
+    hf = builder()
+    cfg = config_from_hf(hf.config, family)
+    cfg.training.params_dtype = "bfloat16"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=1, seq=48, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 0.1, f"bf16 avg max logit err {avg_max}"
+
+
+def test_codellama_realistic_shape_parity():
+    """CodeLlama-flavored config at realistic proportions: GQA 8:1,
+    rope_theta=1e6, linear rope scaling x2 (the 32K position-interpolation
+    path, ref positional_embeddings.py:11, arguments.py:465-468)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hc = LlamaConfig(
+        vocab_size=256, hidden_size=256, intermediate_size=688,
+        num_hidden_layers=2, num_attention_heads=32, num_key_value_heads=4,
+        max_position_embeddings=512, rms_norm_eps=1e-5, rope_theta=1e6,
+        rope_scaling={"type": "linear", "factor": 2.0},
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf = LlamaForCausalLM(hc)
+    cfg = config_from_hf(hc, "codellama")
+    assert cfg.model.rope_theta == 1e6
+    assert cfg.model.rope_scaling_factor == 2.0
+    assert cfg.model.num_attention_heads // cfg.model.num_attention_heads_kv == 8
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=1, seq=128, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 1e-3, f"avg max logit err {avg_max}"
+
+
+# ---------------------------------------------------------------------------
+# round trips: native -> HF == original, per family
+# ---------------------------------------------------------------------------
+
+
+def _round_trip(hf, family, state_fn_name, vocab=128):
+    import weights_conversion.native_to_hf as n2h
+
+    cfg = config_from_hf(hf.config, family)
+    params = convert_hf_model(hf, cfg)
+    state = getattr(n2h, state_fn_name)(params, cfg, vocab)
+    hf2 = hf.__class__(n2h.hf_config_from_native(cfg, vocab))
+    hf2.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()}
+    )
+    tokens = torch.randint(0, vocab, (1, 32))
+    with torch.no_grad():
+        l1 = hf(tokens).logits.numpy()
+        l2 = hf2(tokens).logits.numpy()
+    np.testing.assert_allclose(l1, l2, atol=1e-5)
+
+
+def test_mistral_round_trip():
+    _round_trip(tiny_hf_mistral(), "mistral", "to_hf_llama_state")
+
+
+def test_falcon_round_trip():
+    _round_trip(tiny_hf_falcon(), "falcon", "to_hf_falcon_state")

@@ -204,6 +204,20 @@ def config_from_hf(hf_config, model_name: str):
         vocab_size=hf_config.vocab_size,
         max_position_embeddings=getattr(hf_config, "max_position_embeddings", 2048),
     )
+    # HF linear rope scaling -> --rope_scaling_factor (the reference's
+    # position-interpolation path, positional_embeddings.py:11). Anything we
+    # cannot represent (llama3 / yarn / dynamic) must fail loudly: silently
+    # dropping it would convert to a model with wrong RoPE frequencies.
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        stype = scaling.get("type") or scaling.get("rope_type")
+        if stype != "linear":
+            raise ValueError(
+                f"unsupported rope_scaling type {stype!r}; only linear "
+                "position interpolation has a native equivalent"
+            )
+        kw["rope_scaling_factor"] = float(scaling["factor"])
+
     if model_name == "falcon":
         kw["num_attention_heads_kv"] = getattr(hf_config, "num_kv_heads", None) or (
             1 if getattr(hf_config, "multi_query", False)
@@ -211,6 +225,7 @@ def config_from_hf(hf_config, model_name: str):
         )
         kw["parallel_layernorm"] = getattr(hf_config, "new_decoder_architecture", False)
         kw["tie_embed_logits"] = True
+        kw["rope_theta"] = getattr(hf_config, "rope_theta", 10000.0)
     else:
         kw["num_attention_heads_kv"] = getattr(
             hf_config, "num_key_value_heads", hf_config.num_attention_heads

@@ -57,6 +57,54 @@ def to_hf_llama_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str,
     return state
 
 
+def to_hf_falcon_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str, Any]:
+    """Native params pytree -> HF Falcon state dict (inverse of
+    convert_falcon_state; reference megatron_to_hf.py falcon branch)."""
+    m = cfg.model
+    n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
+    g = n // nkv
+    layers = params["layers"]
+    state: Dict[str, np.ndarray] = {
+        "transformer.word_embeddings.weight":
+            np.asarray(params["embedding"]["word_embeddings"])[:vocab_size],
+        "transformer.ln_f.weight": np.asarray(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+        # falcon ties lm_head to the embedding
+        "lm_head.weight":
+            np.asarray(params["embedding"]["word_embeddings"])[:vocab_size],
+    }
+    ln_name = "ln_attn" if m.parallel_layernorm else "input_layernorm"
+    for i in range(m.num_layers):
+        pre = f"transformer.h.{i}"
+        get = lambda *ks: np.asarray(_walk(layers, ks)[i])
+        q, k, v = unpack_qkv(get("attention", "qkv", "kernel"), n, nkv, d)
+        q = interleaved_rows_to_hf(q, d)
+        k = interleaved_rows_to_hf(k, d)
+        h = q.shape[1]
+        fused = np.concatenate(
+            [q.reshape(nkv, g, d, h), k.reshape(nkv, 1, d, h),
+             v.reshape(nkv, 1, d, h)], axis=1,
+        ).reshape(nkv * (g + 2) * d, h)
+        state[f"{pre}.self_attention.query_key_value.weight"] = (
+            np.ascontiguousarray(fused)
+        )
+        state[f"{pre}.self_attention.dense.weight"] = np.ascontiguousarray(
+            get("attention", "dense", "kernel").T
+        )
+        state[f"{pre}.mlp.dense_h_to_4h.weight"] = np.ascontiguousarray(
+            get("mlp", "fc1", "kernel").T
+        )
+        state[f"{pre}.mlp.dense_4h_to_h.weight"] = np.ascontiguousarray(
+            get("mlp", "fc2", "kernel").T
+        )
+        state[f"{pre}.{ln_name}.weight"] = get("input_norm", "scale")
+        state[f"{pre}.{ln_name}.bias"] = get("input_norm", "bias")
+        if m.parallel_layernorm:
+            state[f"{pre}.ln_mlp.weight"] = get("mlp_norm", "scale")
+            state[f"{pre}.ln_mlp.bias"] = get("mlp_norm", "bias")
+    return state
+
+
 def _walk(tree, keys):
     for k in keys:
         tree = tree[k]
@@ -64,9 +112,29 @@ def _walk(tree, keys):
 
 
 def hf_config_from_native(cfg, vocab_size: int):
-    from transformers import LlamaConfig, MistralConfig
+    from transformers import FalconConfig, LlamaConfig, MistralConfig
 
     m = cfg.model
+    rope_scaling = (
+        {"type": "linear", "factor": float(m.rope_scaling_factor)}
+        if m.rope_scaling_factor and m.rope_scaling_factor != 1.0 else None
+    )
+    if cfg.model_name == "falcon":
+        return FalconConfig(
+            vocab_size=vocab_size,
+            hidden_size=m.hidden_size,
+            num_hidden_layers=m.num_layers,
+            num_attention_heads=m.num_attention_heads,
+            num_kv_heads=m.num_attention_heads_kv,
+            new_decoder_architecture=m.parallel_layernorm,
+            parallel_attn=m.parallel_attn,
+            bias=False,
+            alibi=False,
+            max_position_embeddings=m.max_position_embeddings,
+            layer_norm_epsilon=m.layernorm_epsilon,
+            rope_theta=m.rope_theta,
+            rope_scaling=rope_scaling,
+        )
     common = dict(
         vocab_size=vocab_size,
         hidden_size=m.hidden_size,
@@ -79,6 +147,8 @@ def hf_config_from_native(cfg, vocab_size: int):
         rope_theta=m.rope_theta,
         tie_word_embeddings=m.tie_embed_logits,
     )
+    if rope_scaling:
+        common["rope_scaling"] = rope_scaling
     if cfg.model_name == "mistral":
         return MistralConfig(sliding_window=m.sliding_window_size, **common)
     return LlamaConfig(**common)
@@ -121,7 +191,10 @@ def main():
     params = ocp.StandardCheckpointer().restore(os.path.join(path, "params"))
 
     vocab = args.vocab_size or saved["model"].get("vocab_size")
-    state = to_hf_llama_state(params, cfg, vocab)
+    if cfg.model_name == "falcon":
+        state = to_hf_falcon_state(params, cfg, vocab)
+    else:
+        state = to_hf_llama_state(params, cfg, vocab)
     hf_cfg = hf_config_from_native(cfg, vocab)
     model = AutoModelForCausalLM.from_config(hf_cfg)
     model.load_state_dict(
