@@ -1,0 +1,183 @@
+"""Reference-checkpoint interop: torch-free .pt reader + TP/PP shard merge
+(reference checkpointing.py:77-104 layout; VERDICT missing #4).
+
+The synthetic checkpoint is WRITTEN with torch.save (the real serializer the
+reference uses) and READ with our zipfile+pickle reader — a true round trip
+over the wire format."""
+
+import argparse
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from megatron_llm_tpu.models import model_forward
+from weights_conversion.hf_to_native import (
+    config_from_hf,
+    convert_hf_model,
+    pack_qkv,
+)
+from weights_conversion.megatron_to_native import (
+    convert_megatron_state,
+    load_reference_state,
+)
+from weights_conversion.permute_qkv import hf_rows_to_interleaved
+from weights_conversion.pt_reader import load_pt
+
+
+def tiny_hf_llama(vocab=128):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    return LlamaForCausalLM(cfg)
+
+
+def build_reference_checkpoint(hf, cfg, out_dir, tp=2, pp=2, iteration=100):
+    """Write the HF weights in the reference's sharded on-disk layout."""
+    m = cfg.model
+    n, nkv, d, h = (m.num_attention_heads, m.num_attention_heads_kv,
+                    m.kv_channels, m.hidden_size)
+    L, lpr = m.num_layers, m.num_layers // pp
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    def W(i, name):
+        return sd[f"model.layers.{i}.{name}.weight"]
+
+    iter_dir = os.path.join(out_dir, f"iter_{iteration:07d}")
+    for t in range(tp):
+        for p in range(pp):
+            enc = {}
+            for local in range(lpr):
+                gi = p * lpr + local
+                # megatron fused qkv = native kernel transposed; column-split
+                # over tp keeps whole kv groups per rank
+                qkv = pack_qkv(
+                    hf_rows_to_interleaved(W(gi, "self_attn.q_proj"), d),
+                    hf_rows_to_interleaved(W(gi, "self_attn.k_proj"), d),
+                    W(gi, "self_attn.v_proj"), n, nkv, d,
+                ).T
+                rows = qkv.shape[0] // tp
+                enc[f"layers.{local}.attention.query_key_value.weight"] = (
+                    torch.from_numpy(qkv[t * rows:(t + 1) * rows].copy())
+                )
+                dense = W(gi, "self_attn.o_proj")
+                cols = dense.shape[1] // tp
+                enc[f"layers.{local}.attention.dense.weight"] = (
+                    torch.from_numpy(dense[:, t * cols:(t + 1) * cols].copy())
+                )
+                up, gate = W(gi, "mlp.up_proj"), W(gi, "mlp.gate_proj")
+                ffn_loc = up.shape[0] // tp
+                enc[f"layers.{local}.mlp.dense_h_to_4h.weight"] = (
+                    torch.from_numpy(np.concatenate([
+                        up[t * ffn_loc:(t + 1) * ffn_loc],
+                        gate[t * ffn_loc:(t + 1) * ffn_loc],
+                    ], axis=0))
+                )
+                down = sd[f"model.layers.{gi}.mlp.down_proj.weight"]
+                cols = down.shape[1] // tp
+                enc[f"layers.{local}.mlp.dense_4h_to_h.weight"] = (
+                    torch.from_numpy(down[:, t * cols:(t + 1) * cols].copy())
+                )
+                enc[f"layers.{local}.input_layernorm.weight"] = (
+                    torch.from_numpy(W(gi, "input_layernorm").copy())
+                )
+                enc[f"layers.{local}.post_attention_layernorm.weight"] = (
+                    torch.from_numpy(W(gi, "post_attention_layernorm").copy())
+                )
+            lm = {"encoder": enc}
+            if p == 0:
+                emb = sd["model.embed_tokens.weight"]
+                rows = emb.shape[0] // tp
+                lm["embedding"] = {"word_embeddings": {
+                    "weight": torch.from_numpy(
+                        emb[t * rows:(t + 1) * rows].copy())
+                }}
+            if p == pp - 1:
+                enc["final_layernorm.weight"] = torch.from_numpy(
+                    sd["model.norm.weight"].copy())
+                head = sd["lm_head.weight"]
+                rows = head.shape[0] // tp
+                lm["lm_head"] = torch.from_numpy(
+                    head[t * rows:(t + 1) * rows].copy())
+            name = f"mp_rank_{t:02d}" + (f"_{p:03d}" if pp > 1 else "")
+            rank_dir = os.path.join(iter_dir, name)
+            os.makedirs(rank_dir, exist_ok=True)
+            torch.save(
+                {"model": {"language_model": lm}, "iteration": iteration,
+                 "args": argparse.Namespace(tensor_model_parallel_size=tp),
+                 "rng_state": [{"random_rng_state": ("MT19937", 0)}]},
+                os.path.join(rank_dir, "model_optim_rng.pt"),
+            )
+    with open(os.path.join(out_dir, "latest_checkpointed_iteration.txt"),
+              "w") as f:
+        f.write(str(iteration))
+
+
+def test_pt_reader_basic(tmp_path):
+    """Torch-free reader returns numpy arrays matching what torch saved."""
+    obj = {
+        "a": torch.arange(12, dtype=torch.float32).reshape(3, 4),
+        "nested": {"b": torch.ones(5, dtype=torch.int64) * 7},
+        "half": torch.full((2, 2), 1.5, dtype=torch.bfloat16),
+        "scalar": torch.tensor(3.0),
+        "args": argparse.Namespace(lr=0.1),
+    }
+    p = tmp_path / "x.pt"
+    torch.save(obj, p)
+    loaded = load_pt(str(p))
+    np.testing.assert_array_equal(loaded["a"], obj["a"].numpy())
+    np.testing.assert_array_equal(loaded["nested"]["b"], obj["nested"]["b"].numpy())
+    assert float(loaded["scalar"]) == 3.0
+    assert loaded["half"].astype(np.float32).max() == 1.5
+    assert loaded["args"].lr == 0.1
+
+
+def test_pt_reader_noncontiguous(tmp_path):
+    """Stride/offset handling: tensors saved as views."""
+    base = torch.arange(24, dtype=torch.float32).reshape(4, 6)
+    obj = {"t": base.t()}  # transposed view: non-trivial strides
+    p = tmp_path / "v.pt"
+    torch.save(obj, p)
+    loaded = load_pt(str(p))
+    np.testing.assert_array_equal(loaded["t"], base.t().numpy())
+
+
+@pytest.mark.parametrize("tp,pp", [(1, 1), (2, 2)])
+def test_reference_checkpoint_round_trip(tmp_path, tp, pp):
+    hf = tiny_hf_llama()
+    cfg = config_from_hf(hf.config, "llama2")
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    expected = convert_hf_model(hf, cfg)
+
+    build_reference_checkpoint(hf, cfg, str(tmp_path), tp=tp, pp=pp)
+    states, tp_found, pp_found = load_reference_state(str(tmp_path))
+    assert (tp_found, pp_found) == (tp, pp)
+    params = convert_megatron_state(states, cfg)
+
+    import jax.tree_util as jtu
+
+    got = {jtu.keystr(k): v for k, v in
+           jtu.tree_flatten_with_path(params)[0]}
+    for path, val in jtu.tree_flatten_with_path(expected)[0]:
+        key = jtu.keystr(path)
+        np.testing.assert_allclose(
+            got[key], val, atol=1e-6, err_msg=key)
+
+    # end to end: merged params produce HF-parity logits
+    tokens = np.random.RandomState(0).randint(0, 128, (1, 32)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    ours, _ = model_forward(cfg, params, tokens)
+    ours = np.asarray(ours, np.float32)[..., :128]
+    err = np.abs(ours - hf_logits).max(axis=-1).mean()
+    assert err <= 1e-3, f"avg max logit err {err}"

@@ -219,6 +219,14 @@ def config_from_hf(hf_config, model_name: str):
         kw["rope_scaling_factor"] = float(scaling["factor"])
 
     if model_name == "falcon":
+        # same fail-loudly posture as rope_scaling above: a config feature we
+        # cannot represent must not silently convert to garbage logits
+        if getattr(hf_config, "alibi", False):
+            raise ValueError("alibi falcon models are not supported "
+                             "(native falcon uses RoPE)")
+        if not getattr(hf_config, "parallel_attn", True):
+            raise ValueError("sequential-attention falcon (parallel_attn="
+                             "False) is not supported")
         kw["num_attention_heads_kv"] = getattr(hf_config, "num_kv_heads", None) or (
             1 if getattr(hf_config, "multi_query", False)
             else hf_config.num_attention_heads

@@ -1,0 +1,205 @@
+"""Reference (Megatron) checkpoint -> native params, torch-free.
+
+Reads the reference's on-disk layout directly (checkpointing.py:77-104:
+``<load>/latest_checkpointed_iteration.txt`` then ``iter_{it:07d}/
+mp_rank_{tp:02d}[_{pp:03d}]/model_optim_rng.pt``), merges tensor- and
+pipeline-parallel shards, and emits the native params pytree — the direct
+migration path that previously required exporting through HF first.
+
+Merge rules (reference core/tensor_parallel/layers.py):
+  column-parallel (fused qkv, fc1, vocab embedding, lm head) -> concat dim 0
+  row-parallel (attention dense, fc2)                        -> concat dim 1
+  norms and biases                                           -> replicated
+Gated-MLP fc1 shards are [ffn_local(up w3); ffn_local(gate w1)] per rank and
+must be split before concatenation (megatron_to_hf.py convert_ffn).
+The fused qkv is group-major with megatron's interleaved-RoPE rows — the
+same conventions as the native layout (permute_qkv.py), so no per-head row
+permutation is needed: the native kernel is simply the transpose.
+
+    python -m weights_conversion.megatron_to_native \
+        --load /ckpts/llama2-7b --out ckpts/native [--model_name llama2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+from typing import Any, Dict, List
+
+import numpy as np
+
+from weights_conversion.pt_reader import load_pt
+
+
+def _discover_shards(load_dir: str):
+    """Return (iter_dir, tp_size, pp_size) from the reference layout."""
+    tracker = os.path.join(load_dir, "latest_checkpointed_iteration.txt")
+    if os.path.exists(tracker):
+        with open(tracker) as f:
+            tag = f.read().strip()
+        sub = "release" if tag == "release" else f"iter_{int(tag):07d}"
+        iter_dir = os.path.join(load_dir, sub)
+    else:
+        iter_dir = load_dir  # caller pointed directly at an iteration dir
+    ranks = []
+    for name in sorted(os.listdir(iter_dir)):
+        m = re.fullmatch(r"mp_rank_(\d{2})(?:_(\d{3}))?", name)
+        if m:
+            ranks.append((int(m.group(1)), int(m.group(2) or 0), name))
+    if not ranks:
+        raise FileNotFoundError(f"no mp_rank_* dirs under {iter_dir}")
+    tp = max(r[0] for r in ranks) + 1
+    pp = max(r[1] for r in ranks) + 1
+    assert len(ranks) == tp * pp, (tp, pp, ranks)
+    return iter_dir, tp, pp
+
+
+def load_reference_state(load_dir: str):
+    """Load every mp_rank shard. Returns (states[pp][tp], tp, pp) where each
+    entry is the unpickled model_optim_rng.pt dict."""
+    iter_dir, tp, pp = _discover_shards(load_dir)
+    states = [[None] * tp for _ in range(pp)]
+    for t in range(tp):
+        for p in range(pp):
+            name = f"mp_rank_{t:02d}" + (f"_{p:03d}" if pp > 1 else "")
+            states[p][t] = load_pt(
+                os.path.join(iter_dir, name, "model_optim_rng.pt")
+            )
+    return states, tp, pp
+
+
+def _lm(state) -> Dict[str, Any]:
+    return state["model"]["language_model"]
+
+
+def convert_megatron_state(states: List[List[Dict]], cfg) -> Dict[str, Any]:
+    """Merge shards -> native params pytree (llama/mistral families)."""
+    from megatron_llm_tpu.models import padded_vocab_size
+
+    m = cfg.model
+    h = m.hidden_size
+    L = m.num_layers
+    pp = len(states)
+    tp = len(states[0])
+    assert L % pp == 0, (L, pp)
+    lpr = L // pp
+    vpad = padded_vocab_size(m.vocab_size, cfg)
+
+    def emb_pad(w):
+        out = np.zeros((vpad, h), np.float32)
+        out[: min(w.shape[0], vpad)] = w[:vpad]
+        return out
+
+    # --- embedding (pp stage 0, vocab-split over tp) ---
+    emb = np.concatenate(
+        [np.asarray(_lm(states[0][t])["embedding"]["word_embeddings"]["weight"],
+                    np.float32) for t in range(tp)], axis=0
+    )[: m.vocab_size]
+
+    # --- per-layer merges ---
+    def enc(p, t, local, name):
+        return np.asarray(
+            _lm(states[p][t])["encoder"][f"layers.{local}.{name}"], np.float32
+        )
+
+    qkv_k, dense_k, fc1_k, fc2_k, in_n, post_n = [], [], [], [], [], []
+    for gi in range(L):
+        p, local = gi // lpr, gi % lpr
+        qkv = np.concatenate(
+            [enc(p, t, local, "attention.query_key_value.weight")
+             for t in range(tp)], axis=0)
+        qkv_k.append(np.ascontiguousarray(qkv.T))  # [h, (n+2nkv)d]
+        dense = np.concatenate(
+            [enc(p, t, local, "attention.dense.weight") for t in range(tp)],
+            axis=1)
+        dense_k.append(np.ascontiguousarray(dense.T))  # [nd, h]
+        w3s, w1s = [], []  # up, gate halves of each rank's fc1
+        for t in range(tp):
+            fc1 = enc(p, t, local, "mlp.dense_h_to_4h.weight")
+            half = fc1.shape[0] // 2
+            w3s.append(fc1[:half])
+            w1s.append(fc1[half:])
+        w3 = np.concatenate(w3s, axis=0)  # [ffn, h] up
+        w1 = np.concatenate(w1s, axis=0)  # [ffn, h] gate
+        fc1_k.append(np.stack([w3.T, w1.T], axis=1))  # [h, 2, ffn]
+        fc2 = np.concatenate(
+            [enc(p, t, local, "mlp.dense_4h_to_h.weight") for t in range(tp)],
+            axis=1)
+        fc2_k.append(np.ascontiguousarray(fc2.T))  # [ffn, h]
+        in_n.append(enc(p, 0, local, "input_layernorm.weight"))
+        post_n.append(enc(p, 0, local, "post_attention_layernorm.weight"))
+
+    last = _lm(states[pp - 1][0])
+    params: Dict[str, Any] = {
+        "embedding": {"word_embeddings": emb_pad(emb)},
+        "layers": {
+            "input_norm": {"scale": np.stack(in_n)},
+            "post_norm": {"scale": np.stack(post_n)},
+            "attention": {
+                "qkv": {"kernel": np.stack(qkv_k)},
+                "dense": {"kernel": np.stack(dense_k)},
+            },
+            "mlp": {
+                "fc1": {"kernel": np.stack(fc1_k)},
+                "fc2": {"kernel": np.stack(fc2_k)},
+            },
+        },
+        "final_norm": {
+            "scale": np.asarray(last["encoder"]["final_layernorm.weight"],
+                                np.float32)
+        },
+    }
+    if not m.tie_embed_logits:
+        head = np.concatenate(
+            [np.asarray(_lm(states[pp - 1][t])["lm_head"], np.float32)
+             for t in range(tp)], axis=0
+        )[: m.vocab_size]
+        params["lm_head"] = {"kernel": np.ascontiguousarray(emb_pad(head).T)}
+    return params
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--load", required=True,
+                    help="reference checkpoint root (with tracker file)")
+    ap.add_argument("--out", required=True, help="native checkpoint dir")
+    ap.add_argument("--model_name", default="llama2",
+                    choices=["llama", "llama2", "codellama", "mistral"])
+    ap.add_argument("--num_layers", type=int, required=True)
+    ap.add_argument("--hidden_size", type=int, required=True)
+    ap.add_argument("--num_attention_heads", type=int, required=True)
+    ap.add_argument("--num_attention_heads_kv", type=int, default=None)
+    ap.add_argument("--ffn_hidden_size", type=int, default=None)
+    ap.add_argument("--vocab_size", type=int, required=True)
+    args = ap.parse_args()
+
+    from megatron_llm_tpu.models import make_config
+
+    kw = dict(
+        num_layers=args.num_layers, hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=args.vocab_size,
+    )
+    if args.num_attention_heads_kv:
+        kw["num_attention_heads_kv"] = args.num_attention_heads_kv
+    if args.ffn_hidden_size:
+        kw["ffn_hidden_size"] = args.ffn_hidden_size
+    cfg = make_config(args.model_name, **kw)
+
+    states, tp, pp = load_reference_state(args.load)
+    print(f"loaded {tp}x{pp} reference shards from {args.load}")
+    params = convert_megatron_state(states, cfg)
+
+    import orbax.checkpoint as ocp
+
+    out = os.path.abspath(os.path.join(args.out, "release"))
+    ocp.StandardCheckpointer().save(os.path.join(out, "params"), params)
+    with open(os.path.join(args.out, "latest_checkpointed_iteration.txt"),
+              "w") as f:
+        f.write("release")
+    print(f"saved native release checkpoint to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from weights_conversion.hf_to_native import unpack_qkv
+from weights_conversion.hf_to_native import pack_qkv, unpack_qkv
 from weights_conversion.permute_qkv import interleaved_rows_to_hf
 
 
@@ -62,7 +62,6 @@ def to_hf_falcon_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str
     convert_falcon_state; reference megatron_to_hf.py falcon branch)."""
     m = cfg.model
     n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
-    g = n // nkv
     layers = params["layers"]
     state: Dict[str, np.ndarray] = {
         "transformer.word_embeddings.weight":
@@ -80,13 +79,9 @@ def to_hf_falcon_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str
         q, k, v = unpack_qkv(get("attention", "qkv", "kernel"), n, nkv, d)
         q = interleaved_rows_to_hf(q, d)
         k = interleaved_rows_to_hf(k, d)
-        h = q.shape[1]
-        fused = np.concatenate(
-            [q.reshape(nkv, g, d, h), k.reshape(nkv, 1, d, h),
-             v.reshape(nkv, 1, d, h)], axis=1,
-        ).reshape(nkv * (g + 2) * d, h)
+        # HF falcon's fused qkv is the same group-major layout as native
         state[f"{pre}.self_attention.query_key_value.weight"] = (
-            np.ascontiguousarray(fused)
+            np.ascontiguousarray(pack_qkv(q, k, v, n, nkv, d).T)
         )
         state[f"{pre}.self_attention.dense.weight"] = np.ascontiguousarray(
             get("attention", "dense", "kernel").T
@@ -128,6 +123,9 @@ def hf_config_from_native(cfg, vocab_size: int):
             num_kv_heads=m.num_attention_heads_kv,
             new_decoder_architecture=m.parallel_layernorm,
             parallel_attn=m.parallel_attn,
+            # without new_decoder_architecture HF ignores num_kv_heads and
+            # derives nkv from multi_query — keep them consistent
+            multi_query=(m.num_attention_heads_kv == 1),
             bias=False,
             alibi=False,
             max_position_embeddings=m.max_position_embeddings,

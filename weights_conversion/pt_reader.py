@@ -1,0 +1,137 @@
+"""Torch-free reader for PyTorch ``.pt`` checkpoint files.
+
+The reference stores checkpoints as ``iter_NNNNNNN/mp_rank_{tp:02d}[_{pp:03d}]
+/model_optim_rng.pt`` (reference checkpointing.py:77-104) — torch ZIP
+serialization: a zip archive holding ``<name>/data.pkl`` (a pickle whose
+tensors are persistent-id references) plus one raw little-endian buffer per
+storage under ``<name>/data/<key>``. This module parses that format with only
+zipfile + pickle + numpy, so reference checkpoints can be migrated on hosts
+without torch (and without executing arbitrary reduce callables: unknown
+classes are stubbed, never imported).
+
+    state = load_pt("/ckpts/iter_0080000/mp_rank_00/model_optim_rng.pt")
+    state["model"]["language_model"]["encoder"]["layers.0.attention...."]
+    # -> numpy arrays
+"""
+
+from __future__ import annotations
+
+import pickle
+import zipfile
+from types import SimpleNamespace
+from typing import Any, Dict
+
+import numpy as np
+
+try:  # bundled with jax; gives numpy a real bfloat16
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = np.dtype(np.uint16)  # raw bits fallback
+
+STORAGE_DTYPES = {
+    "FloatStorage": np.dtype(np.float32),
+    "DoubleStorage": np.dtype(np.float64),
+    "HalfStorage": np.dtype(np.float16),
+    "BFloat16Storage": _BFLOAT16,
+    "LongStorage": np.dtype(np.int64),
+    "IntStorage": np.dtype(np.int32),
+    "ShortStorage": np.dtype(np.int16),
+    "CharStorage": np.dtype(np.int8),
+    "ByteStorage": np.dtype(np.uint8),
+    "BoolStorage": np.dtype(np.bool_),
+}
+
+
+class _StorageType:
+    """Marker carrying the element dtype of a torch storage class."""
+
+    def __init__(self, dtype: np.dtype):
+        self.dtype = dtype
+
+
+class _Stub:
+    """Inert stand-in for any class we do not model (argparse.Namespace from
+    the saved args, loss scalers, RNG state holders...). Accepts any
+    construction/state and records it for optional inspection."""
+
+    def __init__(self, *args, **kwargs):
+        self._args, self._kwargs, self._state = args, kwargs, None
+
+    def __setstate__(self, state):
+        self._state = state
+
+    def __call__(self, *args, **kwargs):  # classmethod-style reduces
+        return _Stub(*args, **kwargs)
+
+
+def _rebuild_tensor_v2(storage, storage_offset, size, stride,
+                       requires_grad=False, backward_hooks=None,
+                       metadata=None):
+    arr, dtype = storage
+    itemsize = dtype.itemsize
+    if not size:
+        return arr[storage_offset].copy() if arr.size else arr
+    strides_bytes = tuple(s * itemsize for s in stride)
+    base = arr[storage_offset:]
+    out = np.lib.stride_tricks.as_strided(base, shape=tuple(size),
+                                          strides=strides_bytes)
+    return out.copy()  # own the memory; the zip buffer is transient
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, read_storage):
+        super().__init__(file)
+        self._read_storage = read_storage
+
+    def find_class(self, module: str, name: str):
+        if module == "torch._utils" and name in (
+            "_rebuild_tensor_v2", "_rebuild_tensor"
+        ):
+            return _rebuild_tensor_v2
+        if module == "torch._utils" and name == "_rebuild_parameter":
+            # nn.Parameter(data, requires_grad, hooks) -> just the data
+            return lambda data, *a: data
+        if module == "torch" and name in STORAGE_DTYPES:
+            return _StorageType(STORAGE_DTYPES[name])
+        if module == "collections" and name == "OrderedDict":
+            return dict
+        if (module, name) == ("argparse", "Namespace"):
+            return SimpleNamespace
+        if module.startswith(("torch", "megatron", "numpy", "argparse",
+                              "deepspeed", "apex")):
+            return _Stub  # never import framework code from a checkpoint
+        raise pickle.UnpicklingError(
+            f"refusing to unpickle {module}.{name} from a checkpoint"
+        )
+
+    def persistent_load(self, pid):
+        # ('storage', StorageType, key, location, numel)
+        if not (isinstance(pid, tuple) and pid and pid[0] == "storage"):
+            raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+        _, storage_type, key, _location, _numel = pid
+        dtype = (storage_type.dtype if isinstance(storage_type, _StorageType)
+                 else np.dtype(np.float32))
+        return self._read_storage(str(key), dtype)
+
+
+def load_pt(path: str) -> Dict[str, Any]:
+    """Load a torch ZIP-format .pt file as nested dicts of numpy arrays."""
+    zf = zipfile.ZipFile(path)
+    names = zf.namelist()
+    pkl_name = next((n for n in names if n.endswith("/data.pkl")), None)
+    if pkl_name is None:
+        raise ValueError(
+            f"{path}: not a torch ZIP checkpoint (no data.pkl); legacy "
+            "(pre-1.6) serialization is not supported — re-save with a "
+            "modern torch first"
+        )
+    prefix = pkl_name[: -len("data.pkl")]
+
+    def read_storage(key: str, dtype: np.dtype) -> tuple:
+        buf = zf.read(f"{prefix}data/{key}")
+        return np.frombuffer(buf, dtype=dtype), dtype
+
+    with zf.open(pkl_name) as f:
+        return _Unpickler(f, read_storage).load()
