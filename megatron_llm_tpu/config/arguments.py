@@ -253,6 +253,14 @@ class LoggingConfig:
     log_interval: int = 100
     timing_log_level: int = 0
     timing_log_option: str = "minmax"  # max|minmax|all
+    # jax.profiler xplane tracing (SURVEY §5: the TPU analog of the
+    # reference's named-span timer discipline, megatron/timers.py). Traces
+    # iterations [profile_step_start, profile_step_end) into profile_dir
+    # (viewable with tensorboard / xprof).
+    profile: bool = False
+    profile_step_start: int = 10
+    profile_step_end: int = 12
+    profile_dir: Optional[str] = None  # default: <tensorboard_dir or .>/profile
     tensorboard_dir: Optional[str] = None
     tensorboard_log_interval: int = 1
     tensorboard_queue_size: int = 1000

@@ -28,7 +28,10 @@ from megatron_llm_tpu.models.language_model import loss_from_batch, make_rope_ca
 from megatron_llm_tpu.optimizer.optimizer import opt_state_shardings
 from megatron_llm_tpu.parallel.tp import make_sp_constraint, param_shardings
 from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
-from megatron_llm_tpu.training_step import make_jitted_train_step
+from megatron_llm_tpu.training_step import (
+    make_jitted_train_step,
+    measure_span_breakdown,
+)
 from megatron_llm_tpu.utils.logging_utils import (
     SignalHandler,
     build_writer,
@@ -374,9 +377,26 @@ def pretrain(
         metrics: Dict[str, Any] = {}
         step_times = []
 
+        profiling = False
+        profile_stop_at = None  # set when the trace starts
+        spans_printed = False
+        profile_dir = cfg.logging.profile_dir or os.path.join(
+            cfg.logging.tensorboard_dir or ".", "profile"
+        )
+
         while iteration < train_iters:
             if t.skip_train:
                 break
+            # xplane tracing over [profile_step_start, profile_step_end)
+            # (SURVEY §5: jax-profiler analog of the reference's span timers)
+            # >= not ==: a resumed run past the start step still gets a trace
+            # (of at least one step, even past the configured window)
+            if (cfg.logging.profile and profile_stop_at is None
+                    and iteration >= cfg.logging.profile_step_start):
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+                profile_stop_at = max(cfg.logging.profile_step_end,
+                                      iteration + 1)
             calc.update(consumed_samples)
             gbs = calc.get_current_global_batch_size()
             num_micro = calc.get()
@@ -423,10 +443,26 @@ def pretrain(
             iteration += 1
             consumed_samples += gbs
 
+            if profiling and iteration >= profile_stop_at:
+                jax.profiler.stop_trace()
+                profiling = False
+                print(f"profiler: xplane trace written to {profile_dir}",
+                      flush=True)
+
             if iteration % cfg.logging.log_interval == 0:
                 avg = float(np.mean(step_times[-cfg.logging.log_interval:]))
                 training_log(cfg, metrics, iteration, avg, writer, timers,
                              consumed_samples, global_batch_size=gbs)
+                if cfg.logging.timing_log_level >= 2 and not spans_printed:
+                    spans_printed = True  # once per run, incl. resumed runs
+                    spans = measure_span_breakdown(
+                        cfg, params, shardings["place_batch"](batch), avg,
+                        loss_fn=loss_fn,
+                    )
+                    if spans:
+                        print("    span breakdown (ms): " + " | ".join(
+                            f"{k}: {v * 1e3:.1f}" for k, v in spans.items()),
+                            flush=True)
 
             if (cfg.training.eval_interval and valid_iter_factory
                     and iteration % cfg.training.eval_interval == 0):
@@ -458,6 +494,8 @@ def pretrain(
                 exit_reason = "exit_duration"
                 break
 
+        if profiling:  # early exit mid-window: don't leak an open trace
+            jax.profiler.stop_trace()
         if cfg.checkpoint.save and exit_reason != "train_iters reached":
             save_checkpoint(cfg, cfg.checkpoint.save, iteration, params,
                             opt_state, consumed_samples)

@@ -92,13 +92,14 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
         deterministic = (
             cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0
         ) or dropout_key is None
-        return loss_fn(
-            cfg, params, mb,
-            dropout_key=dropout_key,
-            deterministic=deterministic,
-            rope_cache=rope,
-            sp_constraint=sp_constraint,
-        )
+        with jax.named_scope("forward"):
+            return loss_fn(
+                cfg, params, mb,
+                dropout_key=dropout_key,
+                deterministic=deterministic,
+                rope_cache=rope,
+                sp_constraint=sp_constraint,
+            )
 
     pp = cfg.parallel.pipeline_model_parallel_size
 
@@ -188,9 +189,13 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             loss = loss_sum * inv
 
         loss = loss * inv_scale  # report the un-scaled loss
-        grad_norm = global_grad_norm(grads) * inv_scale
-        updates, new_opt_state = opt.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        # named scopes surface as labeled regions in jax.profiler xplane
+        # traces — the analog of the reference's optimizer span timers
+        # (training.py:500-525)
+        with jax.named_scope("optimizer"):
+            grad_norm = global_grad_norm(grads) * inv_scale
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
         metrics = {
             "lm loss": loss,
             "grad_norm": grad_norm,
@@ -250,6 +255,64 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
         "batch": b_shard,
         "place_batch": place_batch,
         "opt_state_value": opt_state,
+    }
+
+
+def measure_span_breakdown(cfg, params, batch, step_time_s: float,
+                           loss_fn=None, reps: int = 3):
+    """One-off forward/backward/optimizer wall-clock split.
+
+    The analog of the reference's per-span timer readout (training.py:500-525)
+    — a single jitted step cannot be split from the host, so this times two
+    auxiliary programs (forward-only, forward+backward) and attributes the
+    rest of ``step_time_s`` to the optimizer. Compiles two extra programs:
+    call once, behind timing_log_level >= 2. Returns dict of seconds or None
+    for pipelined configs (spans interleave; use the xplane trace instead).
+    """
+    import time
+
+    if cfg.parallel.pipeline_model_parallel_size > 1:
+        return None
+    from megatron_llm_tpu.models.language_model import (
+        loss_from_batch as default_loss,
+        make_rope_cache,
+    )
+
+    lf = loss_fn or default_loss
+    rope = make_rope_cache(cfg)
+    sp_constraint = make_sp_constraint(cfg)
+
+    # time ONE microbatch and scale: the real step scans num_micro of them,
+    # and a monolithic full-global-batch program would need num_micro x the
+    # activation memory the tuned step was sized for
+    num_micro = cfg.parallel.num_micro_batches or 1
+    if num_micro > 1:
+        batch = _split_microbatches(batch, num_micro)
+        batch = jax.tree.map(lambda a: a[0], batch)
+
+    def loss_only(p, b):
+        return lf(cfg, p, b, deterministic=True, rope_cache=rope,
+                  sp_constraint=sp_constraint)[0]
+
+    fwd = jax.jit(loss_only)
+    fwdbwd = jax.jit(jax.value_and_grad(loss_only))
+
+    def best_of(fn):
+        fn(params, batch)  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(params, batch)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_fwd = best_of(fwd) * num_micro
+    t_fwdbwd = best_of(fwdbwd) * num_micro
+    return {
+        "forward": t_fwd,
+        "backward": max(t_fwdbwd - t_fwd, 0.0),
+        "optimizer": max(step_time_s - t_fwdbwd, 0.0),
     }
 
 

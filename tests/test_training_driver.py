@@ -78,6 +78,32 @@ def test_pretrain_end_to_end_and_resume(toy_corpus, tmp_path, capsys):
     assert "tokens/sec" in out
 
 
+def test_profiler_and_span_breakdown(toy_corpus, tmp_path, capsys):
+    """--profile dumps an xplane trace; timing_log_level>=2 prints the
+    fwd/bwd/opt split (SURVEY §5 / VERDICT missing #6, weak #8)."""
+    from megatron_llm_tpu.training import pretrain
+
+    cfg = small_cfg(toy_corpus, tmp_path, train_iters=6)
+    cfg.checkpoint.save = None
+    cfg.logging.profile = True
+    cfg.logging.profile_step_start = 2
+    cfg.logging.profile_step_end = 4
+    cfg.logging.profile_dir = str(tmp_path / "prof")
+    cfg.logging.timing_log_level = 2
+    cfg.logging.log_interval = 4
+    result = pretrain(cfg)
+    assert result["iteration"] == 6
+
+    out = capsys.readouterr().out
+    assert "xplane trace written" in out
+    assert "span breakdown" in out and "backward" in out
+    # the trace directory must contain an .xplane.pb dump
+    found = []
+    for root, _dirs, files in os.walk(tmp_path / "prof"):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, "no xplane trace file written"
+
+
 def test_finetune_flag_resets_iteration(toy_corpus, tmp_path):
     from megatron_llm_tpu.training import pretrain
 
