@@ -1,39 +1,61 @@
-"""Beam hypothesis container — megatron/text_generation/beam_utils.py analog
-(BeamHypotheses:19-64, itself from HuggingFace). Host-side bookkeeping; holds
-numpy token arrays."""
+"""Top-k beam hypothesis container for beam search decoding.
+
+Keeps the k best finished hypotheses by length-normalized score
+(score = sum_logprobs / length**length_penalty) in a min-heap, so insertion
+is O(log k) and the current admission threshold (the worst kept score) is
+the heap root. ``is_done`` implements the standard beam-search stopping
+rule: once k hypotheses are kept and even the best possible completion of
+any open beam (optimistically length-normalized at the current length)
+cannot beat the worst kept score, decoding can stop.
+
+Role analog: megatron/text_generation/beam_utils.py (whose container is the
+HuggingFace list-based implementation); this one is an independent
+heap-based design around the same decode loop contract
+(add / is_done / beams).
+"""
 
 from __future__ import annotations
+
+import heapq
+from itertools import count
+from typing import Any, List, Tuple
 
 
 class BeamHypotheses:
     def __init__(self, num_beams: int, length_penalty: float = 1.0,
                  early_stopping: bool = False):
+        self.num_beams = num_beams
         self.length_penalty = length_penalty
         self.early_stopping = early_stopping
-        self.num_beams = num_beams
-        self.beams = []  # list of (score, tokens)
-        self.worst_score = 1e9
+        # min-heap of (normalized_score, tiebreak, tokens): the root is the
+        # worst kept hypothesis, i.e. the admission threshold
+        self._heap: List[Tuple[float, int, Any]] = []
+        self._tiebreak = count()  # token arrays are not orderable
 
     def __len__(self) -> int:
-        return len(self.beams)
+        return len(self._heap)
+
+    @property
+    def beams(self) -> List[Tuple[float, Any]]:
+        """Kept hypotheses as (normalized_score, tokens), unordered."""
+        return [(score, tokens) for score, _, tokens in self._heap]
+
+    def _threshold(self) -> float:
+        return self._heap[0][0] if self._heap else float("-inf")
 
     def add(self, hyp, sum_logprobs: float, length: int) -> None:
         score = sum_logprobs / length ** self.length_penalty
-        if len(self) < self.num_beams or score > self.worst_score:
-            self.beams.append((score, hyp))
-            if len(self) > self.num_beams:
-                sorted_scores = sorted(
-                    (s, idx) for idx, (s, _) in enumerate(self.beams)
-                )
-                del self.beams[sorted_scores[0][1]]
-                self.worst_score = sorted_scores[1][0]
-            else:
-                self.worst_score = min(score, self.worst_score)
+        entry = (score, next(self._tiebreak), hyp)
+        if len(self._heap) < self.num_beams:
+            heapq.heappush(self._heap, entry)
+        elif score > self._threshold():
+            heapq.heapreplace(self._heap, entry)
 
     def is_done(self, best_sum_logprobs: float, cur_len: int) -> bool:
-        """No remaining open beam can beat the worst kept hypothesis."""
-        if len(self) < self.num_beams:
+        """True when no open beam can still improve the kept set."""
+        if len(self._heap) < self.num_beams:
             return False
         if self.early_stopping:
             return True
-        return self.worst_score >= best_sum_logprobs / cur_len ** self.length_penalty
+        optimistic = best_sum_logprobs / cur_len ** self.length_penalty
+        return self._threshold() >= optimistic

@@ -314,6 +314,17 @@ def pretrain(
         )
         opt_state = shardings["opt_state_value"]
         timers("model-setup").stop()
+        if cfg.optimizer.use_distributed_optimizer:
+            from megatron_llm_tpu.core.parallel_state import DP_AXIS
+            from megatron_llm_tpu.optimizer.optimizer import (
+                zero1_sharded_fraction,
+            )
+
+            frac = zero1_sharded_fraction(
+                cfg, params, opt_state, mesh.shape.get(DP_AXIS, 1)
+            )
+            print(f"ZeRO-1: {frac * 100:.1f}% of optimizer-state elements "
+                  f"sharded over dp={mesh.shape.get(DP_AXIS, 1)}", flush=True)
 
         iteration, consumed_samples = 0, 0
         if cfg.checkpoint.load:

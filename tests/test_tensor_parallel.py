@@ -101,6 +101,27 @@ def test_train_step_tp_dp_matches_single(eight_devices):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
+def test_zero1_sharded_fraction(eight_devices):
+    """The dp-sharding heuristic must cover nearly all optimizer state —
+    silently-replicated moments would defeat ZeRO-1 (VERDICT weak #7)."""
+    from megatron_llm_tpu.optimizer.optimizer import (
+        get_optimizer,
+        zero1_sharded_fraction,
+    )
+
+    cfg = tiny_config(tp=2, dp=4, sp=True, use_distributed_optimizer=True,
+                      micro_batch_size=2, global_batch_size=8,
+                      train_iters=10, lr=1e-2)
+    mesh = build_mesh(tensor_model_parallel_size=2, devices=eight_devices)
+    with mesh:
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        opt_state = get_optimizer(cfg, params).init(params)
+        frac = zero1_sharded_fraction(cfg, params, opt_state, dp_size=4)
+    # moments dominate element counts; norm scales may stay replicated but
+    # must be a sliver
+    assert frac > 0.95, f"only {frac:.1%} of optimizer state is dp-sharded"
+
+
 def test_microbatch_accumulation_matches_full_batch(eight_devices):
     """num_micro_batches=4 grads == one big batch (pure accumulation)."""
     tok = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256)
