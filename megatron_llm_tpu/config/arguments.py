@@ -292,6 +292,27 @@ class InferenceConfig:
 
 
 @dataclass
+class RetrieverConfig:
+    """Biencoder/ICT/REALM retrieval (reference ``_add_biencoder_args``:
+    biencoder_model.py, pretrain_ict.py, indexer.py, tasks/orqa)."""
+
+    biencoder_projection_dim: int = 0
+    biencoder_shared_query_context_model: bool = False
+    retriever_score_scaling: bool = False
+    retriever_report_topk_accuracies: List[int] = field(
+        default_factory=lambda: [1, 5, 20]
+    )
+    retriever_seq_length: int = 256
+    titles_data_path: Optional[str] = None
+    query_in_block_prob: float = 0.1
+    use_one_sent_docs: bool = False
+    bert_load: Optional[str] = None     # init towers from a BERT checkpoint
+    embedding_path: Optional[str] = None  # block-embedding store
+    indexer_batch_size: int = 128
+    indexer_log_interval: int = 1000
+
+
+@dataclass
 class Config:
     """Aggregate configuration (analog of the reference's global ``args``)."""
 
@@ -303,6 +324,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
     # architecture family: 'gpt' | 'llama' | 'llama2' | 'codellama' | 'falcon' | 'mistral'
     model_name: str = "llama2"
 
@@ -476,6 +498,7 @@ _GROUPS = {
     "checkpoint": CheckpointConfig,
     "logging": LoggingConfig,
     "inference": InferenceConfig,
+    "retriever": RetrieverConfig,
 }
 
 

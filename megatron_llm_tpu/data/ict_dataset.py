@@ -1,0 +1,147 @@
+"""Inverse Cloze Task dataset for biencoder pretraining.
+
+Reference: megatron/data/ict_dataset.py (ICTDataset:50-158) over the block
+samples mapping of realm_dataset_utils.py / helpers.cpp build_blocks_mapping:
+documents are sequences of sentences (one indexed-dataset item per sentence,
+doc_idx marking document bounds); consecutive sentences are greedily grouped
+into "blocks" of at most ``max_seq_length`` tokens, and a training sample is
+(pseudo-query = one random sentence, context = its block — with the query
+sentence REMOVED from the block 1-query_in_block_prob of the time, which is
+the inverse cloze objective).
+
+The mapping is built in vectorized numpy (the reference JIT-compiles a C++
+helper for this; at one pass over the sizes array numpy is plenty).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from megatron_llm_tpu.data.indexed_dataset import MMapIndexedDataset
+
+
+def build_blocks_mapping(
+    sizes: np.ndarray,      # token length of each sentence
+    doc_idx: np.ndarray,    # [n_docs+1] sentence index at each doc start
+    max_seq_length: int,
+    use_one_sent_docs: bool = False,
+) -> np.ndarray:
+    """[n_blocks, 4] rows (start_sent, end_sent, doc, block_idx) — the
+    helpers.cpp build_blocks_mapping:454-671 contract."""
+    rows: List[Tuple[int, int, int, int]] = []
+    block_idx = 0
+    for d in range(len(doc_idx) - 1):
+        lo, hi = int(doc_idx[d]), int(doc_idx[d + 1])
+        n_sents = hi - lo
+        if n_sents == 0 or (n_sents == 1 and not use_one_sent_docs):
+            continue
+        start, tokens = lo, 0
+        for s in range(lo, hi):
+            sent = int(sizes[s])
+            if tokens + sent > max_seq_length and tokens > 0:
+                rows.append((start, s, d, block_idx))
+                block_idx += 1
+                start, tokens = s, 0
+            tokens += sent
+        if tokens > 0:
+            rows.append((start, hi, d, block_idx))
+            block_idx += 1
+    return np.asarray(rows, np.int64).reshape(-1, 4)
+
+
+def make_attention_pad_mask(tokens: np.ndarray, pad_id: int) -> np.ndarray:
+    return (tokens != pad_id).astype(np.int64)
+
+
+class ICTDataset:
+    """Pseudo-query / context-block pairs (ICTDataset:50-158)."""
+
+    def __init__(
+        self,
+        block_dataset: MMapIndexedDataset,
+        title_dataset: Optional[MMapIndexedDataset],
+        max_seq_length: int,
+        query_in_block_prob: float = 0.1,
+        seed: int = 1234,
+        use_titles: bool = True,
+        use_one_sent_docs: bool = False,
+        cls_id: int = 101,
+        sep_id: int = 102,
+        pad_id: int = 0,
+        num_samples: Optional[int] = None,
+    ):
+        self.block_dataset = block_dataset
+        self.title_dataset = title_dataset if use_titles else None
+        self.max_seq_length = max_seq_length
+        self.query_in_block_prob = query_in_block_prob
+        self.cls_id, self.sep_id, self.pad_id = cls_id, sep_id, pad_id
+        self.mapping = build_blocks_mapping(
+            block_dataset.sizes, block_dataset.doc_idx, max_seq_length,
+            use_one_sent_docs,
+        )
+        self.num_samples = num_samples or len(self.mapping)
+        self.rng = random.Random(seed)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        start, end, doc, block_id = self.mapping[idx % len(self.mapping)]
+        title = (list(self.title_dataset[int(doc)])
+                 if self.title_dataset is not None else None)
+        title_pad_offset = 3 + len(title) if title is not None else 2
+        block = [list(self.block_dataset[i]) for i in range(start, end)]
+
+        sent_idx = self.rng.randint(0, len(block) - 1)
+        if self.rng.random() < self.query_in_block_prob:
+            query = list(block[sent_idx])  # query kept in context
+        else:
+            query = block.pop(sent_idx)    # inverse cloze: query removed
+
+        query = query[: self.max_seq_length - 2]
+        flat = [t for sent in block for t in sent]
+        flat = flat[: self.max_seq_length - title_pad_offset]
+
+        query_tokens, query_pad = self.concat_and_pad_tokens(query)
+        context_tokens, context_pad = self.concat_and_pad_tokens(flat, title)
+        return {
+            "query_tokens": query_tokens,
+            "query_pad_mask": query_pad,
+            "context_tokens": context_tokens,
+            "context_pad_mask": context_pad,
+            "block_data": np.asarray([start, end, doc, block_id], np.int64),
+        }
+
+    def get_block(self, start: int, end: int, doc: int) -> tuple:
+        """Tokens for an evidence block (indexer path, ict_dataset.py:127)."""
+        title = (list(self.title_dataset[int(doc)])
+                 if self.title_dataset is not None else None)
+        offset = 3 + len(title) if title is not None else 2
+        flat = [t for i in range(start, end) for t in self.block_dataset[i]]
+        return self.concat_and_pad_tokens(flat[: self.max_seq_length - offset],
+                                          title)
+
+    def get_null_block(self) -> tuple:
+        return self.concat_and_pad_tokens([], [] if self.title_dataset else None)
+
+    def concat_and_pad_tokens(self, tokens, title=None) -> tuple:
+        """[CLS] (title [SEP])? tokens [SEP] + padding, with pad mask."""
+        if title is None:
+            out = [self.cls_id, *tokens, self.sep_id]
+        else:
+            out = [self.cls_id, *title, self.sep_id, *tokens, self.sep_id]
+        assert len(out) <= self.max_seq_length, (len(out), self.max_seq_length)
+        pad = self.max_seq_length - len(out)
+        mask = np.asarray([1] * len(out) + [0] * pad, np.int64)
+        arr = np.asarray(out + [self.pad_id] * pad, np.int64)
+        return arr, mask
+
+
+def ict_collator(samples: list) -> dict:
+    return {
+        key: np.stack([s[key] for s in samples])
+        for key in samples[0]
+    }

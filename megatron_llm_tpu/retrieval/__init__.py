@@ -1,0 +1,16 @@
+from megatron_llm_tpu.retrieval.biencoder import (
+    biencoder_embed,
+    biencoder_forward,
+    ict_loss_from_batch,
+    init_biencoder_params,
+)
+from megatron_llm_tpu.retrieval.index import BlockEmbedStore, MIPSIndex
+
+__all__ = [
+    "BlockEmbedStore",
+    "MIPSIndex",
+    "biencoder_embed",
+    "biencoder_forward",
+    "ict_loss_from_batch",
+    "init_biencoder_params",
+]

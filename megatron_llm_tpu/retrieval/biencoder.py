@@ -1,0 +1,157 @@
+"""BERT-based biencoder for learned retrieval (ICT / REALM / DPR-style).
+
+Reference: megatron/model/biencoder_model.py (BiEncoderModel:71,
+PretrainedBertModel:255 — CLS pooling + optional linear projection) and the
+ICT in-batch contrastive loss of pretrain_ict.py:76-118.
+
+TPU-native redesign of the loss: the reference all-gathers query/context
+embeddings across the data-parallel group with a hand-written autograd
+collective (pretrain_ict.py AllgatherFromDataParallelRegion:47-73) so every
+rank scores against the *global* batch. Under SPMD the global batch is one
+logical array sharded over ``dp`` — writing ``scores = q @ c.T`` makes XLA
+insert exactly that all-gather (and its transpose in the backward), so the
+whole apparatus reduces to a matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.models.bert import padding_bias
+from megatron_llm_tpu.models.language_model import embed_tokens, init_model_params
+from megatron_llm_tpu.models.transformer import transformer_forward
+from megatron_llm_tpu.ops.norms import norm
+
+Params = Dict[str, Any]
+
+
+def _init_tower(cfg, key: jax.Array) -> Params:
+    tower = init_model_params(cfg, key)
+    tower.pop("lm_head", None)  # encoder only — no vocab head
+    proj_dim = cfg.retriever.biencoder_projection_dim
+    if proj_dim > 0:
+        h = cfg.model.hidden_size
+        tower["projection"] = {
+            "kernel": cfg.model.init_method_std
+            * jax.random.normal(jax.random.fold_in(key, 11), (h, proj_dim),
+                                jnp.float32),
+            "bias": jnp.zeros((proj_dim,), jnp.float32),
+        }
+    return tower
+
+
+def init_biencoder_params(cfg, key: jax.Array) -> Params:
+    """Two towers, or one shared (biencoder_shared_query_context_model).
+    With cfg.retriever.bert_load set, the encoder weights of every tower are
+    warm-started from that BERT checkpoint (init_state_dict_from_bert,
+    biencoder_model.py:189-254); projections stay freshly initialized."""
+    if cfg.retriever.biencoder_shared_query_context_model:
+        params = {"shared_model": _init_tower(cfg, key)}
+    else:
+        kq, kc = jax.random.split(key)
+        params = {"query_model": _init_tower(cfg, kq),
+                  "context_model": _init_tower(cfg, kc)}
+    if cfg.retriever.bert_load:
+        bert = _load_bert_encoder(cfg.retriever.bert_load)
+        for tower in params.values():
+            for k in ("embedding", "layers", "final_norm"):
+                tower[k] = jax.tree.map(jnp.asarray, bert[k])
+    return params
+
+
+def _load_bert_encoder(load_dir: str) -> Params:
+    """Encoder subtree (embedding/layers/final_norm) of a saved BERT
+    checkpoint (pretrain_bert.py output layout)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from megatron_llm_tpu.checkpointing import checkpoint_dir, read_tracker
+
+    iteration, release = read_tracker(load_dir)
+    path = checkpoint_dir(os.path.abspath(load_dir), iteration or 0, release)
+    params = ocp.StandardCheckpointer().restore(os.path.join(path, "params"))
+    missing = {"embedding", "layers", "final_norm"} - set(params)
+    if missing:
+        raise ValueError(f"{load_dir}: not a BERT checkpoint "
+                         f"(missing {sorted(missing)})")
+    return params
+
+
+def _towers(params: Params) -> Tuple[Params, Params]:
+    if "shared_model" in params:
+        return params["shared_model"], params["shared_model"]
+    return params["query_model"], params["context_model"]
+
+
+def biencoder_embed(
+    cfg,
+    tower: Params,
+    tokens: jax.Array,        # [b, s]
+    padding_mask: jax.Array,  # [b, s] 1=real
+    tokentype_ids: Optional[jax.Array] = None,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Embed a batch of texts -> [b, proj_dim or hidden] (CLS pooling,
+    biencoder_model.py:298-310)."""
+    m = cfg.model
+    hidden = embed_tokens(cfg, tower, tokens, tokentype_ids=tokentype_ids)
+    hidden, _ = transformer_forward(
+        cfg, tower["layers"], hidden,
+        attn_bias=padding_bias(padding_mask),
+        dropout_key=dropout_key, deterministic=deterministic,
+    )
+    hidden = norm(hidden, tower["final_norm"], m.layernorm_epsilon,
+                  m.use_rms_norm)
+    pooled = hidden[:, 0]  # [CLS]
+    if "projection" in tower:
+        pooled = (pooled @ tower["projection"]["kernel"].astype(pooled.dtype)
+                  + tower["projection"]["bias"].astype(pooled.dtype))
+    return pooled.astype(jnp.float32)
+
+
+def biencoder_forward(cfg, params: Params, batch: Dict[str, jax.Array], *,
+                      dropout_key=None, deterministic=True):
+    """Returns (query_embeds [b, d], context_embeds [b, d])."""
+    qt, ct = _towers(params)
+    kq = kc = None
+    if dropout_key is not None:
+        kq, kc = jax.random.split(dropout_key)
+    q = biencoder_embed(cfg, qt, batch["query_tokens"],
+                        batch["query_pad_mask"], dropout_key=kq,
+                        deterministic=deterministic)
+    c = biencoder_embed(cfg, ct, batch["context_tokens"],
+                        batch["context_pad_mask"], dropout_key=kc,
+                        deterministic=deterministic)
+    return q, c
+
+
+def ict_loss_from_batch(cfg, params: Params, batch: Dict[str, jax.Array], *,
+                        dropout_key=None, deterministic=True,
+                        rope_cache=None, sp_constraint=None):
+    """In-batch contrastive retrieval loss (pretrain_ict.py loss_func:76-118):
+    NLL of the matching context under softmax over all contexts in the global
+    batch, plus top-k retrieval accuracies."""
+    del rope_cache, sp_constraint  # bidirectional towers; absolute/none pos
+    q, c = biencoder_forward(cfg, params, batch, dropout_key=dropout_key,
+                             deterministic=deterministic)
+    scores = q @ c.T  # [gbs, gbs]; XLA all-gathers the dp-sharded c
+    if cfg.retriever.retriever_score_scaling:
+        scores = scores / jnp.sqrt(jnp.float32(cfg.model.hidden_size))
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    gbs = scores.shape[0]
+    labels = jnp.arange(gbs)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+    # top-k retrieval accuracy metrics (retriever_report_topk_accuracies)
+    ranks = jnp.argsort(-scores, axis=-1)
+    match = ranks == labels[:, None]  # [gbs, gbs] one-hot at the true rank
+    metrics = {"lm loss": loss}
+    for k in cfg.retriever.retriever_report_topk_accuracies:
+        if k <= gbs:
+            metrics[f"top{k}_acc"] = match[:, :k].any(axis=-1).mean() * 100.0
+    return loss, metrics

@@ -1,0 +1,56 @@
+"""Evidence-index builder: embed every block with the context tower.
+
+Reference: megatron/indexer.py (IndexBuilder:123 — shards blocks over DP
+ranks, embeds with the context model, writes OpenRetreivalDataStore shards,
+merges). Single-controller version: one process walks the block mapping in
+batches, runs the jitted context encoder (batch dp-sharded over the mesh if
+one is active), and fills a BlockEmbedStore.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from megatron_llm_tpu.retrieval.biencoder import biencoder_embed
+from megatron_llm_tpu.retrieval.index import BlockEmbedStore
+
+
+class IndexBuilder:
+    def __init__(self, cfg, params, dataset, store: Optional[BlockEmbedStore] = None):
+        """``dataset`` is an ICTDataset (get_block + mapping); ``params`` a
+        biencoder params tree."""
+        self.cfg = cfg
+        self.dataset = dataset
+        self.store = store or BlockEmbedStore(cfg.retriever.embedding_path)
+        tower_key = ("shared_model" if "shared_model" in params
+                     else "context_model")
+        tower = params[tower_key]
+        self._embed = jax.jit(
+            lambda tok, mask: biencoder_embed(cfg, tower, tok, mask)
+        )
+
+    def build_and_save_index(self, log=print) -> BlockEmbedStore:
+        r = self.cfg.retriever
+        mapping = self.dataset.mapping
+        bs = r.indexer_batch_size
+        for i0 in range(0, len(mapping), bs):
+            rows = mapping[i0: i0 + bs]
+            n = len(rows)
+            toks, masks = zip(*(
+                self.dataset.get_block(int(s), int(e), int(d))
+                for s, e, d, _ in rows
+            ))
+            toks, masks = np.stack(toks), np.stack(masks)
+            if n < bs:  # pad the tail batch: one compiled program for all
+                toks = np.concatenate([toks, np.repeat(toks[-1:], bs - n, 0)])
+                masks = np.concatenate([masks, np.repeat(masks[-1:], bs - n, 0)])
+            embeds = np.asarray(self._embed(toks, masks), np.float32)[:n]
+            self.store.add_block_data(rows[:, 3], embeds, block_metas=rows)
+            if (i0 // bs) % max(r.indexer_log_interval // bs, 1) == 0:
+                log(f"indexer: {i0 + len(rows)}/{len(mapping)} blocks")
+        if self.store.embedding_path:
+            self.store.save()
+        return self.store

@@ -1,0 +1,35 @@
+"""MSDP evaluation: token F1 between generated and reference files.
+
+Reference: tasks/msdp/evaluate.py (evaluate_f1 over line-aligned files).
+
+    python tasks/msdp/evaluate.py --guess_file gen.txt --answer_file ref.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from tasks.msdp.metrics import F1Metric
+
+
+def evaluate_f1(guess_file: str, answer_file: str):
+    with open(guess_file, encoding="utf-8") as f:
+        guesses = [x.strip() for x in f]
+    with open(answer_file, encoding="utf-8") as f:
+        answers = [x.strip() for x in f]
+    guesses = guesses[: len(answers)]
+    precision, recall, f1 = F1Metric.compute_all_pairs(guesses, answers)
+    print(f"Precision: {precision:.4f} | Recall: {recall:.4f} | F1: {f1:.4f}")
+    return precision, recall, f1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--guess_file", required=True)
+    ap.add_argument("--answer_file", required=True)
+    args = ap.parse_args()
+    evaluate_f1(args.guess_file, args.answer_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""MSDP data preprocessing: dialog datasets -> the tab-separated test format.
+
+Reference: tasks/msdp/preprocessing.py (Wizard-of-Wikipedia / Wizard-of-
+Internet specific). This version implements the shared core: flatten a
+dialog json into ``topic\\tturn1 [SEP] ... turnN\\tknowledge`` lines (the
+format prompt.py consumes) and emit line-aligned reference responses for
+evaluation.
+
+Input jsonl, one dialog per line:
+    {"topic": ..., "turns": ["u1", "s1", "u2", ...],
+     "knowledge": ["k for s1", "k for s2", ...]}
+Every system turn (odd index) becomes one sample whose context is all turns
+before it.
+
+    python tasks/msdp/preprocessing.py dialogs.jsonl test.txt refs.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _sanitize(text: str) -> str:
+    """The output formats are tab-separated and line-aligned — embedded tabs
+    would shift fields and embedded newlines would misalign every following
+    guess/answer pair in evaluate_f1."""
+    return " ".join(str(text).split())
+
+
+def process_dialogs(in_path: str, test_path: str, ref_path: str) -> int:
+    n = 0
+    with open(in_path, encoding="utf-8") as fin, \
+            open(test_path, "w", encoding="utf-8") as ftest, \
+            open(ref_path, "w", encoding="utf-8") as fref:
+        for line in fin:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            topic = _sanitize(d.get("topic", ""))
+            turns = [_sanitize(t) for t in d["turns"]]
+            knowledge = [_sanitize(k) for k in d.get("knowledge", [])]
+            for i in range(1, len(turns), 2):  # system turns
+                context = " [SEP] ".join(turns[:i])
+                k = knowledge[i // 2] if i // 2 < len(knowledge) else ""
+                ftest.write(f"{topic}\t{context}\t{k}\n")
+                fref.write(turns[i].strip() + "\n")
+                n += 1
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("test_output")
+    ap.add_argument("ref_output")
+    args = ap.parse_args()
+    n = process_dialogs(args.input, args.test_output, args.ref_output)
+    print(f"wrote {n} samples", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

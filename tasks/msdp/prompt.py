@@ -1,0 +1,140 @@
+"""Multi-stage dialog prompting: knowledge + response generation.
+
+Reference: tasks/msdp/prompt.py (the MSDP paper's two-stage pipeline):
+stage 1 prompts the LM to generate topical knowledge for the dialog's last
+turn; stage 2 prompts it to generate the response conditioned on that
+knowledge. Test samples are tab-separated: ``topic\\tturn1 [SEP] turn2...\\t
+knowledge``. Generation runs through the local generation API (a loaded
+model) or any REST endpoint following the server's PUT /api contract.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Callable, Dict, Optional
+
+
+def _tokenize_words(text: str) -> str:
+    """Whitespace-normalize with punctuation split (reference uses
+    nltk.word_tokenize; a regexp split keeps the prompt format identical
+    for evaluation purposes without the nltk data download)."""
+    return " ".join(re.findall(r"\w+|[^\w\s]", text))
+
+
+def read_prompts(prompt_path: str, prompt_type: str,
+                 n_example: int) -> object:
+    """Knowledge prompts: jsonl {"<topic> <last turn>": [examples...]} ->
+    dict of concatenated few-shot prompts. Response prompts: plain lines ->
+    one shared few-shot prompt (prompt.py:38-71)."""
+    if prompt_type == "knowledge":
+        out: Dict[str, str] = {}
+        with open(prompt_path, encoding="utf-8") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                d = json.loads(line)
+                key = next(iter(d))
+                if key not in out:
+                    out[key] = "".join(x.strip() + " \n" for x in d[key])
+        return out
+    with open(prompt_path, encoding="utf-8") as f:
+        lines = [x.strip() for x in f.readlines()[:n_example]]
+    return "".join(x + " \n" for x in lines)
+
+
+def build_knowledge_input(prompts: Dict[str, str], topic: str,
+                          last_turn: str) -> str:
+    key = f"{topic} {last_turn}"
+    prompt = prompts.get(key, next(iter(prompts.values())) if prompts else "")
+    return prompt + "( " + last_turn + " ) " + topic + " =>"
+
+
+def build_response_input(prompt: str, topic: str, last_turn: str,
+                         knowledge: str) -> str:
+    last_turn = _tokenize_words(last_turn).strip()
+    knowledge = _tokenize_words(knowledge).strip()
+    return (prompt + "Topic: " + topic + ". "
+            + "User says: " + last_turn + " "
+            + "We know that: " + knowledge + " "
+            + "System replies:")
+
+
+def postprocess_generation(full_output: str, input_text: str) -> str:
+    """Strip the prompt and keep the first generated line (prompt.py:31-35)."""
+    out = full_output[len(input_text):] if full_output.startswith(input_text) \
+        else full_output
+    return out.split("\n")[0].strip()
+
+
+def generate_samples(
+    generate_fn: Callable[[str, int], str],
+    prompt_file: str,
+    prompt_type: str,
+    sample_input_file: str,
+    sample_output_file: str,
+    n_prompt_examples: int = 10,
+    out_seq_length: int = 64,
+) -> int:
+    """Drive the stage over a test file; returns the number of samples.
+
+    ``generate_fn(input_text, tokens_to_generate) -> full output text`` —
+    wrap either generation.api.generate_and_post_process or a requests.put
+    call against the REST server.
+    """
+    assert prompt_type in ("knowledge", "response")
+    prompts = read_prompts(prompt_file, prompt_type, n_prompt_examples)
+    n = 0
+    with open(sample_input_file, encoding="utf-8") as fin, \
+            open(sample_output_file, "w", encoding="utf-8") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            splits = line.split("\t")
+            topic, turns = splits[0], splits[1].split(" [SEP] ")
+            last_turn = turns[-1]
+            if prompt_type == "knowledge":
+                inputs = build_knowledge_input(prompts, topic, last_turn)
+            else:
+                knowledge = splits[2] if len(splits) > 2 else ""
+                inputs = build_response_input(prompts, topic, last_turn,
+                                              knowledge)
+            out = postprocess_generation(
+                generate_fn(inputs, out_seq_length), inputs
+            )
+            fout.write(out + "\n")
+            n += 1
+    return n
+
+
+def make_local_generate_fn(cfg, params, tokenizer) -> Callable[[str, int], str]:
+    """generate_fn backed by the in-process generation engine."""
+    from megatron_llm_tpu.generation.api import InferenceEngine
+
+    engine = InferenceEngine(cfg, params, tokenizer)
+
+    def fn(text: str, tokens_to_generate: int) -> str:
+        out = engine.generate_and_post_process(
+            prompts=[text], tokens_to_generate=tokens_to_generate,
+            top_k_sampling=1,
+        )
+        return out[0][0]
+
+    return fn
+
+
+def make_api_generate_fn(url: str) -> Callable[[str, int], str]:
+    """generate_fn backed by a running REST generation server."""
+    import requests
+
+    def fn(text: str, tokens_to_generate: int) -> str:
+        r = requests.put(
+            url, headers={"Content-Type": "application/json; charset=UTF-8"},
+            data=json.dumps({"prompts": [text],
+                             "tokens_to_generate": tokens_to_generate,
+                             "top_k": 1}),
+        )
+        return r.json()["text"][0]
+
+    return fn

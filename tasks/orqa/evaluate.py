@@ -1,0 +1,98 @@
+"""Unsupervised open-retrieval QA evaluation (NQ-style retrieval accuracy).
+
+Reference: tasks/orqa/evaluate_orqa.py + evaluate_utils.py (ORQAEvaluator):
+embed each question with the biencoder's query tower, search the evidence
+MIPS index, and report top-k retrieval accuracy = fraction of questions
+whose gold answer string appears in a top-k document.
+
+Inputs (self-contained text formats):
+  evidence: jsonl {"id": int, "text": ..., "title": ...} — the wiki split
+            (reference orqa_wiki_dataset.py reads the same fields from tsv)
+  qa file:  jsonl {"question": ..., "answers": [...]}  (NQ open format)
+  embeddings: a BlockEmbedStore pickle whose ids match evidence ids
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+import numpy as np
+
+from tasks.orqa.qa_utils import calculate_matches
+
+
+def load_evidence(path: str) -> dict:
+    docs = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                d = json.loads(line)
+                docs[int(d["id"])] = (d.get("text", ""), d.get("title", ""))
+    return docs
+
+
+def load_qa(path: str):
+    questions, answers = [], []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                d = json.loads(line)
+                questions.append(d["question"])
+                answers.append(list(d["answers"]))
+    return questions, answers
+
+
+class ORQAEvaluator:
+    def __init__(self, cfg, params, store, tokenize_fn):
+        """``params``: biencoder tree; ``store``: BlockEmbedStore over the
+        evidence; ``tokenize_fn(question) -> (tokens, pad_mask)`` at
+        retriever_seq_length."""
+        import jax
+
+        from megatron_llm_tpu.retrieval.biencoder import biencoder_embed
+        from megatron_llm_tpu.retrieval.index import MIPSIndex
+
+        self.cfg = cfg
+        self.tokenize_fn = tokenize_fn
+        tower = params.get("shared_model") or params["query_model"]
+        self._embed = jax.jit(
+            lambda tok, mask: biencoder_embed(cfg, tower, tok, mask)
+        )
+        embed_size = next(iter(store.embed_data.values())).shape[-1]
+        self.index = MIPSIndex(embed_size, store=store)
+
+    def embed_questions(self, questions: List[str], batch_size: int = 64):
+        out = []
+        for i in range(0, len(questions), batch_size):
+            toks, masks = zip(*(self.tokenize_fn(q)
+                                for q in questions[i: i + batch_size]))
+            toks, masks = np.stack(toks), np.stack(masks)
+            n = toks.shape[0]
+            if n < batch_size:  # stable shapes -> one compiled program
+                toks = np.concatenate(
+                    [toks, np.repeat(toks[-1:], batch_size - n, 0)])
+                masks = np.concatenate(
+                    [masks, np.repeat(masks[-1:], batch_size - n, 0)])
+            out.append(np.asarray(self._embed(toks, masks), np.float32)[:n])
+        return np.concatenate(out, axis=0)
+
+    def evaluate(self, qa_path: str, evidence_path: str, top_k: int = 20,
+                 match_type: str = "string") -> dict:
+        questions, answers = load_qa(qa_path)
+        docs = load_evidence(evidence_path)
+        q_embeds = self.embed_questions(questions)
+        scores, ids = self.index.search_mips_index(q_embeds, top_k)
+        closest = [(list(map(int, row_ids)), list(row_scores))
+                   for row_ids, row_scores in zip(ids, scores)]
+        stats = calculate_matches(docs, answers, closest, match_type)
+        n = len(questions)
+        top_k_eff = len(stats.top_k_hits)  # index may hold < top_k blocks
+        results = {
+            f"top{k + 1}_acc": stats.top_k_hits[k] / n * 100.0
+            for k in range(top_k_eff)
+            if (k + 1) in (1, 5, 20, 100) or k + 1 == top_k_eff
+        }
+        for name, val in sorted(results.items()):
+            print(f"  {name}: {val:.2f}")
+        return results

@@ -1,0 +1,78 @@
+"""Answer-matching utilities for open-retrieval QA.
+
+Reference: tasks/orqa/unsupervised/qa_utils.py (itself from the DPR
+codebase): unicode-normalized token matching ('string') or regex search
+('regex') of gold answers inside retrieved documents, and
+``calculate_matches`` producing top-k hit statistics. The DPR
+SimpleTokenizer is replaced by a regexp word tokenizer with identical
+casing/normalization behavior for matching purposes.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from collections import namedtuple
+from typing import Dict, List, Sequence, Tuple
+
+QAMatchStats = namedtuple("QAMatchStats", ["top_k_hits", "questions_doc_hits"])
+
+_WORD_RE = re.compile(r"[\w\d]+", re.UNICODE)
+
+
+def _normalize(text: str) -> str:
+    return unicodedata.normalize("NFD", text)
+
+
+def _words(text: str) -> List[str]:
+    return [m.group().lower() for m in _WORD_RE.finditer(_normalize(text))]
+
+
+def has_answer(answers: Sequence[str], text: str, match_type: str = "string") -> bool:
+    """Does ``text`` contain any of ``answers``? 'string' = token-subsequence
+    match, 'regex' = regex search (qa_utils.py:111-140)."""
+    if text is None:
+        return False
+    if match_type == "regex":
+        for pattern in answers:
+            try:
+                if re.compile(pattern, re.IGNORECASE | re.UNICODE).search(
+                    _normalize(text)
+                ):
+                    return True
+            except re.error:
+                continue
+        return False
+    tokens = _words(text)
+    for answer in answers:
+        ans = _words(answer)
+        if not ans:
+            continue
+        for i in range(len(tokens) - len(ans) + 1):
+            if tokens[i: i + len(ans)] == ans:
+                return True
+    return False
+
+
+def calculate_matches(
+    all_docs: Dict[object, Tuple[str, str]],   # doc_id -> (text, title)
+    answers: List[List[str]],                  # per question
+    closest_docs: List[Tuple[Sequence[object], Sequence[float]]],
+    match_type: str = "string",
+) -> QAMatchStats:
+    """Per-question hit vector over its top docs + aggregated top-k hits:
+    top_k_hits[k] = #questions whose answer appears in the top k+1 docs."""
+    n_docs = max((len(ids) for ids, _ in closest_docs), default=0)
+    top_k_hits = [0] * n_docs
+    questions_doc_hits = []
+    for ans, (doc_ids, _scores) in zip(answers, closest_docs):
+        hits = [
+            has_answer(ans, all_docs.get(doc_id, (None, None))[0], match_type)
+            for doc_id in doc_ids
+        ]
+        questions_doc_hits.append(hits)
+        first = next((i for i, h in enumerate(hits) if h), None)
+        if first is not None:
+            for k in range(first, n_docs):
+                top_k_hits[k] += 1
+    return QAMatchStats(top_k_hits, questions_doc_hits)

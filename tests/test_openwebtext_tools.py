@@ -69,6 +69,69 @@ def test_filter_ngrams(tmp_path):
     assert len(lines) == 1 and "clean document" in lines[0]
 
 
+def test_add_id(tmp_path):
+    src = tmp_path / "c.jsonl"
+    src.write_text(json.dumps({"text": "a"}) + "\n" + json.dumps({"text": "b"}) + "\n")
+    out = tmp_path / "o.jsonl"
+    r = run("add_id.py", str(src), str(out), "--id_prefix", "owt")
+    assert r.returncode == 0, r.stderr
+    docs = [json.loads(x) for x in out.read_text().splitlines()]
+    assert [d["id"] for d in docs] == ["owt-0", "owt-1"]
+
+
+def test_group_and_remove_duplicates(tmp_path):
+    pairs = tmp_path / "pairs.jsonl"
+    pairs.write_text(
+        json.dumps({"http://a": [{"http://b": 0.9}, {"http://x": 0.1}]}) + "\n"
+        + json.dumps({"http://b": [{"http://c": 0.8}]}) + "\n"
+        + json.dumps({"http://solo": []}) + "\n"
+    )
+    groups = tmp_path / "groups.jsonl"
+    r = run("group_duplicate_url.py", str(pairs), str(groups))
+    assert r.returncode == 0, r.stderr
+    gs = [json.loads(x) for x in groups.read_text().splitlines()]
+    assert gs == [["http://a", "http://b", "http://c"]]  # transitive a-b-c
+
+    corpus = tmp_path / "corpus.jsonl"
+    corpus.write_text("\n".join(
+        json.dumps({"url": u, "text": u}) for u in
+        ["http://a", "http://b", "http://c", "http://x", "http://solo"]
+    ) + "\n")
+    out = tmp_path / "dedup.jsonl"
+    r = run("remove_group_duplicates.py", str(groups), str(corpus), str(out))
+    assert r.returncode == 0, r.stderr
+    kept = [json.loads(x)["url"] for x in out.read_text().splitlines()]
+    # first group member kept, b/c removed, non-group docs kept
+    assert kept == ["http://a", "http://x", "http://solo"]
+
+
+def test_merge_jsons(tmp_path):
+    d = tmp_path / "shards"
+    d.mkdir()
+    (d / "a.json").write_text(json.dumps({"text": "1"}) + "\n")
+    (d / "b.jsonl").write_text(json.dumps({"text": "2"}) + "\n")
+    out = tmp_path / "merged.jsonl"
+    r = run("merge_jsons.py", "--json_path", str(d), "--output_file", str(out))
+    assert r.returncode == 0, r.stderr
+    assert len(out.read_text().splitlines()) == 2
+
+
+def test_cleanup_fix_dataset(tmp_path):
+    src = tmp_path / "c.jsonl"
+    long_text = "the quick brown fox and the lazy dog went to the market " * 12
+    src.write_text(
+        json.dumps({"text": "short javascript snippet"}) + "\n"
+        + json.dumps({"text": long_text + "trailing   spaces\n\n\n\nend"}) + "\n"
+    )
+    out = tmp_path / "o.jsonl"
+    r = run("cleanup_fix_dataset.py", str(src), str(out),
+            "--tasks", "remove_256_javascript,general_cleaning")
+    assert r.returncode == 0, r.stderr
+    docs = [json.loads(x) for x in out.read_text().splitlines()]
+    assert len(docs) == 1
+    assert "   " not in docs[0]["text"] and "\n\n\n" not in docs[0]["text"]
+
+
 def test_cleanup_dataset(tmp_path):
     corpus = tmp_path / "corpus.jsonl"
     corpus.write_text(

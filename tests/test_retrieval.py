@@ -1,0 +1,317 @@
+"""Biencoder / ICT / REALM-index / ORQA / MSDP stacks (VERDICT missing #5:
+reference biencoder_model.py, ict_dataset.py, realm_index.py, indexer.py,
+pretrain_ict.py, tasks/orqa, tasks/msdp)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import Config, apply_architecture
+from megatron_llm_tpu.data.ict_dataset import ICTDataset, build_blocks_mapping
+from megatron_llm_tpu.data.indexed_dataset import make_builder, make_dataset
+from megatron_llm_tpu.retrieval.biencoder import (
+    biencoder_forward,
+    ict_loss_from_batch,
+    init_biencoder_params,
+)
+from megatron_llm_tpu.retrieval.index import BlockEmbedStore, MIPSIndex
+from megatron_llm_tpu.retrieval.indexer import IndexBuilder
+
+
+def bert_cfg(shared=False, proj=0):
+    cfg = Config()
+    apply_architecture(cfg, "bert")
+    cfg.model.num_layers = 2
+    cfg.model.hidden_size = 64
+    cfg.model.num_attention_heads = 4
+    cfg.model.vocab_size = 512
+    cfg.model.max_position_embeddings = 64
+    cfg.model.bert_binary_head = False
+    cfg.data.seq_length = 32
+    cfg.retriever.retriever_seq_length = 32
+    cfg.retriever.biencoder_shared_query_context_model = shared
+    cfg.retriever.biencoder_projection_dim = proj
+    cfg.retriever.retriever_score_scaling = True
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    cfg.training.micro_batch_size = 4
+    cfg.training.global_batch_size = 4
+    cfg.training.train_iters = 4
+    cfg.finalize(n_devices=1)
+    return cfg
+
+
+@pytest.fixture
+def sentence_corpus(tmp_path):
+    """Indexed dataset where items are sentences and docs group them."""
+    prefix = str(tmp_path / "sents_text_document")
+    rng = np.random.RandomState(0)
+    builder = make_builder(prefix + ".bin", vocab_size=500)
+    for _doc in range(8):
+        for _sent in range(rng.randint(2, 6)):
+            builder.add_item(rng.randint(5, 500, size=rng.randint(4, 12)))
+        builder.end_document()
+    builder.finalize(prefix + ".idx")
+    return prefix
+
+
+def test_blocks_mapping(sentence_corpus):
+    ds = make_dataset(sentence_corpus)
+    mapping = build_blocks_mapping(ds.sizes, ds.doc_idx, max_seq_length=24)
+    assert len(mapping) > 0
+    for start, end, doc, _bid in mapping:
+        assert ds.doc_idx[doc] <= start < end <= ds.doc_idx[doc + 1]
+        # a multi-sentence block fits the budget (single long sentences may
+        # overflow and get truncated downstream, like the reference)
+        if end - start > 1:
+            assert ds.sizes[start:end].sum() <= 24
+    # every multi-sentence doc is covered
+    covered = {int(d) for _s, _e, d, _b in mapping}
+    multi = {d for d in range(len(ds.doc_idx) - 1)
+             if ds.doc_idx[d + 1] - ds.doc_idx[d] >= 2}
+    assert multi <= covered
+
+
+def test_ict_dataset_samples(sentence_corpus):
+    ds = make_dataset(sentence_corpus)
+    ict = ICTDataset(ds, None, max_seq_length=32, query_in_block_prob=0.0,
+                     seed=3, use_titles=False, cls_id=1, sep_id=2, pad_id=0)
+    s = ict[0]
+    assert s["query_tokens"].shape == (32,) and s["context_tokens"].shape == (32,)
+    assert s["query_tokens"][0] == 1  # CLS
+    # query_in_block_prob=0: the query sentence is REMOVED from the context
+    q_body = [t for t in s["query_tokens"] if t > 2]
+    c_body = [t for t in s["context_tokens"] if t > 2]
+    qs = " ".join(map(str, q_body))
+    cs = " ".join(map(str, c_body))
+    assert qs not in cs or len(q_body) == 0
+
+
+def test_ict_loss_and_grads():
+    cfg = bert_cfg(proj=16)
+    params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+    assert "query_model" in params and "context_model" in params
+    rng = np.random.RandomState(0)
+    batch = {
+        "query_tokens": rng.randint(3, 512, (4, 32)),
+        "query_pad_mask": np.ones((4, 32), np.int64),
+        "context_tokens": rng.randint(3, 512, (4, 32)),
+        "context_pad_mask": np.ones((4, 32), np.int64),
+    }
+    q, c = biencoder_forward(cfg, params, batch)
+    assert q.shape == (4, 16) and c.shape == (4, 16)
+
+    (loss, metrics), grads = jax.value_and_grad(
+        lambda p: ict_loss_from_batch(cfg, p, batch), has_aux=True
+    )(params)
+    assert np.isfinite(float(loss))
+    assert "top1_acc" in metrics
+    gnorm = sum(float(np.abs(g).sum()) for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0
+
+
+def test_ict_shared_tower():
+    cfg = bert_cfg(shared=True)
+    params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+    assert set(params) == {"shared_model"}
+    batch = {
+        "query_tokens": np.full((2, 32), 5), "query_pad_mask": np.ones((2, 32)),
+        "context_tokens": np.full((2, 32), 5), "context_pad_mask": np.ones((2, 32)),
+    }
+    q, c = biencoder_forward(cfg, params, batch)
+    np.testing.assert_allclose(q, c, atol=1e-6)  # same tower, same input
+
+
+def test_bert_load_warm_start(tmp_path):
+    """--bert_load warm-starts the towers from a BERT checkpoint
+    (init_state_dict_from_bert analog)."""
+    import orbax.checkpoint as ocp
+
+    from megatron_llm_tpu.models import init_model_params
+
+    cfg = bert_cfg(proj=8)
+    bert_params = init_model_params(cfg, jax.random.PRNGKey(42))
+    ckpt = tmp_path / "bert" / "release" / "params"
+    ocp.StandardCheckpointer().save(str(ckpt), bert_params)
+    (tmp_path / "bert" / "latest_checkpointed_iteration.txt").write_text(
+        "release")
+
+    cfg.retriever.bert_load = str(tmp_path / "bert")
+    params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+    for tower in ("query_model", "context_model"):
+        np.testing.assert_allclose(
+            params[tower]["embedding"]["word_embeddings"],
+            bert_params["embedding"]["word_embeddings"])
+        assert "projection" in params[tower]
+    # projections are fresh (not shared between towers)
+    assert not np.allclose(params["query_model"]["projection"]["kernel"],
+                           params["context_model"]["projection"]["kernel"])
+
+
+def test_mips_index_and_store(tmp_path):
+    rng = np.random.RandomState(1)
+    embeds = rng.randn(50, 8).astype(np.float32)
+    store = BlockEmbedStore(str(tmp_path / "emb.pkl"))
+    store.add_block_data(np.arange(50), embeds)
+    store.save()
+    store2 = BlockEmbedStore(str(tmp_path / "emb.pkl"), load_from_path=True)
+    assert len(store2) == 50
+
+    index = MIPSIndex(8, store=store2, use_device=False)
+    q = rng.randn(3, 8).astype(np.float32)
+    scores, ids = index.search_mips_index(q, top_k=5)
+    # the store keeps fp16 embeddings; brute-force against the same rounding
+    brute = q @ embeds.astype(np.float16).astype(np.float32).T
+    expect = np.argsort(-brute, axis=-1)[:, :5]
+    np.testing.assert_array_equal(ids, expect)
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(brute, expect, -1), rtol=1e-5)
+
+    # device path agrees with numpy path
+    index_dev = MIPSIndex(8, store=store2, use_device=True)
+    s2, ids2 = index_dev.search_mips_index(q, top_k=5)
+    np.testing.assert_array_equal(ids2, expect)
+
+
+def test_store_shard_merge(tmp_path):
+    path = str(tmp_path / "emb.pkl")
+    for rank in range(2):
+        shard = BlockEmbedStore(path, rank=rank)
+        shard.add_block_data([rank * 10, rank * 10 + 1],
+                             np.ones((2, 4)) * rank)
+        shard.save_shard()
+    merged = BlockEmbedStore(path)
+    merged.merge_shards_and_save()
+    final = BlockEmbedStore(path, load_from_path=True)
+    assert sorted(final.embed_data) == [0, 1, 10, 11]
+
+
+def test_index_builder(sentence_corpus, tmp_path):
+    cfg = bert_cfg()
+    cfg.retriever.embedding_path = str(tmp_path / "blocks.pkl")
+    cfg.retriever.indexer_batch_size = 4
+    params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+    ds = make_dataset(sentence_corpus)
+    ict = ICTDataset(ds, None, max_seq_length=32, use_titles=False,
+                     cls_id=1, sep_id=2, pad_id=0)
+    store = IndexBuilder(cfg, params, ict).build_and_save_index(log=lambda *_: None)
+    assert len(store) == len(ict.mapping)
+    dim = next(iter(store.embed_data.values())).shape[-1]
+    assert dim == cfg.model.hidden_size
+
+
+def test_orqa_evaluator(sentence_corpus, tmp_path):
+    """End to end: evidence docs -> index -> question retrieval accuracy."""
+    from tasks.orqa.evaluate import ORQAEvaluator
+    from tasks.orqa.qa_utils import calculate_matches, has_answer
+
+    assert has_answer(["forty two"], "the answer is Forty-Two indeed")
+    assert not has_answer(["nothing"], "the answer is forty two")
+    assert has_answer([r"forty.?two"], "it is forty-two", match_type="regex")
+
+    stats = calculate_matches(
+        {0: ("paris is the capital", ""), 1: ("berlin", "")},
+        [["paris"]], [([1, 0], [0.9, 0.8])],
+    )
+    assert stats.top_k_hits == [0, 1]  # found at rank 2
+
+    cfg = bert_cfg()
+    params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+    store = BlockEmbedStore()
+    rng = np.random.RandomState(0)
+    store.add_block_data(np.arange(4), rng.randn(4, cfg.model.hidden_size))
+
+    evidence = tmp_path / "evidence.jsonl"
+    evidence.write_text("\n".join(
+        json.dumps({"id": i, "text": f"document {i} mentions answer{i}",
+                    "title": f"t{i}"}) for i in range(4)
+    ) + "\n")
+    qa = tmp_path / "qa.jsonl"
+    qa.write_text(json.dumps(
+        {"question": "which doc mentions answer2?", "answers": ["answer2"]}
+    ) + "\n")
+
+    def tokenize(q):
+        toks = np.zeros((32,), np.int64)
+        ids = [1] + [3 + (hash(w) % 500) for w in q.split()][:30] + [2]
+        toks[: len(ids)] = ids
+        return toks, (toks != 0).astype(np.int64)
+
+    ev = ORQAEvaluator(cfg, params, store, tokenize)
+    results = ev.evaluate(str(qa), str(evidence), top_k=4)
+    assert "top4_acc" in results and 0.0 <= results["top4_acc"] <= 100.0
+
+
+def test_msdp_pipeline(tmp_path):
+    from tasks.msdp.evaluate import evaluate_f1
+    from tasks.msdp.metrics import F1Metric
+    from tasks.msdp.preprocessing import process_dialogs
+    from tasks.msdp.prompt import generate_samples
+
+    p, r, f1 = F1Metric.compute_each_pair("the cat sat", "the cat stood")
+    assert 0 < f1 < 1
+    assert F1Metric.compute_each_pair("", "ref") == (0.0, 0.0, 0.0)
+
+    dialogs = tmp_path / "dialogs.jsonl"
+    dialogs.write_text(json.dumps({
+        "topic": "cats",
+        "turns": ["do cats purr?", "yes cats purr when happy",
+                  "why?", "vibration of the larynx"],
+        "knowledge": ["cats purr via larynx", "larynx vibrates"],
+    }) + "\n")
+    test_file, ref_file = tmp_path / "test.txt", tmp_path / "refs.txt"
+    n = process_dialogs(str(dialogs), str(test_file), str(ref_file))
+    assert n == 2
+    assert test_file.read_text().splitlines()[1].count("\t") == 2
+
+    # knowledge stage with a fake LM
+    kprompts = tmp_path / "kprompts.jsonl"
+    kprompts.write_text(json.dumps(
+        {"cats do cats purr?": ["( example ) cats => cats purr"]}) + "\n")
+    out = tmp_path / "gen.txt"
+    n = generate_samples(
+        lambda text, _n: text + " generated knowledge\nrest",
+        str(kprompts), "knowledge", str(test_file), str(out))
+    assert n == 2
+    assert all(line == "generated knowledge"
+               for line in out.read_text().splitlines())
+
+    # response stage + F1 eval
+    rprompt = tmp_path / "rprompt.txt"
+    rprompt.write_text("Example response prompt\n")
+    out2 = tmp_path / "resp.txt"
+    generate_samples(
+        lambda text, _n: text + " yes cats purr when happy\nmore",
+        str(rprompt), "response", str(test_file), str(out2))
+    _p, _r, f1 = evaluate_f1(str(out2), str(ref_file))
+    assert f1 > 0.3
+
+
+def test_pretrain_ict_end_to_end(sentence_corpus, tmp_path):
+    """The pretrain_ict.py entry trains on the CPU mesh and reports
+    retrieval accuracy metrics."""
+    import pretrain_ict
+
+    result = pretrain_ict.main([
+        "--data_path", sentence_corpus,
+        "--tokenizer_type", "NullTokenizer",
+        "--vocab_size", "512",
+        "--num_layers", "2", "--hidden_size", "64",
+        "--num_attention_heads", "4",
+        "--max_position_embeddings", "64",
+        "--retriever_seq_length", "32",
+        "--seq_length", "32",
+        "--params_dtype", "float32",
+        "--use_flash_attn", "false",
+        "--micro_batch_size", "4", "--global_batch_size", "4",
+        "--data_parallel_size", "1",
+        "--train_iters", "3", "--eval_iters", "1", "--eval_interval", "100",
+        "--lr", "1e-3",
+        "--biencoder_projection_dim", "16",
+    ])
+    assert result["iteration"] == 3
+    assert np.isfinite(float(result["last_metrics"]["lm loss"]))
+    # top-k retrieval accuracies flow through the eval path; the metric
+    # computation itself is asserted in test_ict_loss_and_grads

@@ -1,0 +1,42 @@
+"""Add a unique id to every json document in a jsonl corpus.
+
+Reference: tools/openwebtext/add_id.py (sequential ids with an optional
+prefix, written back as jsonl).
+
+    python add_id.py corpus.jsonl out.jsonl --id_prefix owt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--id_prefix", default="",
+                    help="prepended to the running index, e.g. 'owt' -> owt-17")
+    ap.add_argument("--id_field", default="id")
+    args = ap.parse_args()
+
+    n = 0
+    with open(args.input, encoding="utf-8") as fin, \
+            open(args.output, "w", encoding="utf-8") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            doc[args.id_field] = (
+                f"{args.id_prefix}-{n}" if args.id_prefix else str(n)
+            )
+            fout.write(json.dumps(doc, ensure_ascii=False) + "\n")
+            n += 1
+    print(f"wrote {n} docs with ids to {args.output}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

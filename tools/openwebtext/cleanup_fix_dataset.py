@@ -1,0 +1,125 @@
+"""Task-selectable document filtering and text fixing for jsonl corpora.
+
+Reference: tools/openwebtext/cleanup_fix_dataset.py. Tasks (comma-separated
+via --tasks, applied in order, first removal wins):
+  remove_512              drop docs under 512 characters
+  remove_256_javascript   drop docs under 256 chars mentioning javascript
+  remove_512_non_english  drop short docs that don't look like English
+  fix_text                mojibake/unicode fixing (ftfy when installed,
+                          otherwise a conservative builtin normalization)
+  general_cleaning        collapse runs of spaces/newlines
+
+Language detection uses langdetect when installed; otherwise a stopword
+heuristic (this image has neither ftfy nor langdetect baked in, and the
+cleaning must still run — both dependencies are optional).
+
+    python cleanup_fix_dataset.py in.jsonl out.jsonl \
+        --tasks remove_512,fix_text,general_cleaning
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import unicodedata
+
+try:
+    import ftfy
+except ImportError:
+    ftfy = None
+
+try:
+    from langdetect import detect as _detect_lang
+except ImportError:
+    _detect_lang = None
+
+_EN_STOPWORDS = frozenset(
+    "the of and to in a is that it for on was with as at by be this have "
+    "from or are an they which you had not but his her".split()
+)
+
+_MOJIBAKE = {
+    "â": "'", "â": "'",
+    "â": '"', "â": '"',
+    "â": "-", "â": "-",
+    "Â ": " ",
+}
+
+
+def looks_english(text: str) -> bool:
+    if _detect_lang is not None:
+        try:
+            return _detect_lang(text) == "en"
+        except Exception:
+            return False
+    words = re.findall(r"[a-z']+", text.lower())
+    if not words:
+        return False
+    hits = sum(w in _EN_STOPWORDS for w in words)
+    return hits / len(words) >= 0.08
+
+
+def fix_text(text: str) -> str:
+    if ftfy is not None:
+        return ftfy.fix_text(text)
+    for bad, good in _MOJIBAKE.items():
+        text = text.replace(bad, good)
+    return unicodedata.normalize("NFC", text)
+
+
+def general_cleaning(text: str) -> str:
+    text = re.sub(r"[ \t]+", " ", text)
+    text = re.sub(r"\n{3,}", "\n\n", text)
+    return text.strip()
+
+
+def process(doc: dict, tasks) -> tuple:
+    """Returns (doc_or_none, removal_reason_or_none)."""
+    text = doc.get("text", "")
+    if "remove_512" in tasks and len(text) < 512:
+        return None, "remove_512"
+    if ("remove_256_javascript" in tasks and len(text) < 256
+            and "javascript" in text.lower()):
+        return None, "remove_256_javascript"
+    if ("remove_512_non_english" in tasks and len(text) < 512
+            and not looks_english(text)):
+        return None, "remove_512_non_english"
+    if "fix_text" in tasks or "ftfy_fix_text" in tasks:
+        text = fix_text(text)
+    if "general_cleaning" in tasks:
+        text = general_cleaning(text)
+    out = dict(doc)
+    out["text"] = text
+    return out, None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--tasks", default="fix_text,general_cleaning",
+                    help="comma-separated, see module docstring")
+    args = ap.parse_args()
+    tasks = set(args.tasks.split(","))
+
+    stats: dict = {}
+    kept = 0
+    with open(args.input, encoding="utf-8") as fin, \
+            open(args.output, "w", encoding="utf-8") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            doc, reason = process(json.loads(line), tasks)
+            if doc is None:
+                stats[reason] = stats.get(reason, 0) + 1
+                continue
+            fout.write(json.dumps(doc, ensure_ascii=False) + "\n")
+            kept += 1
+    print(f"kept {kept}; removed {stats}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

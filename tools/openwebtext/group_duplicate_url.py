@@ -1,0 +1,70 @@
+"""Union similar-url records into connected duplicate groups.
+
+Reference: tools/openwebtext/group_duplicate_url.py. Input is jsonl where
+each line maps a url to its scored neighbors:
+    {"http://a": [{"http://b": 0.81}, {"http://c": 0.42}]}
+Pairs at or above the similarity threshold are merged transitively
+(union-find); output is one json list of urls per duplicate group.
+
+    python group_duplicate_url.py pairs.jsonl groups.jsonl [--threshold 0.7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+class UnionFind:
+    def __init__(self):
+        self.parent: dict = {}
+
+    def find(self, x):
+        self.parent.setdefault(x, x)
+        while self.parent[x] != x:
+            self.parent[x] = self.parent[self.parent[x]]
+            x = self.parent[x]
+        return x
+
+    def union(self, a, b):
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[rb] = ra
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--threshold", type=float, default=0.7)
+    args = ap.parse_args()
+
+    uf = UnionFind()
+    with open(args.input, encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            record = json.loads(line)
+            for main_url, neighbors in record.items():
+                uf.find(main_url)
+                for entry in neighbors:
+                    for other_url, score in entry.items():
+                        if score >= args.threshold:
+                            uf.union(main_url, other_url)
+
+    groups: dict = {}
+    for url in list(uf.parent):
+        groups.setdefault(uf.find(url), []).append(url)
+
+    n = 0
+    with open(args.output, "w", encoding="utf-8") as out:
+        for members in groups.values():
+            if len(members) > 1:
+                out.write(json.dumps(sorted(members)) + "\n")
+                n += 1
+    print(f"{n} duplicate url groups", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Merge every .json/.jsonl file in a directory into one jsonl corpus.
+
+Reference: tools/openwebtext/merge_jsons.py.
+
+    python merge_jsons.py --json_path shards/ --output_file merged.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json_path", default=".")
+    ap.add_argument("--output_file", default="merged_output.jsonl")
+    args = ap.parse_args()
+
+    files = sorted(
+        glob.glob(os.path.join(args.json_path, "*.json"))
+        + glob.glob(os.path.join(args.json_path, "*.jsonl"))
+    )
+    out_abs = os.path.abspath(args.output_file)
+    docs = 0
+    with open(args.output_file, "w", encoding="utf-8") as out:
+        for fname in files:
+            if os.path.abspath(fname) == out_abs:
+                continue
+            with open(fname, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    json.loads(line)  # validate before passing through
+                    out.write(line + "\n")
+                    docs += 1
+    print(f"merged {len(files)} files, {docs} docs -> {args.output_file}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Drop all-but-one document of every duplicate group from a jsonl corpus.
+
+Reference: tools/openwebtext/remove_group_duplicates.py. Groups come from
+group_duplicate_url.py (json list of urls per line) or find_duplicates.py
+(tab-separated ids per line); the first member of each group is kept.
+
+    python remove_group_duplicates.py groups.jsonl corpus.jsonl out.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_removals(path: str) -> set:
+    remove = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("["):
+                members = json.loads(line)
+            elif line.startswith("{"):
+                # reference url-file format {key: [urls...]}: the VALUES are
+                # the group and its first url is kept (the reference's
+                # `for i in range(1, len(this_urls))` removal loop)
+                members = [u for v in json.loads(line).values() for u in v]
+            else:
+                members = line.split("\t")
+            remove.update(members[1:])  # keep the first member
+    return remove
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("groups")
+    ap.add_argument("corpus")
+    ap.add_argument("output")
+    ap.add_argument("--key", default=None,
+                    help="doc field matching the group ids (default: url, "
+                         "then id)")
+    args = ap.parse_args()
+
+    remove = load_removals(args.groups)
+    print(f"removing {len(remove)} docs", file=sys.stderr)
+
+    kept = removed = 0
+    with open(args.corpus, encoding="utf-8") as fin, \
+            open(args.output, "w", encoding="utf-8") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            key = doc.get(args.key) if args.key else (
+                doc.get("url") or doc.get("id")
+            )
+            if key is not None and str(key) in remove:
+                removed += 1
+                continue
+            fout.write(json.dumps(doc, ensure_ascii=False) + "\n")
+            kept += 1
+    print(f"kept {kept}, removed {removed}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
