@@ -1,0 +1,13 @@
+#!/bin/bash
+# Unsupervised open-retrieval QA: top-k retrieval accuracy on NQ-open style
+# data (reference tasks/orqa/evaluate_orqa.py analog). Build the evidence
+# embeddings first with retrieval.indexer over the trained biencoder.
+python tasks/main.py --task ORQA \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --tokenizer_type HFTokenizer --tokenizer_model bert-base-uncased \
+    --retriever_seq_length 64 \
+    --load ${ICT_CKPT:-ckpts/ict} \
+    --embedding_path ${EMBEDS:-ckpts/ict/evidence_embeddings.pkl} \
+    --qa_data ${QA:-/data/nq_open_dev.jsonl} \
+    --evidence_data ${EVIDENCE:-/data/wiki_evidence.jsonl} \
+    --report_topk 20

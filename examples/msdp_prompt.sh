@@ -1,0 +1,24 @@
+#!/bin/bash
+# Multi-stage dialog prompting (reference tasks/msdp): stage 1 generates
+# knowledge, stage 2 the response, then F1 evaluation against references.
+CKPT=${CKPT:-ckpts/llama2-7b}
+MODEL_ARGS="--model_name llama2 --num_layers 32 --hidden_size 4096 \
+    --num_attention_heads 32 --tokenizer_type SentencePieceTokenizer \
+    --tokenizer_model ${TOKENIZER:-/data/tokenizer.model} --load ${CKPT}"
+mkdir -p out
+
+python tasks/main.py --task MSDP-PROMPT ${MODEL_ARGS} \
+    --prompt_type knowledge --prompt_file ${KPROMPTS:-/data/k_prompts.jsonl} \
+    --sample_input_file ${TEST:-/data/wow_test.txt} \
+    --sample_output_file out/knowledge.txt --out_seq_length 64
+
+# stage 2 conditions on stage 1's generated knowledge (drop --knowledge_file
+# for the oracle-knowledge evaluation mode)
+python tasks/main.py --task MSDP-PROMPT ${MODEL_ARGS} \
+    --prompt_type response --prompt_file ${RPROMPT:-/data/r_prompt.txt} \
+    --sample_input_file ${TEST:-/data/wow_test.txt} \
+    --knowledge_file out/knowledge.txt \
+    --sample_output_file out/response.txt --out_seq_length 64
+
+python tasks/main.py --task MSDP-EVAL-F1 \
+    --guess_file out/response.txt --answer_file ${REFS:-/data/wow_refs.txt}

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Biencoder ICT pretraining (reference pretrain_ict.py analog).
+# DATA must be a sentence-split indexed corpus (preprocess_data.py
+# --split_sentences); TITLES the matching titles dataset.
+python pretrain_ict.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --data_path ${DATA:-/data/wiki_sent_text_document} \
+    --titles_data_path ${TITLES:-/data/wiki_titles_text_document} \
+    --tokenizer_type HFTokenizer --tokenizer_model bert-base-uncased \
+    --retriever_seq_length 256 --query_in_block_prob 0.1 \
+    --biencoder_projection_dim 128 --retriever_score_scaling true \
+    --bert_load ${BERT_CKPT:-ckpts/bert} \
+    --micro_batch_size 32 --global_batch_size 128 \
+    --train_iters 100000 --lr 1e-4 --lr_warmup_fraction 0.01 \
+    --save ckpts/ict --save_interval 5000 --log_interval 100

@@ -211,6 +211,11 @@ def attention_sublayer(
             dropout_key=dropout_key,
         )
 
+    # named so remat policies can save the attention output and skip
+    # recomputing the (custom-vjp) flash kernel forward in the backward pass
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx = checkpoint_name(ctx, "attn_out")
     out = _linear(p["dense"], ctx.reshape(b, s, n * d))
     return out, new_cache
 
@@ -358,6 +363,12 @@ def _remat_policy(name: str):
         # 'selective' ~ reference selective recompute: save everything except
         # the attention internals (we approximate with save-only-dot-products).
         "selective": jax.checkpoint_policies.dots_saveable,
+        # dots + the named attention outputs: the backward reuses the saved
+        # flash result instead of re-running the kernel forward
+        "save_dots_and_attn": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        ),
     }
     return policies.get(name, jax.checkpoint_policies.checkpoint_dots)
 

@@ -21,11 +21,33 @@ from megatron_llm_tpu.config import parse_args
 def get_tasks_args(parser):
     group = parser.add_argument_group("tasks")
     group.add_argument("--task", type=str, required=True,
-                       help="MNLI|QQP|RACE|WIKITEXT103|LAMBADA")
+                       help="MNLI|QQP|RACE|WIKITEXT103|LAMBADA|ORQA|"
+                            "MSDP-PROMPT|MSDP-EVAL-F1")
     group.add_argument("--train_data", type=str, default=None)
     group.add_argument("--valid_data", type=str, default=None)
     group.add_argument("--epochs", type=int, default=3)
     group.add_argument("--strict_lambada", action="store_true")
+    # ORQA (reference tasks/orqa/evaluate_orqa.py surface)
+    group.add_argument("--qa_data", type=str, default=None,
+                       help="jsonl {question, answers} for ORQA")
+    group.add_argument("--evidence_data", type=str, default=None,
+                       help="jsonl {id, text, title} evidence for ORQA")
+    group.add_argument("--report_topk", type=int, default=20)
+    group.add_argument("--match", type=str, default="string",
+                       choices=["string", "regex"])
+    # MSDP (reference tasks/msdp/main.py surface)
+    group.add_argument("--prompt_file", type=str, default=None)
+    group.add_argument("--prompt_type", type=str, default="knowledge",
+                       choices=["knowledge", "response"])
+    group.add_argument("--sample_input_file", type=str, default=None)
+    group.add_argument("--sample_output_file", type=str, default=None)
+    group.add_argument("--num_prompt_examples", type=int, default=10)
+    group.add_argument("--out_seq_length", type=int, default=64)
+    group.add_argument("--knowledge_file", type=str, default=None,
+                       help="stage-1 output to condition stage 2 on "
+                            "(omit for oracle-knowledge evaluation)")
+    group.add_argument("--guess_file", type=str, default=None)
+    group.add_argument("--answer_file", type=str, default=None)
     return parser
 
 
@@ -141,12 +163,97 @@ def run_race(cfg, extra):
     )
 
 
+def run_orqa(cfg, extra):
+    """Unsupervised NQ-style retrieval accuracy (tasks/orqa/evaluate_orqa.py)."""
+    import numpy as np
+
+    from megatron_llm_tpu.core.parallel_state import (
+        build_mesh_from_config,
+        global_mesh,
+    )
+    from megatron_llm_tpu.retrieval.biencoder import init_biencoder_params
+    from megatron_llm_tpu.retrieval.index import BlockEmbedStore
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.orqa.evaluate import ORQAEvaluator
+
+    tokenizer = build_tokenizer(cfg)
+    ids = _special_ids(tokenizer, cfg.model.vocab_size)
+    seq = cfg.retriever.retriever_seq_length
+
+    def tokenize(question):
+        body = tokenizer.tokenize(question)[: seq - 2]
+        toks = np.zeros((seq,), np.int64)
+        row = [ids["cls_id"], *body, ids["sep_id"]]
+        toks[: len(row)] = row
+        mask = (np.arange(seq) < len(row)).astype(np.int64)
+        return toks, mask
+
+    for flag, value in (("qa_data", extra.qa_data),
+                        ("evidence_data", extra.evidence_data)):
+        if not value:
+            raise SystemExit(f"--task ORQA requires --{flag}")
+    if not cfg.retriever.embedding_path:
+        raise SystemExit("--task ORQA requires --embedding_path "
+                         "(a BlockEmbedStore built by retrieval.indexer)")
+
+    mesh = build_mesh_from_config(cfg)
+    with global_mesh(mesh):
+        params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
+        if cfg.checkpoint.load:
+            from megatron_llm_tpu.checkpointing import load_checkpoint
+            from megatron_llm_tpu.parallel.tp import param_shardings
+
+            shard = param_shardings(mesh, params)
+            params, *_ = load_checkpoint(
+                cfg, cfg.checkpoint.load, params, None, shard, None
+            )
+        store = BlockEmbedStore(cfg.retriever.embedding_path,
+                                load_from_path=True)
+        ev = ORQAEvaluator(cfg, params, store, tokenize)
+        return ev.evaluate(extra.qa_data, extra.evidence_data,
+                           top_k=extra.report_topk, match_type=extra.match)
+
+
+def run_msdp_prompt(cfg, extra):
+    """Knowledge/response generation stage (tasks/msdp/prompt.py)."""
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.msdp.prompt import generate_samples, make_local_generate_fn
+
+    for flag in ("prompt_file", "sample_input_file", "sample_output_file"):
+        if not getattr(extra, flag):
+            raise SystemExit(f"--task MSDP-PROMPT requires --{flag}")
+    out_dir = os.path.dirname(os.path.abspath(extra.sample_output_file))
+    os.makedirs(out_dir, exist_ok=True)
+
+    tokenizer = build_tokenizer(cfg)
+    mesh, params = _load_params_for_eval(cfg)
+    from megatron_llm_tpu.core.parallel_state import global_mesh
+
+    with global_mesh(mesh):
+        fn = make_local_generate_fn(cfg, params, tokenizer)
+        n = generate_samples(
+            fn, extra.prompt_file, extra.prompt_type,
+            extra.sample_input_file, extra.sample_output_file,
+            n_prompt_examples=extra.num_prompt_examples,
+            out_seq_length=extra.out_seq_length,
+            knowledge_file=extra.knowledge_file,
+        )
+    print(f"generated {n} samples -> {extra.sample_output_file}")
+    return n
+
+
 def main():
     import argparse
 
     # pull the task args off argv, pass the rest to the standard parser
     task_parser = get_tasks_args(argparse.ArgumentParser(allow_abbrev=False))
     extra, rest = task_parser.parse_known_args()
+
+    if extra.task == "MSDP-EVAL-F1":  # pure text metric, no model/config
+        from tasks.msdp.evaluate import evaluate_f1
+
+        return evaluate_f1(extra.guess_file, extra.answer_file)
+
     cfg = parse_args(rest, n_devices=len(jax.devices()))
 
     if extra.task in ("WIKITEXT103", "LAMBADA"):
@@ -155,6 +262,10 @@ def main():
         return run_glue(cfg, extra)
     if extra.task == "RACE":
         return run_race(cfg, extra)
+    if extra.task == "ORQA":
+        return run_orqa(cfg, extra)
+    if extra.task == "MSDP-PROMPT":
+        return run_msdp_prompt(cfg, extra)
     raise ValueError(f"unknown task {extra.task}")
 
 

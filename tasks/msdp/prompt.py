@@ -75,15 +75,26 @@ def generate_samples(
     sample_output_file: str,
     n_prompt_examples: int = 10,
     out_seq_length: int = 64,
+    knowledge_file: Optional[str] = None,
 ) -> int:
     """Drive the stage over a test file; returns the number of samples.
 
     ``generate_fn(input_text, tokens_to_generate) -> full output text`` —
     wrap either generation.api.generate_and_post_process or a requests.put
     call against the REST server.
+
+    For the response stage, ``knowledge_file`` (line-aligned with the test
+    file — stage 1's output) replaces the gold knowledge in column 3, making
+    the two-stage pipeline end-to-end; without it the response conditions on
+    the gold knowledge (the reference's oracle-knowledge evaluation mode).
     """
     assert prompt_type in ("knowledge", "response")
     prompts = read_prompts(prompt_file, prompt_type, n_prompt_examples)
+    generated_knowledge = None
+    if knowledge_file is not None:
+        assert prompt_type == "response", "knowledge_file is a stage-2 input"
+        with open(knowledge_file, encoding="utf-8") as f:
+            generated_knowledge = [x.strip() for x in f]
     n = 0
     with open(sample_input_file, encoding="utf-8") as fin, \
             open(sample_output_file, "w", encoding="utf-8") as fout:
@@ -97,7 +108,11 @@ def generate_samples(
             if prompt_type == "knowledge":
                 inputs = build_knowledge_input(prompts, topic, last_turn)
             else:
-                knowledge = splits[2] if len(splits) > 2 else ""
+                if generated_knowledge is not None:
+                    knowledge = (generated_knowledge[n]
+                                 if n < len(generated_knowledge) else "")
+                else:
+                    knowledge = splits[2] if len(splits) > 2 else ""
                 inputs = build_response_input(prompts, topic, last_turn,
                                               knowledge)
             out = postprocess_generation(

@@ -278,13 +278,17 @@ def test_msdp_pipeline(tmp_path):
     assert all(line == "generated knowledge"
                for line in out.read_text().splitlines())
 
-    # response stage + F1 eval
+    # response stage + F1 eval, conditioned on stage 1's generated knowledge
     rprompt = tmp_path / "rprompt.txt"
     rprompt.write_text("Example response prompt\n")
     out2 = tmp_path / "resp.txt"
+    seen_inputs = []
     generate_samples(
-        lambda text, _n: text + " yes cats purr when happy\nmore",
-        str(rprompt), "response", str(test_file), str(out2))
+        lambda text, _n: (seen_inputs.append(text)
+                          or text + " yes cats purr when happy\nmore"),
+        str(rprompt), "response", str(test_file), str(out2),
+        knowledge_file=str(out))
+    assert all("generated knowledge" in t for t in seen_inputs)
     _p, _r, f1 = evaluate_f1(str(out2), str(ref_file))
     assert f1 > 0.3
 

@@ -209,3 +209,23 @@ def test_multiple_choice_forward_shapes():
     pad = jnp.ones((2, 4, 16))
     scores = multiple_choice_forward(cfg, params, tokens, pad)
     assert scores.shape == (2, 4)
+
+
+def test_msdp_eval_dispatch(tmp_path):
+    """tasks/main.py MSDP-EVAL-F1 path (no model needed)."""
+    import subprocess
+
+    guess = tmp_path / "g.txt"
+    ref = tmp_path / "r.txt"
+    guess.write_text("the cat sat on the mat\n")
+    ref.write_text("a cat sat on a mat\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tasks", "main.py"),
+         "--task", "MSDP-EVAL-F1",
+         "--guess_file", str(guess), "--answer_file", str(ref)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    assert "F1:" in r.stdout
