@@ -22,7 +22,7 @@ def get_tasks_args(parser):
     group = parser.add_argument_group("tasks")
     group.add_argument("--task", type=str, required=True,
                        help="MNLI|QQP|RACE|WIKITEXT103|LAMBADA|ORQA|"
-                            "MSDP-PROMPT|MSDP-EVAL-F1")
+                            "ORQA-FINETUNE|MSDP-PROMPT|MSDP-EVAL-F1")
     group.add_argument("--train_data", type=str, default=None)
     group.add_argument("--valid_data", type=str, default=None)
     group.add_argument("--epochs", type=int, default=3)
@@ -69,8 +69,8 @@ def _special_ids(tokenizer, vocab_size: int):
     )
 
 
-def _load_params_for_eval(cfg):
-    """Initialize + load checkpoint params (zero-shot path)."""
+def _load_params_for_eval(cfg, init_fn=None):
+    """Initialize + load checkpoint params (zero-shot / eval paths)."""
     from megatron_llm_tpu.checkpointing import load_checkpoint
     from megatron_llm_tpu.core.parallel_state import (
         build_mesh_from_config,
@@ -79,9 +79,11 @@ def _load_params_for_eval(cfg):
     from megatron_llm_tpu.models import init_model_params
     from megatron_llm_tpu.parallel.tp import param_shardings
 
+    if init_fn is None:
+        init_fn = init_model_params
     mesh = build_mesh_from_config(cfg)
     with global_mesh(mesh):
-        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        params = init_fn(cfg, jax.random.PRNGKey(0))
         if cfg.checkpoint.load:
             shard = param_shardings(mesh, params)
             params, *_ = load_checkpoint(
@@ -167,10 +169,7 @@ def run_orqa(cfg, extra):
     """Unsupervised NQ-style retrieval accuracy (tasks/orqa/evaluate_orqa.py)."""
     import numpy as np
 
-    from megatron_llm_tpu.core.parallel_state import (
-        build_mesh_from_config,
-        global_mesh,
-    )
+    from megatron_llm_tpu.core.parallel_state import global_mesh
     from megatron_llm_tpu.retrieval.biencoder import init_biencoder_params
     from megatron_llm_tpu.retrieval.index import BlockEmbedStore
     from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
@@ -196,22 +195,51 @@ def run_orqa(cfg, extra):
         raise SystemExit("--task ORQA requires --embedding_path "
                          "(a BlockEmbedStore built by retrieval.indexer)")
 
-    mesh = build_mesh_from_config(cfg)
+    mesh, params = _load_params_for_eval(cfg, init_fn=init_biencoder_params)
     with global_mesh(mesh):
-        params = init_biencoder_params(cfg, jax.random.PRNGKey(0))
-        if cfg.checkpoint.load:
-            from megatron_llm_tpu.checkpointing import load_checkpoint
-            from megatron_llm_tpu.parallel.tp import param_shardings
-
-            shard = param_shardings(mesh, params)
-            params, *_ = load_checkpoint(
-                cfg, cfg.checkpoint.load, params, None, shard, None
-            )
         store = BlockEmbedStore(cfg.retriever.embedding_path,
                                 load_from_path=True)
         ev = ORQAEvaluator(cfg, params, store, tokenize)
         return ev.evaluate(extra.qa_data, extra.evidence_data,
                            top_k=extra.report_topk, match_type=extra.match)
+
+
+def run_orqa_finetune(cfg, extra):
+    """Supervised DPR-style retriever finetuning (tasks/orqa/supervised)."""
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.orqa.supervised import (
+        OpenRetrievalSupervisedDataset,
+        finetune_orqa,
+        load_dpr_json,
+    )
+
+    if not extra.train_data:
+        raise SystemExit("--task ORQA-FINETUNE requires --train_data "
+                         "(DPR-format json)")
+    tokenizer = build_tokenizer(cfg)
+    ids = _special_ids(tokenizer, cfg.model.vocab_size)
+    t = cfg.training
+    seq = cfg.retriever.retriever_seq_length
+
+    records = load_dpr_json(extra.train_data)
+    if t.train_iters is None:  # derive from --epochs like the GLUE path
+        t.train_iters = max(
+            1, extra.epochs * len(records) // t.global_batch_size
+        )
+
+    def make(path, n, recs=None):
+        if not path and recs is None:
+            return None
+        return OpenRetrievalSupervisedDataset(
+            recs if recs is not None else load_dpr_json(path),
+            tokenizer.tokenize, seq, seed=t.seed, num_samples=n, **ids,
+        )
+
+    train_ds = make(None, max(t.train_iters * t.global_batch_size, 1),
+                    recs=records)
+    valid_ds = make(extra.valid_data,
+                    max(t.eval_iters * t.global_batch_size, 1))
+    return finetune_orqa(cfg, train_ds, valid_ds)
 
 
 def run_msdp_prompt(cfg, extra):
@@ -252,6 +280,9 @@ def main():
     if extra.task == "MSDP-EVAL-F1":  # pure text metric, no model/config
         from tasks.msdp.evaluate import evaluate_f1
 
+        if not extra.guess_file or not extra.answer_file:
+            raise SystemExit(
+                "--task MSDP-EVAL-F1 requires --guess_file and --answer_file")
         return evaluate_f1(extra.guess_file, extra.answer_file)
 
     cfg = parse_args(rest, n_devices=len(jax.devices()))
@@ -264,6 +295,8 @@ def main():
         return run_race(cfg, extra)
     if extra.task == "ORQA":
         return run_orqa(cfg, extra)
+    if extra.task == "ORQA-FINETUNE":
+        return run_orqa_finetune(cfg, extra)
     if extra.task == "MSDP-PROMPT":
         return run_msdp_prompt(cfg, extra)
     raise ValueError(f"unknown task {extra.task}")

@@ -109,8 +109,12 @@ def generate_samples(
                 inputs = build_knowledge_input(prompts, topic, last_turn)
             else:
                 if generated_knowledge is not None:
-                    knowledge = (generated_knowledge[n]
-                                 if n < len(generated_knowledge) else "")
+                    assert n < len(generated_knowledge), (
+                        f"knowledge_file has {len(generated_knowledge)} lines "
+                        f"but the test file has more samples (at {n}); the "
+                        "two must be line-aligned (same stage-1 input)"
+                    )
+                    knowledge = generated_knowledge[n]
                 else:
                     knowledge = splits[2] if len(splits) > 2 else ""
                 inputs = build_response_input(prompts, topic, last_turn,

@@ -1,0 +1,147 @@
+"""Supervised open-retrieval QA finetuning (DPR-style).
+
+Reference: tasks/orqa/supervised/{data.py, finetune.py, eval_utils.py} — a
+biencoder trained on Natural-Questions-style data where each question comes
+with one gold (positive) context and hard-negative contexts; the loss is
+cross entropy of the positive among [its contexts + every other question's
+contexts in the batch] (in-batch negatives). Data format is the published
+DPR json: a list of {"question", "answers", "positive_ctxs": [{"text",
+"title"}...], "hard_negative_ctxs": [...]}.
+
+TPU-native shape: contexts are stacked [b*(1+n_neg), s] next to the query
+batch [b, s]; the score matrix [b, b*(1+n_neg)] comes from one matmul (XLA
+gathers the dp-sharded context embeddings, like the ICT loss).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.retrieval.biencoder import _towers, biencoder_embed
+
+
+def load_dpr_json(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read().lstrip()
+    if text.startswith("["):
+        records = json.loads(text)
+    else:  # jsonl
+        records = [json.loads(x) for x in text.splitlines() if x.strip()]
+    # trainable records need at least one positive context
+    return [r for r in records if r.get("positive_ctxs")]
+
+
+class OpenRetrievalSupervisedDataset:
+    """(question, positive, hard negatives) samples (supervised/data.py)."""
+
+    def __init__(self, records: List[dict], tokenize: Callable[[str], list],
+                 seq_length: int, n_hard_negatives: int = 1,
+                 cls_id: int = 101, sep_id: int = 102, pad_id: int = 0,
+                 seed: int = 1234, num_samples: int = None):
+        self.records = records
+        self.tokenize = tokenize
+        self.seq_length = seq_length
+        self.n_neg = n_hard_negatives
+        self.cls_id, self.sep_id, self.pad_id = cls_id, sep_id, pad_id
+        self.seed = seed
+        self.num_samples = num_samples or len(records)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def _pack(self, text: str, title: str = None):
+        body = self.tokenize(text)
+        if title:
+            t = self.tokenize(title)
+            row = [self.cls_id, *t, self.sep_id, *body]
+        else:
+            row = [self.cls_id, *body]
+        row = row[: self.seq_length - 1] + [self.sep_id]
+        toks = np.full((self.seq_length,), self.pad_id, np.int64)
+        toks[: len(row)] = row
+        mask = (np.arange(self.seq_length) < len(row)).astype(np.int64)
+        return toks, mask
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        r = self.records[idx % len(self.records)]
+        # per-index rng: sample content is a function of idx alone, so eval
+        # re-iteration and checkpoint-resumed runs see the same data
+        rng = random.Random(self.seed * 1_000_003 + idx)
+        q_toks, q_mask = self._pack(r["question"])
+        pos = rng.choice(r["positive_ctxs"])
+        ctxs = [self._pack(pos.get("text", ""), pos.get("title"))]
+        negs = list(r.get("hard_negative_ctxs") or [])
+        rng.shuffle(negs)
+        for i in range(self.n_neg):
+            if i < len(negs):
+                c = negs[i]
+                ctxs.append(self._pack(c.get("text", ""), c.get("title")))
+            else:  # pad with an empty context so shapes stay static
+                ctxs.append(self._pack(""))
+        ctx_toks = np.stack([c[0] for c in ctxs])   # [1+n_neg, s]
+        ctx_mask = np.stack([c[1] for c in ctxs])
+        return {
+            "query_tokens": q_toks, "query_pad_mask": q_mask,
+            "context_tokens": ctx_toks, "context_pad_mask": ctx_mask,
+        }
+
+
+def supervised_collator(samples: list) -> dict:
+    return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+def orqa_supervised_loss(cfg, params, batch, *, dropout_key=None,
+                         deterministic=True, rope_cache=None,
+                         sp_constraint=None):
+    """NLL of each question's positive among ALL contexts in the global
+    batch (supervised/finetune.py cross_entropy_forward_step semantics)."""
+    del rope_cache, sp_constraint
+    qt, ct = _towers(params)
+    kq = kc = None
+    if dropout_key is not None:
+        kq, kc = jax.random.split(dropout_key)
+    b, per, s = batch["context_tokens"].shape
+    q = biencoder_embed(cfg, qt, batch["query_tokens"],
+                        batch["query_pad_mask"], dropout_key=kq,
+                        deterministic=deterministic)             # [b, d]
+    c = biencoder_embed(cfg, ct,
+                        batch["context_tokens"].reshape(b * per, s),
+                        batch["context_pad_mask"].reshape(b * per, s),
+                        dropout_key=kc, deterministic=deterministic)
+    scores = q @ c.T                                             # [b, b*per]
+    if cfg.retriever.retriever_score_scaling:
+        scores = scores / jnp.sqrt(jnp.float32(cfg.model.hidden_size))
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    labels = jnp.arange(b) * per  # each question's own positive
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+    acc = (scores.argmax(axis=-1) == labels).mean() * 100.0
+    return loss, {"lm loss": loss, "rank1_acc": acc}
+
+
+def finetune_orqa(cfg, train_ds, valid_ds=None):
+    """Train via the standard pretrain() driver with the DPR loss."""
+    from megatron_llm_tpu.data.samplers import build_pretraining_data_loader
+    from megatron_llm_tpu.retrieval.biencoder import init_biencoder_params
+    from megatron_llm_tpu.training import pretrain
+
+    def provider(cfg, _tokenizer, consumed):
+        t = cfg.training
+        loader = lambda ds, c: build_pretraining_data_loader(  # noqa: E731
+            ds, c, t.global_batch_size, cfg.data.dataloader_type, t.seed,
+            collate_fn=supervised_collator,
+        )
+        valid_factory = (lambda: loader(valid_ds, 0)) if valid_ds else None
+        return loader(train_ds, consumed), valid_factory
+
+    return pretrain(
+        cfg,
+        data_iterators_provider=provider,
+        params_provider=lambda key: init_biencoder_params(cfg, key),
+        loss_fn=orqa_supervised_loss,
+    )
