@@ -15,10 +15,11 @@ p2p_communication.py isend/irecv) with the TPU-native formulation:
   M + pp - 1 — the same bubble as the reference's warmup(pp-rank-1)/steady/
   cooldown accounting (schedules.py:648-720).
 * backward is autodiff through the scan: ppermute transposes to the reverse
-  permute, giving the mirrored cooldown. This is a GPipe-style schedule
-  (all-forward-then-all-backward per jit step) with per-stage remat; a true
-  interleaved 1F1B with jax.vjp staging is an optimization slot for later
-  rounds.
+  permute, giving the mirrored cooldown. This GPipe-style schedule
+  (all-forward-then-all-backward per jit step) coexists with the true 1F1B
+  (grads inside the tick loop, O(pp) activations —
+  :func:`pipeline_1f1b_loss_and_grads`) and its interleaved variant
+  (:func:`pipeline_1f1b_interleaved_loss_and_grads`).
 * only ``pp`` is manual (shard_map axis_names={'pp'}): dp/tp/sp shardings
   inside the stage body stay under GSPMD exactly as in the pp=1 path.
 
@@ -237,6 +238,73 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
+                loss_scale, rope):
+    """Shared preamble of both 1F1B schedules: microbatch splits, dropout
+    keys, params split, compute dtype, and the default GPT embed/head fns."""
+    M = num_micro or cfg.parallel.num_micro_batches or 1
+    gbs = batch["tokens"].shape[0]
+    assert gbs % M == 0
+    s = {"M": M, "mb": gbs // M}
+    s["rope"] = rope if rope is not None else lm.make_rope_cache(cfg)
+    s["scale"] = loss_scale if loss_scale is not None else jnp.float32(1.0)
+
+    def split(x):
+        return x.reshape(M, gbs // M, *x.shape[1:])
+
+    s["tokens"] = split(batch["tokens"])
+    s["labels"] = split(batch["labels"])
+    s["loss_mask"] = split(batch["loss_mask"]).astype(jnp.float32)
+    s["aux_mb"] = {
+        k: split(batch[k]) for k in ("position_ids", "segment_ids")
+        if batch.get(k) is not None
+    }
+    s["token_idx"] = batch.get("token_idx")
+    s["denom"] = jnp.maximum(s["loss_mask"].sum(), 1.0)
+    s["dtype"] = (
+        jnp.bfloat16 if cfg.training.params_dtype == "bfloat16"
+        else jnp.float16 if cfg.training.params_dtype == "float16"
+        else jnp.float32
+    )
+
+    use_dropout = (
+        dropout_key is not None
+        and (cfg.model.hidden_dropout > 0.0 or cfg.model.attention_dropout > 0.0)
+    )
+    s["use_dropout"] = use_dropout
+    embed_keys, layer_keys = microbatch_keys(
+        dropout_key if use_dropout else None, M
+    )
+    if embed_keys is None:  # static shard_map signature
+        embed_keys = jnp.zeros((M, 2), jnp.uint32)
+        layer_keys = jnp.zeros((M, 2), jnp.uint32)
+    s["embed_keys"], s["layer_keys"] = embed_keys, layer_keys
+
+    if embed_fn is None:
+        def embed_fn(outer_p, tok, aux, ke):
+            h = lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
+            if use_dropout:
+                h = rng_mod.dropout(ke, cfg.model.hidden_dropout, h)
+            return h
+
+    if head_loss_fn is None:
+        denom, scale = s["denom"], s["scale"]
+
+        def head_loss_fn(outer_p, hidden, lbl, msk):
+            h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
+                     cfg.model.use_rms_norm)
+            logits = lm.compute_logits(cfg, outer_p, h)
+            per_token = softmax_cross_entropy(logits, lbl)
+            return (per_token * msk).sum() / denom * scale
+
+    s["embed_fn"], s["head_loss_fn"] = embed_fn, head_loss_fn
+    s["token_idx_arr"] = (
+        jnp.full((s["tokens"].shape[2],), -1, jnp.int32)
+        if s["token_idx"] is None else s["token_idx"]
+    )
+    return s
+
+
 def pipeline_1f1b_loss_and_grads(
     cfg, mesh, params, batch: Dict[str, jax.Array], *,
     rope=None, loss_scale=None, num_micro=None, dropout_key=None,
@@ -271,61 +339,25 @@ def pipeline_1f1b_loss_and_grads(
     Returns (loss, grads) with grads matching the params tree.
     """
     assert (cfg.parallel.virtual_pipeline_model_parallel_size or 1) == 1, (
-        "interleaved virtual pipelining is supported on the gpipe schedule; "
-        "1f1b runs non-interleaved"
+        "this is the non-interleaved schedule; with "
+        "virtual_pipeline_model_parallel_size > 1 use "
+        "pipeline_1f1b_interleaved_loss_and_grads"
     )
     pp = cfg.parallel.pipeline_model_parallel_size
-    M = num_micro or cfg.parallel.num_micro_batches or 1
-    gbs = batch["tokens"].shape[0]
-    assert gbs % M == 0
-    mb = gbs // M
-    if rope is None:
-        rope = lm.make_rope_cache(cfg)
-    scale = loss_scale if loss_scale is not None else jnp.float32(1.0)
-
-    def split(x):
-        return x.reshape(M, mb, *x.shape[1:])
-
-    tokens = split(batch["tokens"])
-    labels = split(batch["labels"])
-    loss_mask = split(batch["loss_mask"]).astype(jnp.float32)
-    aux_mb = {}
-    for k in ("position_ids", "segment_ids"):
-        if batch.get(k) is not None:
-            aux_mb[k] = split(batch[k])
-    token_idx = batch.get("token_idx")
-    denom = jnp.maximum(loss_mask.sum(), 1.0)  # global token count
+    st = _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn,
+                     head_loss_fn, loss_scale, rope)
+    M, mb = st["M"], st["mb"]
+    rope = st["rope"]
+    tokens, labels, loss_mask = st["tokens"], st["labels"], st["loss_mask"]
+    aux_mb, token_idx = st["aux_mb"], st["token_idx"]
+    use_dropout = st["use_dropout"]
+    embed_keys, layer_keys = st["embed_keys"], st["layer_keys"]
+    embed_fn, head_loss_fn = st["embed_fn"], st["head_loss_fn"]
 
     # params split: layers are pp-sharded; everything else ("outer": embedding,
     # final_norm, lm_head if untied) is replicated and used at the ends.
     layers = params["layers"]
     outer = {k: v for k, v in params.items() if k != "layers"}
-
-    use_dropout = (
-        dropout_key is not None
-        and (cfg.model.hidden_dropout > 0.0 or cfg.model.attention_dropout > 0.0)
-    )
-    embed_keys, layer_keys = microbatch_keys(
-        dropout_key if use_dropout else None, M
-    )
-    if embed_keys is None:  # static shard_map signature
-        embed_keys = jnp.zeros((M, 2), jnp.uint32)
-        layer_keys = jnp.zeros((M, 2), jnp.uint32)
-
-    if embed_fn is None:
-        def embed_fn(outer_p, tok, aux, ke):
-            h = lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
-            if use_dropout:
-                h = rng_mod.dropout(ke, cfg.model.hidden_dropout, h)
-            return h
-
-    if head_loss_fn is None:
-        def head_loss_fn(outer_p, hidden, lbl, msk):
-            h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
-                     cfg.model.use_rms_norm)
-            logits = lm.compute_logits(cfg, outer_p, h)
-            per_token = softmax_cross_entropy(logits, lbl)
-            return (per_token * msk).sum() / denom * scale
 
     def body(layers_local, outer_p, tokens, labels, loss_mask, aux_mb,
              token_idx_local, embed_keys, layer_keys):
@@ -336,11 +368,7 @@ def pipeline_1f1b_loss_and_grads(
         depth = 2 * pp
         s_local = tokens.shape[2]
         h = cfg.model.hidden_size
-        dtype = (
-            jnp.bfloat16 if cfg.training.params_dtype == "bfloat16"
-            else jnp.float16 if cfg.training.params_dtype == "float16"
-            else jnp.float32
-        )
+        dtype = st["dtype"]
 
         def stage_fwd(L, x, aux, dk):
             return _stage_body(
@@ -463,13 +491,248 @@ def pipeline_1f1b_loss_and_grads(
         axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
-    if token_idx is None:
-        token_idx_arr = jnp.full((tokens.shape[2],), -1, jnp.int32)
-    else:
-        token_idx_arr = token_idx
     grads_L, grads_outer, loss = fn(
-        layers, outer, tokens, labels, loss_mask, aux_mb, token_idx_arr,
+        layers, outer, tokens, labels, loss_mask, aux_mb, st["token_idx_arr"],
         embed_keys, layer_keys,
+    )
+    grads = dict(grads_outer)
+    grads["layers"] = grads_L
+    return loss, grads
+
+
+def pipeline_1f1b_interleaved_loss_and_grads(
+    cfg, mesh, params, batch: Dict[str, jax.Array], *,
+    rope=None, loss_scale=None, num_micro=None, dropout_key=None,
+    embed_fn=None, head_loss_fn=None,
+):
+    """Interleaved (virtual-pipeline) 1F1B: grads inside the tick loop with
+    v layer chunks per stage (reference schedules.py:253-502 +
+    parallel_state.py:406-421 virtual ranks).
+
+    Schedule: virtual stage k = c*pp + s; V = v*pp hops per microbatch.
+    Microbatches run in pp-sized groups, chunk-major (the same forward
+    mapping as the interleaved gpipe schedule in :func:`pipeline_apply`);
+    the backward is its time-shifted mirror — at tick t stage s runs
+      forward  of chain position u = t - s          (chunk u%(v*pp)//pp),
+      backward of chain position j ≡ (V-1-s) mod pp (virtual stage V-1-j),
+    one fwd and one bwd chunk-step per stage per tick, so the pipeline-fill
+    bubble shrinks by v while in-flight activations stay O(V) (ring buffer
+    of depth 2V+2pp saved chunk inputs) instead of the gpipe autodiff's
+    O(M*v) tick residuals.
+
+    The last stage's head vjp runs at the microbatch's final forward tick;
+    dy is held one tick in a depth-pp ring until its backward starts.
+
+    Lockstep cost note: as in the non-interleaved 1F1B, every stage computes
+    the (masked-out) head and embedding vjps every tick. Each interleaved
+    tick does only 1/v of a stage's layers, so that fixed overhead is ~v x
+    larger relative to useful work than non-interleaved — with a very large
+    vocab and few layers per chunk, prefer smaller v (or the gpipe schedule,
+    whose head runs outside the pipelined region).
+    """
+    pp = cfg.parallel.pipeline_model_parallel_size
+    v = cfg.parallel.virtual_pipeline_model_parallel_size or 1
+    V = v * pp
+    st = _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn,
+                     head_loss_fn, loss_scale, rope)
+    M, mb = st["M"], st["mb"]
+    rope = st["rope"]
+    tokens, labels, loss_mask = st["tokens"], st["labels"], st["loss_mask"]
+    aux_mb, token_idx = st["aux_mb"], st["token_idx"]
+    use_dropout = st["use_dropout"]
+    embed_keys, layer_keys = st["embed_keys"], st["layer_keys"]
+    embed_fn, head_loss_fn = st["embed_fn"], st["head_loss_fn"]
+    m_groups = -(-M // pp)
+    T = (m_groups - 1) * v * pp + (pp - 1) + 2 * V
+    depth = 2 * V + 2 * pp
+
+    layers = params["layers"]
+    outer = {k: x for k, x in params.items() if k != "layers"}
+    L = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    assert L % V == 0, (L, pp, v)
+    chunk_layers = L // V
+
+    def chunked(a):
+        return a.reshape(v, pp, chunk_layers, *a.shape[1:])
+
+    layers_chunked = jax.tree.map(chunked, layers)
+
+    def body(layers_local, outer_p, tokens, labels, loss_mask, aux_mb,
+             token_idx_local, embed_keys, layer_keys):
+        stage = jax.lax.axis_index(PP_AXIS)
+        last = pp - 1
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)  # [v, Lc]
+        s_local = tokens.shape[2]
+        h = cfg.model.hidden_size
+        dtype = st["dtype"]
+
+        def chunk_at(c):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                layers_local,
+            )
+
+        def stage_fwd(ch_params, x, aux, dk, layer_offset):
+            return _stage_body(
+                cfg, ch_params, x, aux,
+                token_idx_local if token_idx is not None else None,
+                dk if use_dropout else None, not use_dropout, rope,
+                layer_offset=layer_offset,
+            )
+
+        def aux_at(i):
+            return jax.tree.map(lambda a: a[i], aux_mb)
+
+        def add_chunk(acc, g, c, valid):
+            def upd(a, gg):
+                prev = jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
+                new = prev + jnp.where(valid, gg, jnp.zeros_like(gg))
+                return jax.lax.dynamic_update_index_in_dim(a, new, c, 0)
+
+            return jax.tree.map(upd, acc, g)
+
+        def tick(carry, t):
+            (x_recv, g_recv, saved, dybuf, acc_L, acc_outer, loss_acc) = carry
+
+            # ---- forward mapping (shared with the gpipe interleaved path) --
+            u = t - stage
+            w = u % V
+            c_f = jnp.clip(w // pp, 0, v - 1)
+            f_mb_raw = (u // V) * pp + w % pp
+            do_f = jnp.logical_and(u >= 0, f_mb_raw < M)
+            f_idx = jnp.clip(f_mb_raw, 0, M - 1)
+            first_hop = jnp.logical_and(stage == 0, c_f == 0)
+            last_hop = jnp.logical_and(stage == last, c_f == v - 1)
+
+            x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx),
+                             embed_keys[f_idx])
+            x_in = jnp.where(first_hop, x_emb, x_recv).astype(dtype)
+            slot_f = jnp.where(do_f, u % depth, depth - 1)
+            saved_upd = jax.lax.dynamic_update_index_in_dim(
+                saved, x_in, slot_f, 0
+            )
+            saved = jnp.where(do_f, saved_upd, saved)
+            y = stage_fwd(chunk_at(c_f), x_in, aux_at(f_idx),
+                          layer_keys[f_idx], (c_f * pp + stage) * chunk_layers)
+
+            # ---- head vjp at the final forward hop; dy parked one tick ----
+            loss_f, head_vjp = jax.vjp(
+                lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
+                                            loss_mask[f_idx]),
+                outer_p, y,
+            )
+            use_head = jnp.logical_and(last_hop, do_f)
+            d_outer_head, dy = head_vjp(jnp.float32(1.0))
+            loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
+            acc_outer = jax.tree.map(
+                lambda a, g: a + jnp.where(use_head, g, jnp.zeros_like(g)),
+                acc_outer, d_outer_head,
+            )
+            dy_prev = jax.lax.dynamic_index_in_dim(
+                dybuf, f_idx % pp, 0, keepdims=False)
+            dybuf = jax.lax.dynamic_update_index_in_dim(
+                dybuf, jnp.where(use_head, dy.astype(dtype), dy_prev),
+                f_idx % pp, 0,
+            )
+
+            # ---- backward mapping: j = (V-1-s) % pp + pp*a ----
+            base = (V - 1 - stage) % pp
+            z = t - V - base
+            w2 = z % V
+            a2 = w2 // pp
+            b_mb_raw = (z // V) * pp + w2 % pp
+            j = base + pp * a2
+            k_b = V - 1 - j
+            c_b = jnp.clip(k_b // pp, 0, v - 1)
+            do_b = jnp.logical_and(z >= 0, b_mb_raw < M)
+            b_idx = jnp.clip(b_mb_raw, 0, M - 1)
+            bwd_first = j == 0            # head's dy enters here
+            bwd_last = k_b == 0           # embedding vjp leaves here
+
+            dy_in = jax.lax.dynamic_index_in_dim(
+                dybuf, b_idx % pp, 0, keepdims=False)
+            g_in = jnp.where(bwd_first, dy_in, g_recv)
+            slot_b = ((b_idx // pp) * V + b_idx % pp + c_b * pp) % depth
+            x_saved = jax.lax.dynamic_index_in_dim(saved, slot_b, 0,
+                                                   keepdims=False)
+            _, stage_vjp = jax.vjp(
+                lambda ch, xx: stage_fwd(ch, xx, aux_at(b_idx),
+                                         layer_keys[b_idx],
+                                         (c_b * pp + stage) * chunk_layers),
+                chunk_at(c_b), x_saved,
+            )
+            dchunk, dx = stage_vjp(g_in)
+            acc_L = add_chunk(acc_L, dchunk, c_b, do_b)
+
+            # ---- embedding backward at the last backward hop ----
+            _, emb_vjp = jax.vjp(
+                lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx),
+                                    embed_keys[b_idx]),
+                outer_p,
+            )
+            (d_outer_emb,) = emb_vjp(dx)
+            use_emb = jnp.logical_and(bwd_last, do_b)
+            acc_outer = jax.tree.map(
+                lambda a, g: a + jnp.where(use_emb, g, jnp.zeros_like(g)),
+                acc_outer, d_outer_emb,
+            )
+
+            x_next = jax.lax.ppermute(y.astype(dtype), PP_AXIS, perm_fwd)
+            g_next = jax.lax.ppermute(dx.astype(dtype), PP_AXIS, perm_bwd)
+            return (x_next, g_next, saved, dybuf, acc_L, acc_outer,
+                    loss_acc), None
+
+        zero_x = jnp.zeros((mb, s_local, h), dtype)
+        init = (
+            zero_x,
+            zero_x,
+            jnp.zeros((depth, mb, s_local, h), dtype),
+            jnp.zeros((pp, mb, s_local, h), dtype),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         layers_local),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), outer_p),
+            jnp.float32(0.0),
+        )
+        (_, _, _, _, acc_L, acc_outer, loss_acc), _ = jax.lax.scan(
+            tick, init, jnp.arange(T)
+        )
+        acc_L = jax.lax.psum(acc_L, CP_AXIS)
+        acc_outer = jax.lax.psum(jax.lax.psum(acc_outer, PP_AXIS), CP_AXIS)
+        loss_acc = jax.lax.psum(jax.lax.psum(loss_acc, PP_AXIS), CP_AXIS)
+        return acc_L, acc_outer, loss_acc
+
+    P = jax.sharding.PartitionSpec
+    data_spec = P(None, None, CP_AXIS)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
+            jax.tree.map(lambda _: P(), outer),
+            data_spec, data_spec, data_spec,
+            jax.tree.map(lambda _: data_spec, aux_mb),
+            P(CP_AXIS),
+            P(), P(),
+        ),
+        out_specs=(
+            jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
+            jax.tree.map(lambda _: P(), outer),
+            P(),
+        ),
+        axis_names={PP_AXIS, CP_AXIS},
+        check_vma=False,
+    )
+    grads_Lc, grads_outer, loss = fn(
+        layers_chunked, outer, tokens, labels, loss_mask, aux_mb,
+        st["token_idx_arr"], embed_keys, layer_keys,
+    )
+    # the out-spec gather concatenates stage shards into axis 1: leaves come
+    # back [v, pp*Lc, ...] (chunk-major, then stage, then local layer) —
+    # exactly the chunked() order, so one reshape restores [L, ...]
+    grads_L = jax.tree.map(
+        lambda a: a.reshape(L, *a.shape[2:]), grads_Lc
     )
     grads = dict(grads_outer)
     grads["layers"] = grads_L

@@ -263,6 +263,10 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
     if "loss_scale" in metrics:
         msg += (f" | loss scale: {float(metrics['loss_scale']):.1f} | "
                 f"skipped iterations: {int(metrics['skipped_iterations']):4d}")
+    if "num_zeros" in metrics:
+        msg += f" | num zeros: {float(metrics['num_zeros']):.0f}"
+    if "params_norm" in metrics:
+        msg += f" | params norm: {float(metrics['params_norm']):.3f}"
     print(msg, flush=True)
     if writer is not None:
         writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
@@ -271,6 +275,22 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
         writer.add_scalar("grad-norm/grad-norm", gnorm, iteration)
         writer.add_scalar("throughput/tokens-per-sec", tps, iteration)
         writer.add_scalar("batch-size/batch-size", gbs, iteration)
+        if "num_zeros" in metrics:
+            writer.add_scalar("num-zeros/num-zeros",
+                              float(metrics["num_zeros"]), iteration)
+        if "params_norm" in metrics:
+            writer.add_scalar("params-norm/params-norm",
+                              float(metrics["params_norm"]), iteration)
+        if cfg.logging.log_memory_to_tensorboard:
+            # report_memory analog (reference utils.py:82-96 +
+            # training.py:573-589): device memory_stats -> tensorboard
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+            except Exception:
+                stats = {}
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                if key in stats:
+                    writer.add_scalar(f"memory/{key}", stats[key], iteration)
         if cfg.logging.log_timers_to_tensorboard and timers is not None:
             timers.write(writer, iteration)
     if timers is not None and cfg.logging.timing_log_level > 0:

@@ -135,15 +135,19 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             )
             vpp = cfg.parallel.virtual_pipeline_model_parallel_size or 1
             if cfg.parallel.pipeline_schedule == "1f1b" and vpp > 1:
-                # don't silently fall back: gpipe autodiff holds O(M·v) tick
-                # residuals where 1f1b holds O(pp) — a schedule swap behind
-                # the user's back can OOM a previously-fitting model
-                raise ValueError(
-                    "pipeline_schedule='1f1b' does not support virtual "
-                    "pipelining yet; set pipeline_schedule='gpipe' to use "
-                    "virtual_pipeline_model_parallel_size > 1"
+                # interleaved 1F1B: virtual stages cut the bubble by v while
+                # keeping O(V) in-flight activations (ref schedules.py:253-502)
+                from megatron_llm_tpu.parallel.pipeline import (
+                    pipeline_1f1b_interleaved_loss_and_grads,
                 )
-            if cfg.parallel.pipeline_schedule == "1f1b":
+
+                loss, grads = pipeline_1f1b_interleaved_loss_and_grads(
+                    cfg, mesh, params, batch, rope=rope,
+                    loss_scale=jax.lax.stop_gradient(scale),
+                    num_micro=num_micro,
+                    dropout_key=None if deterministic else base_key,
+                )
+            elif cfg.parallel.pipeline_schedule == "1f1b":
                 # true 1F1B: grads computed inside the tick loop, O(pp)
                 # activation memory (parallel/pipeline.py)
                 from megatron_llm_tpu.parallel.pipeline import (
@@ -201,6 +205,13 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             "grad_norm": grad_norm,
             "learning_rate": lr_fn(iteration),
         }
+        if cfg.logging.log_num_zeros_in_grad:
+            from megatron_llm_tpu.optimizer.optimizer import count_zeros
+
+            metrics["num_zeros"] = count_zeros(grads)
+        if cfg.logging.log_params_norm:
+            # calc_params_l2_norm analog (reference utils.py:38)
+            metrics["params_norm"] = optax.global_norm(new_params)
         if scaler is not None:
             new_scaler = find_scaler_state(new_opt_state)
             metrics["loss_scale"] = new_scaler.loss_scale

@@ -116,3 +116,23 @@ def test_finetune_flag_resets_iteration(toy_corpus, tmp_path):
     cfg2.checkpoint.save = str(tmp_path / "ckpt2")
     result = pretrain(cfg2)
     assert result["iteration"] == 2  # reset, not resumed at 4
+
+
+def test_observability_flags(toy_corpus, tmp_path, capsys):
+    """log_num_zeros_in_grad / log_params_norm / log_memory flags are live
+    (reference training_log surface, training.py:462-641)."""
+    from megatron_llm_tpu.training import pretrain
+
+    cfg = small_cfg(toy_corpus, tmp_path, train_iters=4)
+    cfg.checkpoint.save = None
+    cfg.logging.log_num_zeros_in_grad = True
+    cfg.logging.log_params_norm = True
+    cfg.logging.log_memory_to_tensorboard = True
+    cfg.logging.tensorboard_dir = str(tmp_path / "tb")
+    cfg.logging.log_interval = 2
+    result = pretrain(cfg)
+    assert result["iteration"] == 4
+    assert "num_zeros" in result["last_metrics"]
+    assert float(result["last_metrics"]["params_norm"]) > 0
+    out = capsys.readouterr().out
+    assert "num zeros:" in out and "params norm:" in out
