@@ -334,6 +334,22 @@ def pretrain(
         )
         opt_state = shardings["opt_state_value"]
         timers("model-setup").stop()
+        if cfg.parallel.pipeline_model_parallel_size > 1:
+            from megatron_llm_tpu.parallel.pipeline import (
+                pipeline_bubble_fraction,
+            )
+
+            ppl = cfg.parallel
+            bubble = pipeline_bubble_fraction(
+                ppl.num_micro_batches or 1,
+                ppl.pipeline_model_parallel_size,
+                ppl.virtual_pipeline_model_parallel_size or 1,
+            )
+            # a batch-size ramp runs fewer microbatches early on — this is
+            # the steady-state (full global batch) figure
+            print(f"pipeline: schedule={ppl.pipeline_schedule} "
+                  f"vpp={ppl.virtual_pipeline_model_parallel_size or 1} "
+                  f"steady-state bubble fraction={bubble:.3f}", flush=True)
         if cfg.optimizer.use_distributed_optimizer:
             from megatron_llm_tpu.core.parallel_state import DP_AXIS
             from megatron_llm_tpu.optimizer.optimizer import (
