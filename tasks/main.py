@@ -31,7 +31,8 @@ def get_tasks_args(parser):
     group.add_argument("--qa_data", type=str, default=None,
                        help="jsonl {question, answers} for ORQA")
     group.add_argument("--evidence_data", type=str, default=None,
-                       help="jsonl {id, text, title} evidence for ORQA")
+                       help="evidence for ORQA: jsonl {id, text, title} "
+                            "or DPR psgs_w100-style tsv")
     group.add_argument("--report_topk", type=int, default=20)
     group.add_argument("--match", type=str, default="string",
                        choices=["string", "regex"])

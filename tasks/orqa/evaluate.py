@@ -6,8 +6,9 @@ MIPS index, and report top-k retrieval accuracy = fraction of questions
 whose gold answer string appears in a top-k document.
 
 Inputs (self-contained text formats):
-  evidence: jsonl {"id": int, "text": ..., "title": ...} — the wiki split
-            (reference orqa_wiki_dataset.py reads the same fields from tsv)
+  evidence: jsonl {"id": int, "text": ..., "title": ...} or the DPR
+            psgs_w100-style tsv (id\\ttext\\ttitle, the file the
+            reference's orqa_wiki_dataset.py reads)
   qa file:  jsonl {"question": ..., "answers": [...]}  (NQ open format)
   embeddings: a BlockEmbedStore pickle whose ids match evidence ids
 """
@@ -23,7 +24,23 @@ from tasks.orqa.qa_utils import calculate_matches
 
 
 def load_evidence(path: str) -> dict:
+    """Evidence docs: jsonl {id, text, title} or the published DPR wiki TSV
+    (``id\\ttext\\ttitle`` with a header row — psgs_w100.tsv, the format the
+    reference's orqa_wiki_dataset.py reads)."""
     docs = {}
+    if path.endswith((".tsv", ".tsv.gz")):
+        import csv
+        import gzip
+
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            reader = csv.reader(f, delimiter="\t")
+            for row in reader:
+                if not row or row[0] == "id":
+                    continue
+                docs[int(row[0])] = (row[1] if len(row) > 1 else "",
+                                     row[2] if len(row) > 2 else "")
+        return docs
     with open(path, encoding="utf-8") as f:
         for line in f:
             if line.strip():

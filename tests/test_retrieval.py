@@ -375,3 +375,15 @@ def test_pretrain_ict_end_to_end(sentence_corpus, tmp_path):
     assert np.isfinite(float(result["last_metrics"]["lm loss"]))
     # top-k retrieval accuracies flow through the eval path; the metric
     # computation itself is asserted in test_ict_loss_and_grads
+
+
+def test_load_evidence_tsv(tmp_path):
+    """DPR psgs_w100.tsv format (reference orqa_wiki_dataset.py input)."""
+    from tasks.orqa.evaluate import load_evidence
+
+    tsv = tmp_path / "wiki.tsv"
+    tsv.write_text("id\ttext\ttitle\n1\tparis is in france\tParis\n"
+                   "2\tberlin text\tBerlin\n")
+    docs = load_evidence(str(tsv))
+    assert docs[1] == ("paris is in france", "Paris")
+    assert len(docs) == 2
