@@ -74,6 +74,23 @@ class ModelConfig:
     num_tokentypes: int = 0
     # T5: decoder depth (None = num_layers); decoder layers get cross-attention
     decoder_num_layers: Optional[int] = None
+    # --- Mixture of Experts (beyond-reference: the reference has no MoE) ---
+    # number of experts per MoE layer; None = dense model
+    num_experts: Optional[int] = None
+    moe_router_topk: int = 2
+    # expert capacity = ceil(topk * tokens * capacity_factor / num_experts)
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    # tokens are routed in fixed-size groups of (at most) this many tokens so
+    # the one-hot dispatch/combine tensors stay O(group * capacity) instead
+    # of O(seq^2) at long context (GShard grouping); seq_length must be a
+    # multiple of the group size when longer than it
+    moe_group_size: int = 4096
+    # renormalize the selected top-k gates to sum to 1 (Mixtral convention)
+    moe_normalize_gates: bool = True
+    # Switch-style load-balance aux loss and ST-MoE router z-loss weights
+    moe_aux_loss_coeff: float = 0.01
+    moe_z_loss_coeff: float = 0.0
 
     def finalize(self) -> None:
         if self.kv_channels is None:
@@ -361,6 +378,36 @@ class Config:
             assert (
                 self.model.num_layers % self.parallel.pipeline_model_parallel_size == 0
             ), "num_layers must be divisible by pipeline_model_parallel_size"
+        if self.model.num_experts is not None:
+            ep = self.parallel.expert_parallel_size
+            assert self.model.num_experts % ep == 0, (
+                f"num_experts {self.model.num_experts} not divisible by "
+                f"expert_parallel_size {ep}"
+            )
+            assert self.parallel.pipeline_model_parallel_size == 1, (
+                "MoE is currently supported with pipeline_model_parallel_size"
+                " == 1 (dp/ep/tp/cp/sp compose freely)"
+            )
+            assert self.model.moe_router_topk <= self.model.num_experts
+            if self.parallel.data_parallel_size is not None:
+                # auto-inferred dp (None) is validated later by build_mesh
+                assert self.parallel.data_parallel_size % ep == 0, (
+                    f"data_parallel_size {self.parallel.data_parallel_size} "
+                    f"not divisible by expert_parallel_size {ep} (ep is "
+                    f"carved out of dp)"
+                )
+            assert self.model_name in (
+                "gpt", "llama", "llama2", "codellama", "falcon", "mistral",
+                "mixtral",
+            ), (
+                "MoE is supported for the GPT/Llama-family decoder models "
+                "only — the BERT/T5/biencoder loss paths do not consume the "
+                "router aux losses"
+            )
+        else:
+            assert self.parallel.expert_parallel_size == 1, (
+                "expert_parallel_size > 1 requires num_experts (MoE)"
+            )
         return self
 
 
@@ -442,6 +489,19 @@ ARCH_DEFAULTS = {
         layernorm_epsilon=1e-5,
         sliding_window_size=4096,
     ),
+    # Mixtral: mistral block with a top-2 8-expert MoE FFN (beyond-reference —
+    # the reference has no MoE family; see models/moe.py)
+    "mixtral": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-5,
+        num_experts=8,
+        moe_router_topk=2,
+        rope_theta=1_000_000.0,
+    ),
 }
 
 # Canonical model sizes (hidden/layers/heads/kv-heads/ffn) for convenience.
@@ -466,6 +526,10 @@ MODEL_SIZES = {
     "mistral-7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
                        num_attention_heads_kv=8, ffn_hidden_size=14336,
                        max_position_embeddings=32768),
+    "mixtral-8x7b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                         num_attention_heads_kv=8, ffn_hidden_size=14336,
+                         max_position_embeddings=32768, num_experts=8,
+                         moe_router_topk=2),
 }
 
 

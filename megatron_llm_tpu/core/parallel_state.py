@@ -31,6 +31,12 @@ TP_AXIS = "tp"
 CP_AXIS = "cp"  # context (sequence/ring-attention) parallelism
 EP_AXIS = "ep"  # expert parallelism (MoE)
 
+# Batch axes: expert parallelism is carved out of data parallelism (the
+# Megatron-LM convention, ep | dp): the global batch is sharded over BOTH
+# axes, and MoE expert weights shard over ep only. For dense models ep=1
+# and this degenerates to plain dp.
+DATA_AXES = (DP_AXIS, EP_AXIS)
+
 _GLOBAL_MESH: Optional[Mesh] = None
 
 
@@ -42,6 +48,7 @@ class MeshLayout:
     pipeline_model_parallel_size: int = 1
     data_parallel_size: Optional[int] = None
     context_parallel_size: int = 1
+    expert_parallel_size: int = 1
 
 
 def build_mesh(
@@ -49,13 +56,20 @@ def build_mesh(
     pipeline_model_parallel_size: int = 1,
     data_parallel_size: Optional[int] = None,
     context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the (dp, pp, cp, tp) device mesh.
+    """Build the (dp, ep, pp, cp, tp) device mesh.
 
     Analog of ``initialize_model_parallel`` (parallel_state.py:51-205): instead
     of enumerating rank lists per subgroup, the reshaped device array defines
     every "group" implicitly — a TP group is a row of the tp axis, etc.
+
+    ``expert_parallel_size`` (ep) is carved out of data parallelism
+    (Megatron-LM's ep | dp convention): ``data_parallel_size`` counts the
+    TOTAL data-parallel replicas, of which ep also carry distinct experts.
+    The batch shards over (dp, ep) jointly (DATA_AXES); expert weights
+    shard over ep; dense weights are replicated across both.
     """
     if devices is None:
         devices = jax.devices()
@@ -63,20 +77,25 @@ def build_mesh(
     tp = tensor_model_parallel_size
     pp = pipeline_model_parallel_size
     cp = context_parallel_size
+    ep = expert_parallel_size
     if data_parallel_size is None:
-        assert n % (tp * pp * cp) == 0, (
-            f"{n} devices not divisible by tp*pp*cp = {tp * pp * cp}"
+        assert n % (tp * pp * cp * ep) == 0, (
+            f"{n} devices not divisible by tp*pp*cp*ep = {tp * pp * cp * ep}"
         )
-        dp = n // (tp * pp * cp)
+        dp = n // (tp * pp * cp * ep)
         need = n  # auto dp must consume every device
     else:
         # an explicitly requested layout may use a subset of the devices
-        dp = data_parallel_size
-        need = dp * pp * cp * tp
-        assert need <= n, f"dp*pp*cp*tp = {need} > device count {n}"
+        assert data_parallel_size % ep == 0, (
+            f"data_parallel_size {data_parallel_size} not divisible by "
+            f"expert_parallel_size {ep}"
+        )
+        dp = data_parallel_size // ep
+        need = dp * ep * pp * cp * tp
+        assert need <= n, f"dp*ep*pp*cp*tp = {need} > device count {n}"
     devices = list(devices)[:need]
-    dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
-    return Mesh(dev_array, (DP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS))
+    dev_array = np.asarray(devices).reshape(dp, ep, pp, cp, tp)
+    return Mesh(dev_array, (DP_AXIS, EP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS))
 
 
 def build_mesh_from_config(cfg, devices=None) -> Mesh:
@@ -86,6 +105,7 @@ def build_mesh_from_config(cfg, devices=None) -> Mesh:
         pipeline_model_parallel_size=p.pipeline_model_parallel_size,
         data_parallel_size=p.data_parallel_size,
         context_parallel_size=p.context_parallel_size,
+        expert_parallel_size=getattr(p, "expert_parallel_size", 1),
         devices=devices,
     )
 
@@ -141,7 +161,13 @@ def get_pipeline_model_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
 
 
 def get_data_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
-    return _axis_size(mesh or get_global_mesh(), DP_AXIS)
+    """TOTAL data-parallel replicas = dp * ep (ep is carved out of dp)."""
+    m = mesh or get_global_mesh()
+    return _axis_size(m, DP_AXIS) * _axis_size(m, EP_AXIS)
+
+
+def get_expert_parallel_world_size(mesh: Optional[Mesh] = None) -> int:
+    return _axis_size(mesh or get_global_mesh(), EP_AXIS)
 
 
 def get_context_parallel_world_size(mesh: Optional[Mesh] = None) -> int:

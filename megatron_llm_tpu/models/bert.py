@@ -78,7 +78,7 @@ def bert_forward(
     m = cfg.model
     hidden = embed_tokens(cfg, params, tokens, tokentype_ids=tokentype_ids)
     bias = padding_bias(padding_mask)
-    hidden, _ = transformer_forward(
+    hidden, _, _moe_aux = transformer_forward(
         cfg, params["layers"], hidden,
         attn_bias=bias,
         dropout_key=dropout_key, deterministic=deterministic,

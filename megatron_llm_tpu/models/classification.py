@@ -47,7 +47,7 @@ def _pooled(cfg, params, tokens, padding_mask, tokentype_ids,
             dropout_key, deterministic):
     m = cfg.model
     hidden = embed_tokens(cfg, params, tokens, tokentype_ids=tokentype_ids)
-    hidden, _ = transformer_forward(
+    hidden, _, _moe_aux = transformer_forward(
         cfg, params["layers"], hidden,
         attn_bias=padding_bias(padding_mask),
         dropout_key=dropout_key, deterministic=deterministic,

@@ -37,6 +37,12 @@ def validate_family(cfg: Config) -> Config:
         _check(m.sliding_window_size is not None,
                "mistral requires sliding_window_size")
         _check(m.use_rms_norm and m.glu_activation == "swiglu", "mistral uses llama block")
+    elif name == "mixtral":
+        _check(m.num_experts is not None and m.num_experts > 1,
+               "mixtral requires num_experts > 1")
+        _check(m.use_rms_norm and m.glu_activation == "swiglu",
+               "mixtral uses the llama block")
+        _check(not m.use_bias, "mixtral has no biases")
     return cfg
 
 

@@ -139,12 +139,14 @@ def model_forward(
     cache_index=None,
     sp_constraint=None,
     logits_postprocess=True,
+    return_aux=False,
 ):
     """GPTModel.forward analog (gpt_model.py:45-124).
 
     With ``labels``: returns per-token fp32 loss [b, s] (masked mean is the
     caller's job, matching the reference loss_func split). Without: logits.
-    Returns (output, new_kv_caches).
+    Returns (output, new_kv_caches), or (output, new_kv_caches, moe_aux[2])
+    when ``return_aux`` (MoE router losses, models/moe.py).
     """
     hidden = embed_tokens(cfg, params, tokens, position_ids)
     if dropout_key is not None and not deterministic:
@@ -156,7 +158,7 @@ def model_forward(
     if rope_cache is None:
         rope_cache = make_rope_cache(cfg)
 
-    hidden, new_caches = transformer_forward(
+    hidden, new_caches, moe_aux = transformer_forward(
         cfg, params["layers"], hidden,
         rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
         token_idx=token_idx,
@@ -168,15 +170,18 @@ def model_forward(
     hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,
                   cfg.model.use_rms_norm)
 
+    def ret(out):
+        return (out, new_caches, moe_aux) if return_aux else (out, new_caches)
+
     if not logits_postprocess:
-        return hidden, new_caches
+        return ret(hidden)
 
     logits = compute_logits(cfg, params, hidden)
     if labels is None:
-        return logits, new_caches
+        return ret(logits)
 
     loss = softmax_cross_entropy(logits, labels)  # fp32 per-token
-    return loss, new_caches
+    return ret(loss)
 
 
 def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
@@ -186,9 +191,11 @@ def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
     tokens/labels/loss_mask[/position_ids/segment_ids].
 
     Mirrors the reference loss_func (finetune.py:139-190): masked mean of the
-    per-token CE.
+    per-token CE. MoE models add the weighted router losses (models/moe.py)
+    to the trained total while still reporting "lm loss" as the bare CE.
     """
-    per_token, _ = model_forward(
+    moe = cfg.model.num_experts is not None
+    out = model_forward(
         cfg, params, batch["tokens"],
         position_ids=batch.get("position_ids"),
         segment_ids=batch.get("segment_ids"),
@@ -198,8 +205,20 @@ def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
         deterministic=deterministic,
         rope_cache=rope_cache,
         sp_constraint=sp_constraint,
+        return_aux=moe,
     )
+    per_token = out[0]
     mask = batch["loss_mask"].astype(jnp.float32)
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (per_token * mask).sum() / denom
-    return loss, {"lm loss": loss}
+    metrics = {"lm loss": loss}
+    if moe:
+        balance, z = out[2][0], out[2][1]
+        total = (loss
+                 + cfg.model.moe_aux_loss_coeff * balance
+                 + cfg.model.moe_z_loss_coeff * z)
+        metrics["moe aux loss"] = balance
+        if cfg.model.moe_z_loss_coeff:
+            metrics["router z loss"] = z
+        return total, metrics
+    return loss, metrics

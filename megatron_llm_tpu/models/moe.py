@@ -1,0 +1,194 @@
+"""Mixture-of-Experts layer with expert parallelism — beyond-reference feature.
+
+The reference (xingyaoww/Megatron-LLM) has **no MoE**: its parallel_state.py
+carves only TP/PP/DP/embedding groups (SURVEY §2.1 "EP: absent"). This module
+adds the capability TPU-first, in the GShard/Switch/Mixtral lineage:
+
+* **Routing** is a dense top-k softmax gate computed in fp32 with a
+  load-balancing auxiliary loss (Switch Transformer) and an optional router
+  z-loss (ST-MoE) — both standard published formulations.
+* **Dispatch/combine are einsums** against one-hot capacity tensors — no
+  scatter/gather, no dynamic shapes, so everything lands on the MXU and the
+  all-to-all between data- and expert-sharded layouts is *inferred by XLA*
+  from sharding constraints (the same way our TP all-reduces replace NCCL
+  calls, parallel/tp.py).
+* **Expert parallelism is a mesh axis** (``ep``, carved out of dp — the
+  ep | dp convention Megatron-LM upstream uses): expert weight stacks
+  [E, ...] shard their expert axis over ``ep``, dispatched activations are
+  sharding-constrained from batch-sharded [G:(dp,ep), T, h] to
+  expert-sharded [G:dp, E:ep, C, h], and XLA emits the all-to-all over the
+  ICI ring. TP composes: the per-expert FFN hidden axis shards over ``tp``
+  exactly like the dense MLP (column- then row-parallel, parallel/tp.py).
+* **Capacity-based token dropping**: each expert processes at most
+  C = ceil(topk * T * capacity_factor / E) tokens per group; overflow tokens
+  fall through to the residual stream (their combine weight is zero), which
+  keeps every shape static for XLA.
+
+Parameter schema (per layer; stacked on a leading layer axis under scan):
+
+    {'router':  {'kernel': [h, E]}                        # fp32, replicated
+     'experts': {'fc1': {'kernel': [E, h, 2, ffn] | [E, h, ffn], 'bias'?},
+                 'fc2': {'kernel': [E, ffn, h], 'bias'?}}}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.ops.activations import GLU_BASE_ACTIVATIONS, get_mlp_activation
+
+Params = Dict[str, Any]
+
+
+def moe_capacity(cfg, tokens_per_group: int) -> int:
+    """Expert capacity C for one routing group of T tokens."""
+    m = cfg.model
+    cap = int(-(-m.moe_router_topk * tokens_per_group * m.moe_capacity_factor
+                // m.num_experts))  # ceil
+    return max(cap, m.moe_min_capacity)
+
+
+def init_moe_params(cfg, key: jax.Array) -> Params:
+    m = cfg.model
+    h, f, e = m.hidden_size, m.ffn_hidden_size, m.num_experts
+    glu = m.glu_activation is not None
+    std = m.init_method_std
+    out_std = std / (2.0 * m.num_layers) ** 0.5 if m.use_scaled_init_method else std
+    kr, k1, k2 = jax.random.split(key, 3)
+    # per-expert independent init: one key per expert, same distribution as
+    # the dense MLP (transformer.init_layer_params)
+    fc1_shape = (e, h, 2, f) if glu else (e, h, f)
+    p: Params = {
+        "router": {"kernel": std * jax.random.normal(kr, (h, e), jnp.float32)},
+        "experts": {
+            "fc1": {"kernel": std * jax.random.normal(k1, fc1_shape, jnp.float32)},
+            "fc2": {"kernel": out_std * jax.random.normal(k2, (e, f, h), jnp.float32)},
+        },
+    }
+    if m.use_bias:
+        p["experts"]["fc1"]["bias"] = jnp.zeros((e, 2, f) if glu else (e, f),
+                                                jnp.float32)
+        p["experts"]["fc2"]["bias"] = jnp.zeros((e, h), jnp.float32)
+    return p
+
+
+def _ep_constraint(x: jax.Array, expert_axis: int) -> jax.Array:
+    """Constrain an [G, E, C, ...] dispatched tensor so G rides dp and E rides
+    ep — the boundary where XLA inserts the data<->expert all-to-all."""
+    from megatron_llm_tpu.core import parallel_state as ps
+    from jax.sharding import PartitionSpec as P
+
+    if not ps.mesh_is_initialized():
+        return x
+    mesh = ps.get_global_mesh()
+    if ps.EP_AXIS not in mesh.shape:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = ps.DP_AXIS
+    spec[expert_axis] = ps.EP_AXIS
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def route_tokens(
+    cfg, router_logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with per-expert capacity.
+
+    ``router_logits``: [G, T, E] fp32. Returns:
+      combine  [G, T, E, C] fp32 — gate weight of token t in expert e slot c
+      dispatch [G, T, E, C] bool — combine != 0
+      aux      [2] fp32 — (load-balance loss, router z-loss), unweighted
+    """
+    m = cfg.model
+    g_, t_, e_ = router_logits.shape
+    k_ = m.moe_router_topk
+
+    probs = jax.nn.softmax(router_logits, axis=-1)  # fp32
+    gate, idx = jax.lax.top_k(probs, k_)  # [G, T, K]
+    if m.moe_normalize_gates:
+        # Mixtral convention: renormalize the selected gates to sum to 1
+        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    mask = jax.nn.one_hot(idx, e_, dtype=jnp.float32)  # [G, T, K, E]
+
+    # Position of each (token, slot) in its expert's buffer. Priority order is
+    # (slot, token): all first choices are seated before any second choice —
+    # the GShard convention, so capacity pressure drops k=2 traffic first.
+    mk = mask.transpose(0, 2, 1, 3).reshape(g_, k_ * t_, e_)
+    pos = (jnp.cumsum(mk, axis=1) - mk).reshape(g_, k_, t_, e_).transpose(0, 2, 1, 3)
+    pos_tk = (pos * mask).sum(-1).astype(jnp.int32)  # [G,T,K] pos in expert
+    fits = pos_tk < capacity
+
+    # load-balance aux loss (Switch eq. 4, generalized to top-k): fraction of
+    # tokens dispatched to e (all slots, /k so it sums to 1) x mean router
+    # prob for e, scaled by E — equals 1.0 under perfectly uniform routing.
+    frac_tokens = mask.sum(2).mean((0, 1)) / k_    # [E]
+    frac_probs = probs.mean((0, 1))                # [E]
+    balance = e_ * jnp.sum(frac_tokens * frac_probs)
+    # router z-loss (ST-MoE): keeps logits small/stable
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    aux = jnp.stack([balance, z])
+
+    gate_kept = gate * fits.astype(gate.dtype)                  # [G, T, K]
+    slot = jax.nn.one_hot(pos_tk, capacity, dtype=jnp.float32)  # [G, T, K, C]
+    combine = jnp.einsum("gtk,gtke,gtkc->gtec", gate_kept, mask, slot)
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN over [b, s, h]; tokens route in per-sequence-chunk groups of
+    ``moe_group_size``. Returns (out, aux[2]).
+
+    Replaces mlp_sublayer (transformer.py) on MoE layers; the dense path's
+    GLU chunk-2 convention (glu_activations.py:14-16) is preserved per expert.
+    """
+    m = cfg.model
+    b, s, h = x.shape
+    # GShard grouping: route fixed-size chunks of the sequence independently
+    # so dispatch/combine stay O(group * capacity), not O(seq^2) — at 32K seq
+    # an ungrouped [s, E, C~s] one-hot would be gigabytes per sample.
+    gsz = min(s, m.moe_group_size)
+    assert s % gsz == 0, (
+        f"seq_length {s} not a multiple of moe_group_size {gsz}"
+    )
+    x = x.reshape(b * (s // gsz), gsz, h)
+    capacity = moe_capacity(cfg, gsz)
+
+    w_router = p["router"]["kernel"]  # fp32
+    router_logits = x.astype(jnp.float32) @ w_router  # [G, T, E]
+    combine, dispatch, aux = route_tokens(cfg, router_logits, capacity)
+
+    dt = x.dtype
+    xe = jnp.einsum("gtec,gth->gech", dispatch.astype(dt), x)  # [b, E, C, h]
+    xe = _ep_constraint(xe, 1)
+
+    experts = p["experts"]
+    fc1 = experts["fc1"]["kernel"].astype(dt)
+    if m.glu_activation is not None:
+        act = GLU_BASE_ACTIVATIONS[m.glu_activation]
+        y = jnp.einsum("gech,ehuf->gecuf", xe, fc1)  # u = 2 (value, gate)
+        if "bias" in experts["fc1"]:
+            y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
+        inter = y[..., 0, :] * act(y[..., 1, :])
+    else:
+        act = get_mlp_activation(None, m.activation)
+        y = jnp.einsum("gech,ehf->gecf", xe, fc1)
+        if "bias" in experts["fc1"]:
+            y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
+        inter = act(y)
+    out_e = jnp.einsum("gecf,efh->gech", inter, experts["fc2"]["kernel"].astype(dt))
+    if "bias" in experts["fc2"]:
+        out_e = out_e + experts["fc2"]["bias"].astype(dt)[None, :, None]
+    out_e = _ep_constraint(out_e, 1)
+
+    out = jnp.einsum("gech,gtec->gth", out_e, combine.astype(dt))
+    return out.reshape(b, s, h), aux
+
+
+def zero_aux() -> jax.Array:
+    """Aux-loss placeholder for dense layers (keeps scan carries uniform)."""
+    return jnp.zeros((2,), jnp.float32)

@@ -89,7 +89,7 @@ def t5_forward(
 
     # ---- encoder (bidirectional + pad bias) ----
     enc_hidden = embed_tokens(cfg, params, encoder_tokens)
-    enc_hidden, _ = transformer_forward(
+    enc_hidden, _, _enc_aux = transformer_forward(
         cfg, params["layers"], enc_hidden,
         attn_bias=padding_bias(encoder_padding_mask),
         dropout_key=dk_enc, deterministic=deterministic,
@@ -99,7 +99,7 @@ def t5_forward(
 
     # ---- decoder (causal self-attn + cross-attn over encoder) ----
     dec_hidden = embed_tokens(cfg, params, decoder_tokens)
-    dec_hidden, _ = transformer_forward(
+    dec_hidden, _, _dec_aux = transformer_forward(
         cfg, params["decoder_layers"], dec_hidden,
         attn_bias=causal_padding_bias(decoder_padding_mask),
         encoder_hidden=enc_hidden,

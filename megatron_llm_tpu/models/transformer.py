@@ -74,15 +74,22 @@ def init_layer_params(cfg, key: jax.Array, cross_attention: bool = False) -> Par
             "qkv": {"kernel": _normal(k[0], (h, (n + 2 * nkv) * d), std)},
             "dense": {"kernel": _normal(k[1], (n * d, h), out_std)},
         },
-        "mlp": {
+    }
+    if m.num_experts is not None:
+        # MoE layer: router + expert FFN stack replaces the dense MLP
+        # (beyond-reference — see models/moe.py)
+        from megatron_llm_tpu.models.moe import init_moe_params
+
+        p["moe"] = init_moe_params(cfg, jax.random.fold_in(k[2], 0))
+    else:
+        p["mlp"] = {
             # GLU fc1 is [h, 2, ffn] (value half at [:,0,:], gated half at
             # [:,1,:]) so a tp sharding on the ffn axis never splits across
             # the gate/value boundary — the flat reference layout would force
             # a resharding at the chunk-2 split under GSPMD.
             "fc1": {"kernel": _normal(k[2], (h, 2, ffn) if glu else (h, ffn), std)},
             "fc2": {"kernel": _normal(k[3], (ffn, h), out_std)},
-        },
-    }
+        }
     if not m.parallel_attn:
         p["post_norm"] = init_norm_params(h, m.use_rms_norm)
     if m.parallel_layernorm:
@@ -104,8 +111,9 @@ def init_layer_params(cfg, key: jax.Array, cross_attention: bool = False) -> Par
     if m.use_bias:
         p["attention"]["qkv"]["bias"] = jnp.zeros(((n + 2 * nkv) * d,), jnp.float32)
         p["attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
-        p["mlp"]["fc1"]["bias"] = jnp.zeros((2, ffn) if glu else (ffn,), jnp.float32)
-        p["mlp"]["fc2"]["bias"] = jnp.zeros((h,), jnp.float32)
+        if "mlp" in p:
+            p["mlp"]["fc1"]["bias"] = jnp.zeros((2, ffn) if glu else (ffn,), jnp.float32)
+            p["mlp"]["fc2"]["bias"] = jnp.zeros((h,), jnp.float32)
     return p
 
 
@@ -248,6 +256,16 @@ def cross_attention_sublayer(
     return _linear(p["dense"], ctx.reshape(b, sq, n * d))
 
 
+def ffn_sublayer(cfg, p: Params, x: jax.Array):
+    """Dense MLP or MoE, depending on the layer params. Returns (out, aux[2])
+    where aux is the (load-balance, z) router loss pair (zeros for dense)."""
+    from megatron_llm_tpu.models import moe as moe_mod
+
+    if "moe" in p:
+        return moe_mod.moe_sublayer(cfg, p["moe"], x)
+    return mlp_sublayer(cfg, p["mlp"], x), moe_mod.zero_aux()
+
+
 def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
     """ParallelMLP analog (transformer.py:77-142): fc1 -> activation -> fc2.
 
@@ -321,7 +339,7 @@ def block_forward(
             "block; parallel_attn would silently skip the encoder attention"
         )
         mlp_in = norm(hidden, p["mlp_norm"], eps, m.use_rms_norm) if m.parallel_layernorm else ln1
-        mlp_out = mlp_sublayer(cfg, p["mlp"], mlp_in)
+        mlp_out, aux = ffn_sublayer(cfg, p, mlp_in)
         out = hidden + rng_mod.dropout(dk_h1, rate, attn_out, deterministic or dk_h1 is None) \
             + rng_mod.dropout(dk_h2, rate, mlp_out, deterministic or dk_h2 is None)
         out = _sp(out)
@@ -341,10 +359,10 @@ def block_forward(
             )
             resid = _sp(resid)
         ln2 = norm(resid, p["post_norm"], eps, m.use_rms_norm)
-        mlp_out = mlp_sublayer(cfg, p["mlp"], ln2)
+        mlp_out, aux = ffn_sublayer(cfg, p, ln2)
         out = resid + rng_mod.dropout(dk_h2, rate, mlp_out, deterministic or dk_h2 is None)
         out = _sp(out)
-    return out, new_cache
+    return out, new_cache, aux
 
 
 def _lima_rates(cfg, num_layers: int) -> jax.Array:
@@ -397,7 +415,8 @@ def transformer_forward(
     When ``cfg.training.scan_layers`` (default), layers are scanned with an
     optional remat policy; otherwise a Python loop (useful for debugging and
     per-layer inspection).
-    Returns (hidden, new_kv_caches).
+    Returns (hidden, new_kv_caches, aux) — ``aux`` is the summed MoE router
+    loss pair [2] (load-balance, z), zeros for dense models.
     """
     num_layers = jax.tree_util.tree_leaves(stacked_layers)[0].shape[0]
     rates = _lima_rates(cfg, cfg.model.num_layers)
@@ -406,7 +425,7 @@ def transformer_forward(
         layer_params, layer_idx, cache = xs
         dk = None if dropout_key is None else rng_mod.fold_layer(dropout_key, layer_idx)
         rate = rates[layer_idx]
-        out, new_cache = block_forward(
+        out, new_cache, aux = block_forward(
             cfg, layer_params, carry_hidden,
             rope=rope, position_ids=position_ids, segment_ids=segment_ids,
             token_idx=token_idx,
@@ -417,7 +436,7 @@ def transformer_forward(
             kv_cache=cache, cache_index=cache_index,
             sp_constraint=sp_constraint,
         )
-        return out, new_cache
+        return out, (new_cache, aux)
 
     layer_ids = jnp.arange(num_layers) + layer_offset
 
@@ -430,19 +449,21 @@ def transformer_forward(
         body = one_layer
         if granularity is not None:
             body = jax.checkpoint(one_layer, policy=policy, prevent_cse=False)
-        hidden, new_caches = jax.lax.scan(
+        hidden, (new_caches, aux_stack) = jax.lax.scan(
             body, hidden, (stacked_layers, layer_ids, kv_caches)
         )
-        return hidden, new_caches
+        return hidden, new_caches, aux_stack.sum(0)
     else:
         new_caches = []
+        aux_total = jnp.zeros((2,), jnp.float32)
         for i in range(num_layers):
             layer_p = jax.tree.map(lambda a: a[i], stacked_layers)
             cache = None if kv_caches is None else jax.tree.map(lambda a: a[i], kv_caches)
-            hidden, nc = one_layer(hidden, (layer_p, layer_ids[i], cache))
+            hidden, (nc, aux) = one_layer(hidden, (layer_p, layer_ids[i], cache))
             new_caches.append(nc)
+            aux_total = aux_total + aux
         if kv_caches is not None:
             new_caches = jax.tree.map(lambda *xs: jnp.stack(xs), *new_caches)
         else:
             new_caches = None
-        return hidden, new_caches
+        return hidden, new_caches, aux_total

@@ -54,15 +54,16 @@ def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q, block_k
     if not ps.mesh_is_initialized():
         return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
     mesh = ps.get_global_mesh()
-    if mesh.shape.get(ps.DP_AXIS, 1) == 1 and mesh.shape.get(ps.TP_AXIS, 1) == 1:
+    if (mesh.shape.get(ps.DP_AXIS, 1) == 1 and mesh.shape.get(ps.TP_AXIS, 1) == 1
+            and mesh.shape.get(ps.EP_AXIS, 1) == 1):
         return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
 
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    qs = P(ps.DP_AXIS, None, ps.TP_AXIS, None)
-    kvs = P(ps.DP_AXIS, None, ps.TP_AXIS, None)
-    segs = P(ps.DP_AXIS, None)
+    qs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
+    kvs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
+    segs = P(ps.DATA_AXES, None)
     if segment_ids is None:
         fn = shard_map(
             lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from megatron_llm_tpu.core.parallel_state import DP_AXIS
+from megatron_llm_tpu.core.parallel_state import DATA_AXES, DP_AXIS, EP_AXIS
 from megatron_llm_tpu.optimizer.scheduler import lr_schedule, wd_schedule
 from megatron_llm_tpu.parallel.tp import param_partition_specs
 
@@ -86,19 +86,36 @@ def init_optimizer_state(cfg, params: Any):
 # ---------------------------------------------------------------------------
 
 
-def _shard_over_dp(spec: P, shape, dp_size: int) -> P:
-    """Add dp sharding on the first unsharded axis divisible by dp_size.
+def _spec_axes(spec: P):
+    """Flatten a PartitionSpec's entries (entries may be axis tuples)."""
+    out = []
+    for p in spec:
+        if p is None:
+            continue
+        out.extend(p) if isinstance(p, tuple) else out.append(p)
+    return out
+
+
+def _shard_over_dp(spec: P, shape, dp_size: int, ep_size: int = 1) -> P:
+    """Add dp sharding on the first unsharded axis divisible by the dp extent.
 
     The reference shards flattened fp32 state over DP ranks
     (distrib_optimizer.py:63-175); here we annotate an existing axis — XLA
     partitions the Adam update and inserts reduce-scatter/all-gather. Params
     with no divisible axis (norm scales, small stacks) stay replicated — same
     as the reference's padding-to-DP-multiple, minus the padding.
+
+    Expert parameters (spec already carries ``ep``) shard their moments over
+    dp only; dense parameters shard over the full (dp, ep) product — the
+    whole data-parallel group, matching the reference's DP-wide sharding.
     """
+    expert = EP_AXIS in _spec_axes(spec)
+    add = DP_AXIS if (expert or ep_size == 1) else DATA_AXES
+    size = dp_size if (expert or ep_size == 1) else dp_size * ep_size
     parts = list(spec) + [None] * (len(shape) - len(spec))
     for i, (p, n) in enumerate(zip(parts, shape)):
-        if p is None and n % dp_size == 0 and n >= dp_size:
-            parts[i] = DP_AXIS
+        if p is None and n % size == 0 and n >= size:
+            parts[i] = add
             return P(*parts)
     return P(*parts)
 
@@ -108,7 +125,7 @@ def _path_names(path) -> tuple:
 
 
 def opt_state_partition_specs(cfg, params: Any, opt_state: Any,
-                              dp_size: int = 1) -> Any:
+                              dp_size: int = 1, ep_size: int = 1) -> Any:
     """Spec tree for the optax state.
 
     optax states (ScaleByAdamState.mu/nu, trace, masked wrappers) embed
@@ -137,16 +154,19 @@ def opt_state_partition_specs(cfg, params: Any, opt_state: Any,
                 break
         if spec is None:
             spec = P(*([None] * leaf.ndim))
-        return _shard_over_dp(spec, leaf.shape, dp_size) if zero1 else spec
+        return (_shard_over_dp(spec, leaf.shape, dp_size, ep_size)
+                if zero1 else spec)
 
     return jax.tree_util.tree_map_with_path(rule, opt_state)
 
 
 def opt_state_shardings(cfg, mesh: Mesh, params: Any, opt_state: Any) -> Any:
     dp_size = mesh.shape.get(DP_AXIS, 1)
+    ep_size = mesh.shape.get(EP_AXIS, 1)
     return jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        opt_state_partition_specs(cfg, params, opt_state, dp_size=dp_size),
+        opt_state_partition_specs(cfg, params, opt_state, dp_size=dp_size,
+                                  ep_size=ep_size),
     )
 
 
@@ -167,7 +187,7 @@ def zero1_sharded_fraction(cfg, params: Any, opt_state: Any,
         if getattr(leaf, "ndim", 0) == 0:
             continue
         total += leaf.size
-        if any(ax == DP_AXIS for ax in spec if ax is not None):
+        if DP_AXIS in _spec_axes(spec):
             sharded += leaf.size
     return sharded / total if total else 0.0
 

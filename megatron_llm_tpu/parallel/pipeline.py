@@ -63,7 +63,7 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
     layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
     if layer_offset is None:
         layer_offset = stage * layers_per_stage
-    hidden, _ = transformer_forward(
+    hidden, _, _moe_aux = transformer_forward(
         cfg, layers_local, x,
         rope=rope,
         position_ids=aux.get("position_ids"),

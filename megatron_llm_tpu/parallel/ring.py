@@ -240,8 +240,8 @@ def ring_attention(
         f"seq_len {q.shape[1]} not divisible by cp {cp}"
     )
 
-    qs = P(ps.DP_AXIS, ps.CP_AXIS, ps.TP_AXIS, None)
-    segs = P(ps.DP_AXIS, ps.CP_AXIS)
+    qs = P(ps.DATA_AXES, ps.CP_AXIS, ps.TP_AXIS, None)
+    segs = P(ps.DATA_AXES, ps.CP_AXIS)
     idxs = P(ps.CP_AXIS)
     s_local = q.shape[1] // cp
 

@@ -29,7 +29,14 @@ from typing import Any, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from megatron_llm_tpu.core.parallel_state import CP_AXIS, DP_AXIS, PP_AXIS, TP_AXIS
+from megatron_llm_tpu.core.parallel_state import (
+    CP_AXIS,
+    DATA_AXES,
+    DP_AXIS,
+    EP_AXIS,
+    PP_AXIS,
+    TP_AXIS,
+)
 
 # Grad accumulation / FSDP-style extra sharding could compose here later.
 
@@ -72,6 +79,25 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
         if names[-1] == "kernel":
             return spec(TP_AXIS, None)  # row-parallel: shard input (head) dim
         return spec(None)  # row-parallel bias is replicated (added post-reduce)
+    if "router" in names:
+        # MoE router [h, E]: small, fp32, replicated (models/moe.py)
+        return spec(*([None] * (ndim - len(lead))))
+    if "experts" in names:
+        # MoE expert FFN stacks: leading expert axis sharded over ep, the
+        # ffn axis over tp — each (ep, tp) shard holds E/ep experts' tp-slice
+        # (column/row-parallel per expert, exactly the dense fc1/fc2 rule).
+        if "fc1" in names:
+            if names[-1] == "kernel":
+                # [E, h, 2, ffn] (GLU) or [E, h, ffn]
+                return (spec(EP_AXIS, None, None, TP_AXIS)
+                        if ndim == 4 + len(lead) else spec(EP_AXIS, None, TP_AXIS))
+            # bias [E, 2, ffn] or [E, ffn]
+            return (spec(EP_AXIS, None, TP_AXIS)
+                    if ndim == 3 + len(lead) else spec(EP_AXIS, TP_AXIS))
+        if "fc2" in names:
+            if names[-1] == "kernel":
+                return spec(EP_AXIS, TP_AXIS, None)  # [E, ffn, h] row-parallel
+            return spec(EP_AXIS, None)  # [E, h] added post-reduce
     if "fc1" in names:
         if names[-1] == "kernel":
             # [h, 2, ffn] (GLU) or [h, ffn]: shard the ffn axis
@@ -127,13 +153,13 @@ def batch_spec(sequence_parallel: bool, context_parallel: bool = False) -> P:
         seq = (CP_AXIS, TP_AXIS) if sequence_parallel else CP_AXIS
     else:
         seq = TP_AXIS if sequence_parallel else None
-    return P(DP_AXIS, seq, None)
+    return P(DATA_AXES, seq, None)
 
 
 def data_spec(context_parallel: bool = False) -> P:
-    """Spec for integer batch tensors [batch, seq]: batch over dp, and the
-    seq axis over cp when context parallelism is active."""
-    return P(DP_AXIS, CP_AXIS if context_parallel else None)
+    """Spec for integer batch tensors [batch, seq]: batch over (dp, ep), and
+    the seq axis over cp when context parallelism is active."""
+    return P(DATA_AXES, CP_AXIS if context_parallel else None)
 
 
 def batch_shardings(cfg, mesh: Mesh, batch: Any) -> Any:
@@ -144,7 +170,7 @@ def batch_shardings(cfg, mesh: Mesh, batch: Any) -> Any:
 
     cp = cfg.parallel.context_parallel_size > 1
     d = NamedSharding(mesh, data_spec(cp))
-    per_sample = NamedSharding(mesh, P(DP_AXIS))
+    per_sample = NamedSharding(mesh, P(DATA_AXES))
     idx = NamedSharding(mesh, P(CP_AXIS) if cp else P(None))
 
     def spec_for(k, v):
@@ -173,4 +199,4 @@ def make_sp_constraint(cfg, mesh: Optional[Mesh] = None):
 
 def logits_spec() -> P:
     """Logits [b, s, vocab]: vocab sharded over tp (vocab-parallel CE)."""
-    return P(DP_AXIS, None, TP_AXIS)
+    return P(DATA_AXES, None, TP_AXIS)

@@ -100,7 +100,7 @@ def biencoder_embed(
     biencoder_model.py:298-310)."""
     m = cfg.model
     hidden = embed_tokens(cfg, tower, tokens, tokentype_ids=tokentype_ids)
-    hidden, _ = transformer_forward(
+    hidden, _, _moe_aux = transformer_forward(
         cfg, tower["layers"], hidden,
         attn_bias=padding_bias(padding_mask),
         dropout_key=dropout_key, deterministic=deterministic,

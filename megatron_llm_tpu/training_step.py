@@ -118,11 +118,15 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
         scale = scaler.loss_scale if scaler is not None else jnp.float32(1.0)
         inv_scale = 1.0 / scale
 
-        grad_fn = jax.value_and_grad(
-            lambda p, mb, k: micro_loss(p, mb, k, rope)[0]
-            * jax.lax.stop_gradient(scale)
-        )
+        def scaled_loss(p, mb, k):
+            l, mets = micro_loss(p, mb, k, rope)
+            # mets carries the loss_fn's reporting dict (bare CE as "lm loss",
+            # MoE router losses, ...) — unscaled raw values
+            return l * jax.lax.stop_gradient(scale), mets
 
+        grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+        loss_mets = None
         if pp > 1:
             # pipelined path: the microbatch loop lives inside the pipeline
             assert loss_fn is loss_from_batch, (
@@ -173,24 +177,34 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     )[0] * jax.lax.stop_gradient(scale)
                 )(params)
         elif num_micro == 1:
-            loss, grads = grad_fn(params, batch, base_key)
+            (loss, loss_mets), grads = grad_fn(params, batch, base_key)
         else:
             mbs = _split_microbatches(batch, num_micro)
 
             def accum(carry, xs):
-                g_sum, loss_sum = carry
+                g_sum, loss_sum, m_sum = carry
                 mb, idx = xs
-                l, g = grad_fn(params, mb, jax.random.fold_in(base_key, idx))
-                return (jax.tree.map(jnp.add, g_sum, g), loss_sum + l), None
+                (l, mets), g = grad_fn(params, mb, jax.random.fold_in(base_key, idx))
+                return (jax.tree.map(jnp.add, g_sum, g), loss_sum + l,
+                        jax.tree.map(jnp.add, m_sum, mets)), None
 
             zeros = jax.tree.map(jnp.zeros_like, params)
-            (g_sum, loss_sum), _ = jax.lax.scan(
-                accum, (zeros, jnp.zeros((), jnp.float32)),
+            first_mb = jax.tree.map(lambda a: a[0], mbs)
+            mets0 = jax.tree.map(
+                jnp.zeros_like,
+                jax.eval_shape(
+                    lambda p, mb: micro_loss(p, mb, base_key, rope)[1],
+                    params, first_mb,
+                ),
+            )
+            (g_sum, loss_sum, m_sum), _ = jax.lax.scan(
+                accum, (zeros, jnp.zeros((), jnp.float32), mets0),
                 (mbs, jnp.arange(num_micro)),
             )
             inv = 1.0 / num_micro
             grads = jax.tree.map(lambda g: g * inv, g_sum)
             loss = loss_sum * inv
+            loss_mets = jax.tree.map(lambda x: x * inv, m_sum)
 
         loss = loss * inv_scale  # report the un-scaled loss
         # named scopes surface as labeled regions in jax.profiler xplane
@@ -205,6 +219,9 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
             "grad_norm": grad_norm,
             "learning_rate": lr_fn(iteration),
         }
+        if loss_mets is not None:
+            # loss_fn reporting dict (bare CE, MoE router losses, ...)
+            metrics.update(loss_mets)
         if cfg.logging.log_num_zeros_in_grad:
             from megatron_llm_tpu.optimizer.optimizer import count_zeros
 
