@@ -1,0 +1,16 @@
+#!/bin/bash
+# Mixtral-style MoE pretraining: top-2 routing over 8 experts, expert
+# parallelism carved out of dp (ep | dp), composed with TP + sequence
+# parallel + ZeRO-1. See docs/guide/moe.md.
+python finetune.py \
+    --model_name mixtral \
+    --num_layers 24 --hidden_size 2048 --num_attention_heads 16 \
+    --num_attention_heads_kv 8 \
+    --num_experts 8 --moe_router_topk 2 --moe_aux_loss_coeff 0.01 \
+    --tensor_model_parallel_size 4 --expert_parallel_size 8 \
+    --sequence_parallel true --use_distributed_optimizer true \
+    --data_path ${DATA:-/data/corpus_text_document} \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model ${TOK:-tok.model} \
+    --seq_length 2048 --micro_batch_size 2 --global_batch_size 256 \
+    --train_iters 100000 --lr 3e-4 --min_lr 3e-5 --lr_warmup_iters 2000 \
+    --save ckpts/mixtral --save_interval 1000 --log_interval 100

@@ -171,7 +171,7 @@ def opt_state_shardings(cfg, mesh: Mesh, params: Any, opt_state: Any) -> Any:
 
 
 def zero1_sharded_fraction(cfg, params: Any, opt_state: Any,
-                           dp_size: int) -> float:
+                           dp_size: int, ep_size: int = 1) -> float:
     """Fraction of optimizer-state ELEMENTS that actually shard over dp.
 
     The dp annotation in :func:`_shard_over_dp` is heuristic (first divisible
@@ -179,7 +179,8 @@ def zero1_sharded_fraction(cfg, params: Any, opt_state: Any,
     silently stay replicated. This makes that visible: the training driver
     logs it, and tests assert it stays high for the stock architectures
     (VERDICT weak #7)."""
-    specs = opt_state_partition_specs(cfg, params, opt_state, dp_size=dp_size)
+    specs = opt_state_partition_specs(cfg, params, opt_state, dp_size=dp_size,
+                                      ep_size=ep_size)
     total = sharded = 0
     for leaf, spec in zip(jax.tree_util.tree_leaves(opt_state),
                           jax.tree_util.tree_leaves(

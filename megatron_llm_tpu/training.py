@@ -351,16 +351,19 @@ def pretrain(
                   f"vpp={ppl.virtual_pipeline_model_parallel_size or 1} "
                   f"steady-state bubble fraction={bubble:.3f}", flush=True)
         if cfg.optimizer.use_distributed_optimizer:
-            from megatron_llm_tpu.core.parallel_state import DP_AXIS
+            from megatron_llm_tpu.core.parallel_state import DP_AXIS, EP_AXIS
             from megatron_llm_tpu.optimizer.optimizer import (
                 zero1_sharded_fraction,
             )
 
+            dp_ax = mesh.shape.get(DP_AXIS, 1)
+            ep_ax = mesh.shape.get(EP_AXIS, 1)
             frac = zero1_sharded_fraction(
-                cfg, params, opt_state, mesh.shape.get(DP_AXIS, 1)
+                cfg, params, opt_state, dp_ax, ep_size=ep_ax
             )
+            over = f"dp={dp_ax}" + (f" x ep={ep_ax}" if ep_ax > 1 else "")
             print(f"ZeRO-1: {frac * 100:.1f}% of optimizer-state elements "
-                  f"sharded over dp={mesh.shape.get(DP_AXIS, 1)}", flush=True)
+                  f"sharded over {over}", flush=True)
 
         iteration, consumed_samples = 0, 0
         if cfg.checkpoint.load:

@@ -167,5 +167,42 @@ def test_mistral_round_trip():
     _round_trip(tiny_hf_mistral(), "mistral", "to_hf_llama_state")
 
 
+# ---------------------------------------------------------------------------
+# Mixtral (MoE) — beyond-reference family
+# ---------------------------------------------------------------------------
+
+
+def tiny_hf_mixtral():
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    mc = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, sliding_window=None,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    return MixtralForCausalLM(mc)
+
+
+def test_mixtral_logit_parity():
+    """HF Mixtral routes droplessly; with capacity >= tokens the capacity
+    formulation is exactly dropless, so logits must match at the fp32 gate."""
+    hf = tiny_hf_mixtral()
+    cfg = config_from_hf(hf.config, "mixtral")
+    assert cfg.model.num_experts == 4 and cfg.model.moe_router_topk == 2
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    cfg.model.moe_min_capacity = 4096  # dropless
+    stats = verify(hf, cfg, batch_size=2, seq=48, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 1e-3, f"avg max logit err {avg_max}"
+
+
+def test_mixtral_round_trip():
+    _round_trip(tiny_hf_mixtral(), "mixtral", "to_hf_llama_state")
+
+
 def test_falcon_round_trip():
     _round_trip(tiny_hf_falcon(), "falcon", "to_hf_falcon_state")

@@ -134,7 +134,9 @@ def test_bert_load_warm_start(tmp_path):
     cfg = bert_cfg(proj=8)
     bert_params = init_model_params(cfg, jax.random.PRNGKey(42))
     ckpt = tmp_path / "bert" / "release" / "params"
-    ocp.StandardCheckpointer().save(str(ckpt), bert_params)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(ckpt), bert_params)
+    ckptr.wait_until_finished()  # async save; restore below needs it durable
     (tmp_path / "bert" / "latest_checkpointed_iteration.txt").write_text(
         "release")
 

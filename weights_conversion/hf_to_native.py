@@ -96,22 +96,61 @@ def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
                     "kernel": stack(lambda i: W("self_attn.o_proj", i).T)
                 },
             },
-            "mlp": {
-                # fc1 [h, 2, ffn]: slot 0 = value (up_proj), slot 1 = gated
-                # half (gate_proj) — mlp computes x1 * silu(x2)
-                "fc1": {
-                    "kernel": stack(
-                        lambda i: np.stack(
-                            [W("mlp.up_proj", i).T, W("mlp.gate_proj", i).T],
-                            axis=1,
-                        )
-                    )
-                },
-                "fc2": {"kernel": stack(lambda i: W("mlp.down_proj", i).T)},
-            },
         },
         "final_norm": {"scale": _np(state["model.norm.weight"])},
     }
+    if m.num_experts is not None:
+        # HF Mixtral block_sparse_moe: w2(silu(w1(x)) * w3(x)) per expert —
+        # w3 (up) is our value half (slot 0), w1 (gate) our gated half
+        # (slot 1), w2 (down) our fc2; gate.weight [E, h] -> router [h, E]
+        E = m.num_experts
+
+        def EW(i, e, wname):
+            return _np(state[
+                f"model.layers.{i}.block_sparse_moe.experts.{e}.{wname}.weight"
+            ])
+
+        params["layers"]["moe"] = {
+            "router": {
+                "kernel": stack(
+                    lambda i: _np(
+                        state[f"model.layers.{i}.block_sparse_moe.gate.weight"]
+                    ).T
+                )
+            },
+            "experts": {
+                "fc1": {
+                    "kernel": stack(
+                        lambda i: np.stack([
+                            np.stack([EW(i, e, "w3").T, EW(i, e, "w1").T],
+                                     axis=1)
+                            for e in range(E)
+                        ])
+                    )
+                },
+                "fc2": {
+                    "kernel": stack(
+                        lambda i: np.stack(
+                            [EW(i, e, "w2").T for e in range(E)]
+                        )
+                    )
+                },
+            },
+        }
+    else:
+        params["layers"]["mlp"] = {
+            # fc1 [h, 2, ffn]: slot 0 = value (up_proj), slot 1 = gated
+            # half (gate_proj) — mlp computes x1 * silu(x2)
+            "fc1": {
+                "kernel": stack(
+                    lambda i: np.stack(
+                        [W("mlp.up_proj", i).T, W("mlp.gate_proj", i).T],
+                        axis=1,
+                    )
+                )
+            },
+            "fc2": {"kernel": stack(lambda i: W("mlp.down_proj", i).T)},
+        }
     if not m.tie_embed_logits:
         params["lm_head"] = {
             "kernel": np.ascontiguousarray(emb_pad(_np(state["lm_head.weight"])).T)
@@ -243,6 +282,14 @@ def config_from_hf(hf_config, model_name: str):
         kw["rope_theta"] = getattr(hf_config, "rope_theta", 10000.0)
         if model_name == "mistral":
             kw["sliding_window_size"] = getattr(hf_config, "sliding_window", 4096)
+        if model_name == "mixtral":
+            kw["num_experts"] = hf_config.num_local_experts
+            kw["moe_router_topk"] = hf_config.num_experts_per_tok
+            kw["sliding_window_size"] = getattr(hf_config, "sliding_window", None)
+            # keep the checkpoint's aux-loss weight, not our default
+            kw["moe_aux_loss_coeff"] = float(
+                getattr(hf_config, "router_aux_loss_coef", 0.01)
+            )
     return make_config(model_name, **kw)
 
 
@@ -251,7 +298,8 @@ def main():
     ap.add_argument("--model", required=True, help="HF model path or name")
     ap.add_argument("--out", required=True, help="output checkpoint dir")
     ap.add_argument("--model_name", default="llama2",
-                    choices=["llama", "llama2", "codellama", "mistral", "falcon"])
+                    choices=["llama", "llama2", "codellama", "mistral",
+                             "mixtral", "falcon"])
     args = ap.parse_args()
 
     import orbax.checkpoint as ocp
@@ -263,7 +311,9 @@ def main():
     params = convert_hf_model(model, cfg)
 
     out = os.path.abspath(os.path.join(args.out, "release"))
-    ocp.StandardCheckpointer().save(os.path.join(out, "params"), params)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params)
+    ckptr.wait_until_finished()  # the save is async; don't exit half-written
     with open(os.path.join(args.out, "latest_checkpointed_iteration.txt"), "w") as f:
         f.write("release")
     print(f"saved release checkpoint to {out}")

@@ -194,7 +194,9 @@ def main():
     import orbax.checkpoint as ocp
 
     out = os.path.abspath(os.path.join(args.out, "release"))
-    ocp.StandardCheckpointer().save(os.path.join(out, "params"), params)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params)
+    ckptr.wait_until_finished()  # the save is async; don't exit half-written
     with open(os.path.join(args.out, "latest_checkpointed_iteration.txt"),
               "w") as f:
         f.write("release")

@@ -46,12 +46,25 @@ def to_hf_llama_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str,
         state[f"{pre}.self_attn.o_proj.weight"] = np.ascontiguousarray(
             get("attention", "dense", "kernel").T
         )
-        fc1 = get("mlp", "fc1", "kernel")  # [h, 2, ffn]
-        state[f"{pre}.mlp.up_proj.weight"] = np.ascontiguousarray(fc1[:, 0, :].T)
-        state[f"{pre}.mlp.gate_proj.weight"] = np.ascontiguousarray(fc1[:, 1, :].T)
-        state[f"{pre}.mlp.down_proj.weight"] = np.ascontiguousarray(
-            get("mlp", "fc2", "kernel").T
-        )
+        if m.num_experts is not None:
+            # inverse of the mixtral branch in convert_llama_state
+            state[f"{pre}.block_sparse_moe.gate.weight"] = (
+                np.ascontiguousarray(get("moe", "router", "kernel").T)
+            )
+            fc1 = get("moe", "experts", "fc1", "kernel")  # [E, h, 2, ffn]
+            fc2 = get("moe", "experts", "fc2", "kernel")  # [E, ffn, h]
+            for e in range(m.num_experts):
+                epre = f"{pre}.block_sparse_moe.experts.{e}"
+                state[f"{epre}.w3.weight"] = np.ascontiguousarray(fc1[e, :, 0, :].T)
+                state[f"{epre}.w1.weight"] = np.ascontiguousarray(fc1[e, :, 1, :].T)
+                state[f"{epre}.w2.weight"] = np.ascontiguousarray(fc2[e].T)
+        else:
+            fc1 = get("mlp", "fc1", "kernel")  # [h, 2, ffn]
+            state[f"{pre}.mlp.up_proj.weight"] = np.ascontiguousarray(fc1[:, 0, :].T)
+            state[f"{pre}.mlp.gate_proj.weight"] = np.ascontiguousarray(fc1[:, 1, :].T)
+            state[f"{pre}.mlp.down_proj.weight"] = np.ascontiguousarray(
+                get("mlp", "fc2", "kernel").T
+            )
         state[f"{pre}.input_layernorm.weight"] = get("input_norm", "scale")
         state[f"{pre}.post_attention_layernorm.weight"] = get("post_norm", "scale")
     return state
@@ -149,6 +162,16 @@ def hf_config_from_native(cfg, vocab_size: int):
         common["rope_scaling"] = rope_scaling
     if cfg.model_name == "mistral":
         return MistralConfig(sliding_window=m.sliding_window_size, **common)
+    if cfg.model_name == "mixtral":
+        from transformers import MixtralConfig
+
+        return MixtralConfig(
+            sliding_window=m.sliding_window_size,
+            num_local_experts=m.num_experts,
+            num_experts_per_tok=m.moe_router_topk,
+            router_aux_loss_coef=m.moe_aux_loss_coeff,
+            **common,
+        )
     return LlamaConfig(**common)
 
 
