@@ -1,5 +1,5 @@
 """HuggingFace -> native weight conversion (Llama/Llama-2/CodeLlama/Mistral/
-Falcon).
+Mixtral/Falcon).
 
 Reference: weights_conversion/hf_to_megatron.py (llama_to_megatron:116,
 falcon_to_megatron:59). Differences by design: output is ONE tp/pp-agnostic
@@ -55,7 +55,8 @@ def unpack_qkv(kernel: np.ndarray, n: int, nkv: int, d: int):
 
 
 def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
-    """HF Llama/Mistral state_dict -> native params pytree (numpy, fp32)."""
+    """HF Llama/Mistral/Mixtral state_dict -> native params pytree (numpy,
+    fp32); Mixtral swaps the dense MLP subtree for router + expert stacks."""
     m = cfg.model
     n, nkv, d, h = (m.num_attention_heads, m.num_attention_heads_kv,
                     m.kv_channels, m.hidden_size)
@@ -66,8 +67,6 @@ def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
         out = np.zeros((vpad, h), np.float32)
         out[: w.shape[0]] = w
         return out
-
-    layers: Dict[str, Any] = {}
 
     def stack(key_fn):
         return np.stack([key_fn(i) for i in range(L)])
