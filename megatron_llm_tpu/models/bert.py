@@ -65,6 +65,31 @@ def padding_bias(padding_mask: jax.Array) -> jax.Array:
     return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
 
 
+def mlm_head_logits(cfg, params: Params, hidden: jax.Array) -> jax.Array:
+    """BertLMHead (bert_model.py:47-90) over final-normed hidden states:
+    dense h->h + gelu + LN + tied-embedding logits + vocab bias."""
+    m = cfg.model
+    head = params["mlm_head"]
+    x = hidden @ head["dense"]["kernel"].astype(hidden.dtype)
+    x = x + head["dense"]["bias"].astype(hidden.dtype)
+    x = jax.nn.gelu(x, approximate=False)
+    x = norm(x, head["norm"], m.layernorm_epsilon, m.use_rms_norm)
+    emb = params["embedding"]["word_embeddings"].astype(x.dtype)
+    return x @ emb.T + head["vocab_bias"].astype(x.dtype)
+
+
+def binary_head_logits(cfg, params: Params, hidden: jax.Array) -> jax.Array:
+    """Pooler (tanh over CLS) + NSP/SOP binary head (bert_model.py:125,162)."""
+    pooled = jnp.tanh(
+        hidden[:, 0] @ params["pooler"]["kernel"].astype(hidden.dtype)
+        + params["pooler"]["bias"].astype(hidden.dtype)
+    )
+    return (
+        pooled @ params["binary_head"]["kernel"].astype(pooled.dtype)
+        + params["binary_head"]["bias"].astype(pooled.dtype)
+    )
+
+
 def bert_forward(
     cfg,
     params: Params,
@@ -85,27 +110,78 @@ def bert_forward(
     )
     hidden = norm(hidden, params["final_norm"], m.layernorm_epsilon,
                   m.use_rms_norm)
-
-    # MLM head
-    head = params["mlm_head"]
-    x = hidden @ head["dense"]["kernel"].astype(hidden.dtype)
-    x = x + head["dense"]["bias"].astype(hidden.dtype)
-    x = jax.nn.gelu(x, approximate=False)
-    x = norm(x, head["norm"], m.layernorm_epsilon, m.use_rms_norm)
-    emb = params["embedding"]["word_embeddings"].astype(x.dtype)
-    lm_logits = x @ emb.T + head["vocab_bias"].astype(x.dtype)
-
-    binary_logits = None
-    if m.bert_binary_head:
-        pooled = jnp.tanh(
-            hidden[:, 0] @ params["pooler"]["kernel"].astype(hidden.dtype)
-            + params["pooler"]["bias"].astype(hidden.dtype)
-        )
-        binary_logits = (
-            pooled @ params["binary_head"]["kernel"].astype(pooled.dtype)
-            + params["binary_head"]["bias"].astype(pooled.dtype)
-        )
+    lm_logits = mlm_head_logits(cfg, params, hidden)
+    binary_logits = (
+        binary_head_logits(cfg, params, hidden) if m.bert_binary_head else None
+    )
     return lm_logits, binary_logits
+
+
+def bert_pipeline_hooks(cfg, batch: Dict[str, jax.Array]):
+    """Pipeline-parallel hooks for BERT (training_step pipeline_hooks
+    contract): maps the BERT batch onto the pipeline engine's
+    tokens/labels/loss_mask/aux layout and supplies embed/head fns.
+
+    The reference runs BERT under its loss-agnostic schedules via
+    forward_step_func (pretrain_bert.py + schedules.py); here the engine is
+    loss-agnostic via these hooks instead.
+
+    Padding is expressed as segment ids (pad positions get segment 1, real
+    positions 0) rather than the additive bias bert_forward uses: the
+    per-row attention outputs of REAL tokens are identical under either
+    formulation (a real token attends exactly to the real tokens both
+    ways), and only real-token rows reach the loss (MLM mask, CLS pooler) —
+    so pipelined losses match bert_loss_from_batch exactly.
+    """
+    m = cfg.model
+    if (cfg.parallel.context_parallel_size > 1
+            and cfg.parallel.pipeline_schedule == "1f1b"):
+        # the SOP pooler reads hidden[:, 0], which is cp-LOCAL inside the
+        # 1F1B shard_map (each cp rank holds a seq chunk) and the engine
+        # psums the loss over cp — the CLS term would be multiply-counted
+        # from garbage tokens. GPipe runs the head outside the shard_map on
+        # the full sequence, so it composes fine.
+        raise ValueError(
+            "BERT pipeline parallelism with context_parallel_size > 1 "
+            "requires pipeline_schedule='gpipe' (the 1F1B head is cp-local)"
+        )
+    pipe_batch = {
+        "tokens": batch["text"],
+        "labels": batch["labels"],
+        "loss_mask": batch["loss_mask"],
+        # segment 0 = real tokens, 1 = padding: attention() blocks
+        # cross-segment pairs, reproducing padding_bias for real rows
+        "segment_ids": 1 - batch["padding_mask"].astype(jnp.int32),
+    }
+    if batch.get("types") is not None:
+        pipe_batch["types"] = batch["types"]
+    if batch.get("is_random") is not None:
+        pipe_batch["is_random"] = batch["is_random"]
+
+    mlm_denom = jnp.maximum(batch["loss_mask"].astype(jnp.float32).sum(), 1.0)
+    gbs = batch["text"].shape[0]
+
+    def embed_fn(outer_p, tok, aux, ke):
+        # no embedding dropout: matches bert_forward (the pp=1 path), so
+        # pipeline_model_parallel_size does not change regularization
+        return embed_tokens(cfg, outer_p, tok, tokentype_ids=aux.get("types"))
+
+    def head_loss_fn(outer_p, hidden, lbl, msk, aux):
+        hidden = norm(hidden, outer_p["final_norm"], m.layernorm_epsilon,
+                      m.use_rms_norm)
+        lm_logits = mlm_head_logits(cfg, outer_p, hidden)
+        per_token = softmax_cross_entropy(lm_logits, lbl)
+        loss = (per_token * msk.astype(jnp.float32)).sum() / mlm_denom
+        if m.bert_binary_head and "is_random" in aux:
+            binary_logits = binary_head_logits(cfg, outer_p, hidden)
+            logp = jax.nn.log_softmax(binary_logits.astype(jnp.float32), -1)
+            sop_sum = -jnp.take_along_axis(
+                logp, aux["is_random"][:, None].astype(jnp.int32), axis=-1
+            ).sum()
+            loss = loss + sop_sum / gbs
+        return loss
+
+    return pipe_batch, embed_fn, head_loss_fn
 
 
 def bert_loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,

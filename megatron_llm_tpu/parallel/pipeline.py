@@ -215,14 +215,13 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
     # path (parallel/ring.py) — one shard_map, no nesting.
     P = jax.sharding.PartitionSpec
     hidden_spec = P(None, None, CP_AXIS, None)  # [M, mb, s, h]
-    aux_spec = P(None, None, CP_AXIS)           # [M, mb, s]
     fn = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             hidden_spec,
-            jax.tree.map(lambda _: aux_spec, aux_mb),
+            jax.tree.map(_aux_data_spec, aux_mb),
             P(CP_AXIS),
             P(),
         ),
@@ -238,10 +237,61 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def _aux_data_spec(leaf):
+    """shard_map in-spec for one [M, mb, ...] aux leaf: the seq axis (dim 2)
+    shards over cp; per-sample leaves (e.g. BERT is_random [M, mb]) replicate."""
+    P = jax.sharding.PartitionSpec
+    if leaf.ndim >= 3:
+        return P(None, None, CP_AXIS)
+    return P(*([None] * leaf.ndim))
+
+
+def _split_extra_keys(batch, split):
+    """Microbatch-split every batch key outside the engine's positional
+    tokens/labels/loss_mask/token_idx contract — they reach the stage body
+    (segment_ids gates attention) and the embed/head hooks as ``aux``."""
+    return {
+        k: split(v) for k, v in batch.items()
+        if k not in ("tokens", "labels", "loss_mask", "token_idx")
+        and v is not None
+    }
+
+
+def _default_gpt_fns(cfg, batch, use_dropout):
+    """Default GPT-family hooks shared by every schedule: embedding (+optional
+    dropout) and final-norm + LM head + globally-normalized masked CE.
+    head_loss_fn returns the UNSCALED per-microbatch contribution."""
+    denom = jnp.maximum(batch["loss_mask"].astype(jnp.float32).sum(), 1.0)
+
+    def embed_fn(outer_p, tok, aux, ke):
+        h = lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
+        if use_dropout and ke is not None:
+            h = rng_mod.dropout(ke, cfg.model.hidden_dropout, h)
+        return h
+
+    def head_loss_fn(outer_p, hidden, lbl, msk, aux):
+        h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
+                 cfg.model.use_rms_norm)
+        logits = lm.compute_logits(cfg, outer_p, h)
+        per_token = softmax_cross_entropy(logits, lbl)
+        return (per_token * msk.astype(jnp.float32)).sum() / denom
+
+    return embed_fn, head_loss_fn
+
+
 def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
                 loss_scale, rope):
     """Shared preamble of both 1F1B schedules: microbatch splits, dropout
-    keys, params split, compute dtype, and the default GPT embed/head fns."""
+    keys, params split, compute dtype, and the default GPT embed/head fns.
+
+    ``head_loss_fn(outer_p, hidden, labels, mask, aux)`` returns the
+    UNSCALED loss contribution of one microbatch (normalizers are closures
+    over the full batch); the engine applies the fp16 loss scale. Custom
+    families (e.g. BERT, models/bert.py:bert_pipeline_hooks) override both
+    fns; every batch key other than tokens/labels/loss_mask/token_idx is
+    microbatch-split into ``aux`` and reaches both hooks and the stage body
+    (where ``segment_ids`` gates attention).
+    """
     M = num_micro or cfg.parallel.num_micro_batches or 1
     gbs = batch["tokens"].shape[0]
     assert gbs % M == 0
@@ -255,10 +305,7 @@ def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
     s["tokens"] = split(batch["tokens"])
     s["labels"] = split(batch["labels"])
     s["loss_mask"] = split(batch["loss_mask"]).astype(jnp.float32)
-    s["aux_mb"] = {
-        k: split(batch[k]) for k in ("position_ids", "segment_ids")
-        if batch.get(k) is not None
-    }
+    s["aux_mb"] = _split_extra_keys(batch, split)
     s["token_idx"] = batch.get("token_idx")
     s["denom"] = jnp.maximum(s["loss_mask"].sum(), 1.0)
     s["dtype"] = (
@@ -280,24 +327,20 @@ def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
         layer_keys = jnp.zeros((M, 2), jnp.uint32)
     s["embed_keys"], s["layer_keys"] = embed_keys, layer_keys
 
+    default_embed, default_head = _default_gpt_fns(cfg, batch, use_dropout)
     if embed_fn is None:
-        def embed_fn(outer_p, tok, aux, ke):
-            h = lm.embed_tokens(cfg, outer_p, tok, aux.get("position_ids"))
-            if use_dropout:
-                h = rng_mod.dropout(ke, cfg.model.hidden_dropout, h)
-            return h
-
+        embed_fn = default_embed
     if head_loss_fn is None:
-        denom, scale = s["denom"], s["scale"]
+        head_loss_fn = default_head
 
-        def head_loss_fn(outer_p, hidden, lbl, msk):
-            h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
-                     cfg.model.use_rms_norm)
-            logits = lm.compute_logits(cfg, outer_p, h)
-            per_token = softmax_cross_entropy(logits, lbl)
-            return (per_token * msk).sum() / denom * scale
+    # the engine owns the fp16 loss scale so hooks stay scale-agnostic
+    scale = s["scale"]
+    unscaled = head_loss_fn
 
-    s["embed_fn"], s["head_loss_fn"] = embed_fn, head_loss_fn
+    def scaled_head(outer_p, hidden, lbl, msk, aux):
+        return unscaled(outer_p, hidden, lbl, msk, aux) * scale
+
+    s["embed_fn"], s["head_loss_fn"] = embed_fn, scaled_head
     s["token_idx_arr"] = (
         jnp.full((s["tokens"].shape[2],), -1, jnp.int32)
         if s["token_idx"] is None else s["token_idx"]
@@ -333,8 +376,11 @@ def pipeline_1f1b_loss_and_grads(
     (random.py:175-245). Pass ``dropout_key`` to enable.
 
     Custom model families can override ``embed_fn(outer_params, tokens, aux,
-    key)`` and ``head_loss_fn(outer_params, hidden, labels, mask) -> scaled
-    loss`` (defaults implement the GPT/Llama family).
+    key)`` and ``head_loss_fn(outer_params, hidden, labels, mask, aux) ->
+    UNSCALED per-microbatch loss contribution`` — the engine applies the
+    fp16 loss scale itself; normalizers should be closures over the full
+    batch (defaults implement the GPT/Llama family; BERT:
+    models/bert.py:bert_pipeline_hooks).
 
     Returns (loss, grads) with grads matching the params tree.
     """
@@ -391,7 +437,7 @@ def pipeline_1f1b_loss_and_grads(
 
             # ---- forward: embed on stage 0, else the ppermuted stream ----
             x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx),
-                             embed_keys[f_idx])
+                             embed_keys[f_idx] if use_dropout else None)
             x_in = jnp.where(stage == 0, x_emb, x_recv).astype(dtype)
             # guard the save: during cooldown f_idx clips to M-1, whose slot
             # may still be awaiting its backward
@@ -404,7 +450,7 @@ def pipeline_1f1b_loss_and_grads(
             # ---- head + loss on the last stage's fresh output ----
             loss_f, head_vjp = jax.vjp(
                 lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
-                                            loss_mask[f_idx]),
+                                            loss_mask[f_idx], aux_at(f_idx)),
                 outer_p, y,
             )
             use_head = jnp.logical_and(stage == last, do_f)
@@ -434,7 +480,7 @@ def pipeline_1f1b_loss_and_grads(
             # ---- embedding backward on stage 0 ----
             _, emb_vjp = jax.vjp(
                 lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx),
-                                    embed_keys[b_idx]),
+                                    embed_keys[b_idx] if use_dropout else None),
                 outer_p,
             )
             (d_outer_emb,) = emb_vjp(dx)
@@ -479,7 +525,7 @@ def pipeline_1f1b_loss_and_grads(
             jax.tree.map(lambda _: P(PP_AXIS), layers),
             jax.tree.map(lambda _: P(), outer),
             data_spec, data_spec, data_spec,
-            jax.tree.map(lambda _: data_spec, aux_mb),
+            jax.tree.map(_aux_data_spec, aux_mb),
             P(CP_AXIS),
             P(), P(),
         ),
@@ -607,7 +653,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             last_hop = jnp.logical_and(stage == last, c_f == v - 1)
 
             x_emb = embed_fn(outer_p, tokens[f_idx], aux_at(f_idx),
-                             embed_keys[f_idx])
+                             embed_keys[f_idx] if use_dropout else None)
             x_in = jnp.where(first_hop, x_emb, x_recv).astype(dtype)
             slot_f = jnp.where(do_f, u % depth, depth - 1)
             saved_upd = jax.lax.dynamic_update_index_in_dim(
@@ -620,7 +666,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             # ---- head vjp at the final forward hop; dy parked one tick ----
             loss_f, head_vjp = jax.vjp(
                 lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
-                                            loss_mask[f_idx]),
+                                            loss_mask[f_idx], aux_at(f_idx)),
                 outer_p, y,
             )
             use_head = jnp.logical_and(last_hop, do_f)
@@ -669,7 +715,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             # ---- embedding backward at the last backward hop ----
             _, emb_vjp = jax.vjp(
                 lambda op: embed_fn(op, tokens[b_idx], aux_at(b_idx),
-                                    embed_keys[b_idx]),
+                                    embed_keys[b_idx] if use_dropout else None),
                 outer_p,
             )
             (d_outer_emb,) = emb_vjp(dx)
@@ -712,7 +758,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             jax.tree.map(lambda _: P(), outer),
             data_spec, data_spec, data_spec,
-            jax.tree.map(lambda _: data_spec, aux_mb),
+            jax.tree.map(_aux_data_spec, aux_mb),
             P(CP_AXIS),
             P(), P(),
         ),
@@ -741,11 +787,15 @@ def pipeline_1f1b_interleaved_loss_and_grads(
 
 def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
                      dropout_key=None, deterministic=True, rope=None,
-                     sp_constraint=None, num_micro=None):
+                     sp_constraint=None, num_micro=None,
+                     embed_fn=None, head_loss_fn=None):
     """Full pipelined loss over the global batch (microbatched).
 
     batch leaves [gbs, s]; gbs = M * mb. Embedding/head run outside the
-    pipeline (see module docstring).
+    pipeline (see module docstring). ``embed_fn``/``head_loss_fn`` follow the
+    1F1B hook contract (_1f1b_setup): unscaled per-microbatch contributions,
+    normalizers closed over the full batch; defaults implement the GPT
+    family.
     """
     M = num_micro or cfg.parallel.num_micro_batches or 1
     gbs = batch["tokens"].shape[0]
@@ -758,10 +808,7 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     tokens = split(batch["tokens"])
     labels = split(batch["labels"])
     loss_mask = split(batch["loss_mask"])
-    aux_mb = {}
-    for k in ("position_ids", "segment_ids"):
-        if batch.get(k) is not None:
-            aux_mb[k] = split(batch[k])
+    aux_mb = _split_extra_keys(batch, split)
     token_idx = batch.get("token_idx")  # [s], batch-invariant (zigzag cp)
 
     if rope is None:
@@ -772,44 +819,46 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
         dropout_key if use_dropout else None, M
     )
 
+    outer = {k: v for k, v in params.items() if k != "layers"}
+    default_embed, default_head = _default_gpt_fns(cfg, batch, use_dropout)
+    if embed_fn is None:
+        embed_fn = default_embed
+    if head_loss_fn is None:
+        head_loss_fn = default_head
+
+    def aux_at(i):
+        return jax.tree.map(lambda a: a[i], aux_mb)
+
     # [M, mb, s, h] embeddings (vocab-parallel over tp under pjit); dropout
     # keys per microbatch, matching the pp=1 path (model_forward:149-152)
-    if use_dropout:
+    if embed_keys is not None:
         hidden = jax.vmap(
-            lambda t, ke: rng_mod.dropout(
-                ke, cfg.model.hidden_dropout,
-                lm.embed_tokens(cfg, params, t, None))
-        )(tokens, embed_keys)
+            lambda t, a, ke: embed_fn(outer, t, a, ke)
+        )(tokens, aux_mb, embed_keys)
     else:
-        hidden = jax.vmap(lambda t: lm.embed_tokens(cfg, params, t, None))(tokens)
+        hidden = jax.vmap(lambda t, a: embed_fn(outer, t, a, None))(tokens, aux_mb)
 
     hidden = pipeline_apply(
         cfg, mesh, params["layers"], hidden, aux_mb, dropout_key,
         deterministic, rope, token_idx=token_idx, mb_keys=layer_keys,
     )
 
-    # Head + CE one microbatch at a time: materializing [M, mb, s, v] logits
-    # for the whole global batch (vocab 32k, seq 4k, M=16 -> tens of GB)
-    # would defeat microbatching. Matches the non-pp path's discipline
+    # Head + loss one microbatch at a time: materializing [M, mb, s, v]
+    # logits for the whole global batch (vocab 32k, seq 4k, M=16 -> tens of
+    # GB) would defeat microbatching. Matches the non-pp path's discipline
     # (training_step.py grad-accumulation scan).
     # remat: without it the scan's VJP saves each iteration's logits as
     # residuals — cumulatively the same [M, mb, s, v] footprint again
     @functools.partial(jax.checkpoint, policy=None)
-    def ce_loss_sum(hid, lbl, msk):
-        h = norm(hid, params["final_norm"], cfg.model.layernorm_epsilon,
-                 cfg.model.use_rms_norm)
-        logits = lm.compute_logits(cfg, params, h)  # [mb, s, v]
-        per_token = softmax_cross_entropy(logits, lbl)
-        return (per_token * msk.astype(jnp.float32)).sum()
+    def head_mb(hid, lbl, msk, i):
+        return head_loss_fn(outer, hid, lbl, msk, aux_at(i))
 
-    def ce_mb(carry, inp):
-        hid, lbl, msk = inp
-        loss_sum, mask_sum = carry
-        return (loss_sum + ce_loss_sum(hid, lbl, msk),
-                mask_sum + msk.astype(jnp.float32).sum()), None
+    def acc_mb(loss_sum, inp):
+        hid, lbl, msk, i = inp
+        return loss_sum + head_mb(hid, lbl, msk, i), None
 
-    (loss_sum, mask_sum), _ = jax.lax.scan(
-        ce_mb, (jnp.float32(0.0), jnp.float32(0.0)), (hidden, labels, loss_mask)
+    loss, _ = jax.lax.scan(
+        acc_mb, jnp.float32(0.0),
+        (hidden, labels, loss_mask, jnp.arange(M)),
     )
-    loss = loss_sum / jnp.maximum(mask_sum, 1.0)
     return loss, {"lm loss": loss}

@@ -69,7 +69,7 @@ def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
 def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
                     mesh: Optional[Mesh] = None,
                     num_micro: Optional[int] = None,
-                    loss_fn=None):
+                    loss_fn=None, pipeline_hooks=None):
     """Build the pure train_step(params, opt_state, batch, iteration, seed).
 
     Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
@@ -78,6 +78,13 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
 
     ``num_micro`` overrides cfg.parallel.num_micro_batches (batch-size
     ramp-up builds one step per stage, microbatches.py semantics).
+
+    ``pipeline_hooks`` enables non-GPT losses under pipeline parallelism
+    (the reference's schedules are loss-agnostic via forward_step_func;
+    here a hooks builder ``(cfg, batch) -> (pipe_batch, embed_fn,
+    head_loss_fn)`` maps the family's batch onto the pipeline engine's
+    tokens/labels/loss_mask/aux contract — see
+    models/bert.py:bert_pipeline_hooks).
     """
     sp_constraint = make_sp_constraint(cfg)
     lr_fn = lr_schedule(cfg)
@@ -129,9 +136,14 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
         loss_mets = None
         if pp > 1:
             # pipelined path: the microbatch loop lives inside the pipeline
-            assert loss_fn is loss_from_batch, (
-                "pipeline parallelism currently supports the GPT-family LM "
-                "loss only"
+            assert loss_fn is loss_from_batch or pipeline_hooks is not None, (
+                "pipeline parallelism needs the GPT-family LM loss or a "
+                "pipeline_hooks builder for the family (models/bert.py:"
+                "bert_pipeline_hooks is the template)"
+            )
+            pipe_batch, embed_fn, head_loss_fn = (
+                pipeline_hooks(cfg, batch) if pipeline_hooks is not None
+                else (batch, None, None)
             )
             deterministic = (
                 cfg.model.hidden_dropout == 0.0
@@ -146,10 +158,11 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                 )
 
                 loss, grads = pipeline_1f1b_interleaved_loss_and_grads(
-                    cfg, mesh, params, batch, rope=rope,
+                    cfg, mesh, params, pipe_batch, rope=rope,
                     loss_scale=jax.lax.stop_gradient(scale),
                     num_micro=num_micro,
                     dropout_key=None if deterministic else base_key,
+                    embed_fn=embed_fn, head_loss_fn=head_loss_fn,
                 )
             elif cfg.parallel.pipeline_schedule == "1f1b":
                 # true 1F1B: grads computed inside the tick loop, O(pp)
@@ -159,10 +172,11 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                 )
 
                 loss, grads = pipeline_1f1b_loss_and_grads(
-                    cfg, mesh, params, batch, rope=rope,
+                    cfg, mesh, params, pipe_batch, rope=rope,
                     loss_scale=jax.lax.stop_gradient(scale),
                     num_micro=num_micro,
                     dropout_key=None if deterministic else base_key,
+                    embed_fn=embed_fn, head_loss_fn=head_loss_fn,
                 )
             else:
                 # GPipe-style: autodiff through the tick scan
@@ -170,10 +184,11 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
 
                 loss, grads = jax.value_and_grad(
                     lambda p: pipeline_loss_fn(
-                        cfg, mesh, p, batch,
+                        cfg, mesh, p, pipe_batch,
                         dropout_key=None if deterministic else base_key,
                         deterministic=deterministic, rope=rope,
                         sp_constraint=sp_constraint, num_micro=num_micro,
+                        embed_fn=embed_fn, head_loss_fn=head_loss_fn,
                     )[0] * jax.lax.stop_gradient(scale)
                 )(params)
         elif num_micro == 1:
@@ -243,7 +258,7 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
                            num_micro: Optional[int] = None,
                            optimizer: Optional[optax.GradientTransformation] = None,
                            opt_state: Any = None,
-                           loss_fn=None):
+                           loss_fn=None, pipeline_hooks=None):
     """Bind shardings and jit. Returns (step_fn, optimizer, shardings dict).
 
     Donates params/opt_state (the XLA analog of the reference's in-place
@@ -263,7 +278,7 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
     scalar = NamedSharding(mesh, P())
 
     step = make_train_step(cfg, optimizer, mesh=mesh, num_micro=num_micro,
-                           loss_fn=loss_fn)
+                           loss_fn=loss_fn, pipeline_hooks=pipeline_hooks)
     # batch in_sharding is UNSPECIFIED (follows the committed input): batches
     # may carry the [s] token_idx vector whose sharding differs per key —
     # callers place batches with place_batch / batch_shardings.

@@ -79,11 +79,14 @@ def main():
     if "--model_name" not in argv:
         argv = ["--model_name", "bert"] + argv
     cfg = parse_args(argv, n_devices=len(jax.devices()))
+    from megatron_llm_tpu.models.bert import bert_pipeline_hooks
+
     result = pretrain(
         cfg,
         data_iterators_provider=bert_data_provider,
         params_provider=lambda key: init_bert_params(cfg, key),
         loss_fn=bert_loss_from_batch,
+        pipeline_hooks=bert_pipeline_hooks,
     )
     print(f"training done: {result['iteration']} iterations "
           f"({result['exit_reason']})")
