@@ -66,6 +66,12 @@ class ModelConfig:
     use_scaled_init_method: bool = True
     # LIMA per-layer dropout: linearly ramp hidden_dropout from 0 to value.
     lima_dropout: bool = False
+    # FP8 matmuls (TransformerEngine-path analog, ops/fp8.py):
+    # None | 'e4m3' (reference --fp8_e4m3) | 'hybrid' (--fp8_hybrid:
+    # e4m3 forward, e5m2 gradients). Functional on any backend; a
+    # throughput win only on fp8-capable TPU generations.
+    fp8: Optional[str] = None
+    fp8_margin: int = 0  # back off scales by 2^-margin (reference --fp8_margin)
     # BERT next-sentence/sentence-order binary head (bert_model.py:125)
     bert_binary_head: bool = False
     # bidirectional (non-causal) self-attention — BERT / T5 encoder

@@ -139,6 +139,16 @@ def _linear(p: Params, x: jax.Array) -> jax.Array:
     return y
 
 
+def _linear_impl(cfg):
+    """The projection implementation for this config: plain bf16/fp32
+    matmul, or fp8 GEMMs when cfg.model.fp8 is set (ops/fp8.py — the
+    TransformerEngine-path analog; embedding/logits/softmax stay in high
+    precision exactly as TE keeps them out of fp8)."""
+    from megatron_llm_tpu.ops.fp8 import linear_for_config
+
+    return linear_for_config(cfg) or _linear
+
+
 def split_qkv(
     qkv: jax.Array, n_heads: int, n_kv_heads: int, head_dim: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -174,7 +184,8 @@ def attention_sublayer(
     b, s, _ = x.shape
     n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
 
-    qkv = _linear(p["qkv"], x)
+    linear = _linear_impl(cfg)
+    qkv = linear(p["qkv"], x)
     q, k, v = split_qkv(qkv, n, nkv, d)
 
     if rope is not None:
@@ -224,7 +235,7 @@ def attention_sublayer(
     from jax.ad_checkpoint import checkpoint_name
 
     ctx = checkpoint_name(ctx, "attn_out")
-    out = _linear(p["dense"], ctx.reshape(b, s, n * d))
+    out = linear(p["dense"], ctx.reshape(b, s, n * d))
     return out, new_cache
 
 
@@ -243,8 +254,9 @@ def cross_attention_sublayer(
     m = cfg.model
     b, sq, _ = x.shape
     n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
-    q = _linear(p["q"], x).reshape(b, sq, n, d)
-    kv = _linear(p["kv"], encoder_hidden)
+    linear = _linear_impl(cfg)
+    q = linear(p["q"], x).reshape(b, sq, n, d)
+    kv = linear(p["kv"], encoder_hidden)
     skv = encoder_hidden.shape[1]
     kv = kv.reshape(b, skv, nkv, 2, d)
     k, v = kv[..., 0, :], kv[..., 1, :]
@@ -253,7 +265,7 @@ def cross_attention_sublayer(
         dropout_rate=0.0 if deterministic else m.attention_dropout,
         dropout_key=dropout_key,
     )
-    return _linear(p["dense"], ctx.reshape(b, sq, n * d))
+    return linear(p["dense"], ctx.reshape(b, sq, n * d))
 
 
 def ffn_sublayer(cfg, p: Params, x: jax.Array):
@@ -274,16 +286,20 @@ def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
     (glu_activations.py:14-16).
     """
     m = cfg.model
+    linear = _linear_impl(cfg)
     if m.glu_activation is not None:
         act = GLU_BASE_ACTIVATIONS[m.glu_activation]
-        fc1 = p["fc1"]
-        y = jnp.einsum("...h,hcf->...cf", x, fc1["kernel"].astype(x.dtype))
-        if "bias" in fc1:
-            y = y + fc1["bias"].astype(x.dtype)
+        if linear is not _linear:
+            y = linear(p["fc1"], x)  # fp8 path flattens/restores [h, 2, f]
+        else:
+            fc1 = p["fc1"]
+            y = jnp.einsum("...h,hcf->...cf", x, fc1["kernel"].astype(x.dtype))
+            if "bias" in fc1:
+                y = y + fc1["bias"].astype(x.dtype)
         gated = y[..., 0, :] * act(y[..., 1, :])
-        return _linear(p["fc2"], gated)
+        return linear(p["fc2"], gated)
     act = get_mlp_activation(None, m.activation)
-    return _linear(p["fc2"], act(_linear(p["fc1"], x)))
+    return linear(p["fc2"], act(linear(p["fc1"], x)))
 
 
 # ---------------------------------------------------------------------------
