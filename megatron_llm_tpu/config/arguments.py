@@ -390,10 +390,19 @@ class Config:
                 f"num_experts {self.model.num_experts} not divisible by "
                 f"expert_parallel_size {ep}"
             )
-            assert self.parallel.pipeline_model_parallel_size == 1, (
-                "MoE is currently supported with pipeline_model_parallel_size"
-                " == 1 (dp/ep/tp/cp/sp compose freely)"
-            )
+            if self.parallel.pipeline_model_parallel_size > 1:
+                # GPipe differentiates the router aux loss through the tick
+                # scan; the 1F1B schedules compute grads with explicit vjps
+                # that do not carry the aux term (parallel/pipeline.py)
+                assert self.parallel.pipeline_schedule == "gpipe", (
+                    "MoE with pipeline parallelism requires "
+                    "pipeline_schedule='gpipe' (1F1B drops the router "
+                    "aux-loss gradient)"
+                )
+                assert self.parallel.context_parallel_size == 1, (
+                    "MoE with pipeline parallelism requires "
+                    "context_parallel_size == 1"
+                )
             assert self.model.moe_router_topk <= self.model.num_experts
             if self.parallel.data_parallel_size is not None:
                 # auto-inferred dp (None) is validated later by build_mesh

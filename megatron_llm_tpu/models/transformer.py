@@ -133,7 +133,14 @@ def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None,
 
 
 def _linear(p: Params, x: jax.Array) -> jax.Array:
-    y = x @ p["kernel"].astype(x.dtype)
+    kernel = p["kernel"].astype(x.dtype)
+    if kernel.ndim == 3:
+        # GLU fc1 [h, 2, ffn]: flatten for one GEMM, restore the chunk axis
+        # (same contract as ops/fp8.fp8_linear)
+        y = x @ kernel.reshape(kernel.shape[0], -1)
+        y = y.reshape(*y.shape[:-1], *kernel.shape[1:])
+    else:
+        y = x @ kernel
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
@@ -289,13 +296,7 @@ def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
     linear = _linear_impl(cfg)
     if m.glu_activation is not None:
         act = GLU_BASE_ACTIVATIONS[m.glu_activation]
-        if linear is not _linear:
-            y = linear(p["fc1"], x)  # fp8 path flattens/restores [h, 2, f]
-        else:
-            fc1 = p["fc1"]
-            y = jnp.einsum("...h,hcf->...cf", x, fc1["kernel"].astype(x.dtype))
-            if "bias" in fc1:
-                y = y + fc1["bias"].astype(x.dtype)
+        y = linear(p["fc1"], x)  # [..., 2, ffn] (both impls restore the axis)
         gated = y[..., 0, :] * act(y[..., 1, :])
         return linear(p["fc2"], gated)
     act = get_mlp_activation(None, m.activation)

@@ -52,6 +52,10 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
     ``dropout_key`` is the per-microbatch key (the same one the pp=1 path
     hands to transformer_forward, which folds it per *global* layer index) —
     so with cp=1, pipelined dropout is bit-identical to the pp=1 run.
+
+    Returns (hidden, moe_aux[2]) — the stage-local MoE router losses
+    (zeros for dense models; the GPipe schedule accumulates them, the 1F1B
+    schedules require dense models, config finalize enforces it).
     """
     stage = jax.lax.axis_index(PP_AXIS)
     if dropout_key is not None and cfg.parallel.context_parallel_size > 1:
@@ -63,7 +67,7 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
     layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
     if layer_offset is None:
         layer_offset = stage * layers_per_stage
-    hidden, _, _moe_aux = transformer_forward(
+    hidden, _, moe_aux = transformer_forward(
         cfg, layers_local, x,
         rope=rope,
         position_ids=aux.get("position_ids"),
@@ -73,7 +77,7 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
         deterministic=deterministic,
         layer_offset=layer_offset,
     )
-    return hidden
+    return hidden, moe_aux
 
 
 def microbatch_keys(base_key, M: int):
@@ -166,7 +170,7 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
         layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)  # [v, Lc, ...]
 
         def tick(carry, t):
-            recv, out_buf = carry
+            recv, out_buf, aux_acc = carry
             # schedule position: stage s at tick t serves chain position
             # u = t - s; groups of pp microbatches, chunk-major within group
             u = t - stage
@@ -185,12 +189,15 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
                 lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
                 layers_local,
             )
-            out = _stage_body(
+            out, moe_aux = _stage_body(
                 cfg, chunk_params, inp, aux,
                 token_idx_local if token_idx is not None else None,
                 dk, deterministic, rope,
                 layer_offset=(c * pp + stage) * chunk_layers,
             )
+            # each (stage, chunk) serves a valid microbatch exactly once, so
+            # gating on `valid` counts every layer's router loss once
+            aux_acc = aux_acc + jnp.where(valid, moe_aux, 0.0)
             # final output for this microbatch leaves from the last virtual
             # stage (stage pp-1, chunk v-1)
             emit = jnp.logical_and(
@@ -202,13 +209,17 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
                 out_buf, jnp.where(emit, out, prev), mb_idx, 0
             )
             nxt = jax.lax.ppermute(out, PP_AXIS, perm)
-            return (nxt, out_buf), None
+            return (nxt, out_buf, aux_acc), None
 
-        init = (jnp.zeros_like(hidden_mb[0]), jnp.zeros_like(hidden_mb))
-        (_, out_buf), _ = jax.lax.scan(tick, init, jnp.arange(T))
+        init = (jnp.zeros_like(hidden_mb[0]), jnp.zeros_like(hidden_mb),
+                jnp.zeros((2,), jnp.float32))
+        (_, out_buf, aux_acc), _ = jax.lax.scan(tick, init, jnp.arange(T))
         # broadcast last-stage results to every stage (psum of one-hot data);
         # transpose of this psum routes dLoss back to the last stage only.
-        return jax.lax.psum(out_buf, PP_AXIS)
+        # MoE router losses: each stage holds its own layers' sum -> psum
+        # over pp gives the all-layer total (differentiable: the GPipe
+        # backward carries d(aux)/d(router) through the scan transpose).
+        return jax.lax.psum(out_buf, PP_AXIS), jax.lax.psum(aux_acc, PP_AXIS)
 
     # cp joins pp as a manual axis: hidden/aux seq dims are cp-local inside
     # the body, and the attention dispatch takes the ring_attention_manual
@@ -225,7 +236,7 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
             P(CP_AXIS),
             P(),
         ),
-        out_specs=hidden_spec,
+        out_specs=(hidden_spec, P()),
         axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
@@ -421,7 +432,7 @@ def pipeline_1f1b_loss_and_grads(
                 cfg, L, x, aux,
                 token_idx_local if token_idx is not None else None,
                 dk if use_dropout else None, not use_dropout, rope,
-            )
+            )[0]  # MoE aux unsupported under 1F1B (finalize enforces)
 
         def aux_at(i):
             return jax.tree.map(lambda a: a[i], aux_mb)
@@ -626,7 +637,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
                 token_idx_local if token_idx is not None else None,
                 dk if use_dropout else None, not use_dropout, rope,
                 layer_offset=layer_offset,
-            )
+            )[0]  # MoE aux unsupported under 1F1B (finalize enforces)
 
         def aux_at(i):
             return jax.tree.map(lambda a: a[i], aux_mb)
@@ -838,7 +849,7 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     else:
         hidden = jax.vmap(lambda t, a: embed_fn(outer, t, a, None))(tokens, aux_mb)
 
-    hidden = pipeline_apply(
+    hidden, moe_aux = pipeline_apply(
         cfg, mesh, params["layers"], hidden, aux_mb, dropout_key,
         deterministic, rope, token_idx=token_idx, mb_keys=layer_keys,
     )
@@ -861,4 +872,13 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
         acc_mb, jnp.float32(0.0),
         (hidden, labels, loss_mask, jnp.arange(M)),
     )
-    return loss, {"lm loss": loss}
+    metrics = {"lm loss": loss}
+    if cfg.model.num_experts is not None:
+        # aux_acc summed every microbatch; the pp=1 path averages the
+        # per-microbatch aux (loss_from_batch + grad-accum mean) — match it
+        balance, z = moe_aux[0] / M, moe_aux[1] / M
+        loss = (loss
+                + cfg.model.moe_aux_loss_coeff * balance
+                + cfg.model.moe_z_loss_coeff * z)
+        metrics["moe aux loss"] = balance
+    return loss, metrics
