@@ -60,16 +60,33 @@ def save_checkpoint(
     consumed_samples: int = 0,
     extra_state: Optional[Dict] = None,
 ) -> None:
-    """save_checkpoint analog (checkpointing.py:266-341)."""
+    """save_checkpoint analog (checkpointing.py:266-341).
+
+    Multi-host: every process participates in the orbax saves (each writes
+    its addressable shards — the analog of the reference's per-DP-rank
+    distributed-optimizer writes, checkpointing.py:144-155); the small
+    meta/tracker files and pruning are process-0-only.
+    """
+    import jax
+
+    main = jax.process_index() == 0
     path = os.path.abspath(checkpoint_dir(save_dir, iteration))
     os.makedirs(save_dir, exist_ok=True)
-    if os.path.exists(path):
+    if main and os.path.exists(path):
         shutil.rmtree(path)
+    if jax.process_count() > 1:
+        # barrier: no host may enter the save while process 0 is still
+        # deleting a stale directory on the shared filesystem
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_rmtree")
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(path, "params"), params)
     if opt_state is not None and not cfg.checkpoint.no_save_optim:
         ckptr.save(os.path.join(path, "opt_state"), opt_state)
     ckptr.wait_until_finished()
+    if not main:
+        return
     meta = {
         "iteration": iteration,
         "consumed_samples": consumed_samples,

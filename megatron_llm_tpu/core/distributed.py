@@ -1,0 +1,114 @@
+"""Multi-host (multi-process) runtime — the reference's multi-node story.
+
+Reference: one process per GPU launched by torchrun across nodes, NCCL over
+IB/Ethernet (initialize.py:124-167), per-DP-rank data loading
+(data_samplers.py:49 DP-rank slicing) and rank-0 broadcasts.
+
+TPU-native redesign: one process per *host*, each seeing its local chips;
+``jax.distributed.initialize`` wires the coordinator and every jitted
+computation stays a single SPMD program over the global mesh. The mesh axis
+order (dp, ep, pp, cp, tp) keeps dp outermost, so when a pod slice spans
+hosts the data-parallel axis rides DCN while tp/cp/pp stay on ICI — the
+same placement discipline as the reference's "TP ranks intra-node" rule
+(parallel_state.py docstring).
+
+Data: instead of rank-0 broadcast (tensor_parallel/data.py:22-105), every
+host loads only its slice of the global batch (process_batch_slice) and
+``jax.make_array_from_process_local_data`` assembles the global array — no
+cross-host data traffic at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-process JAX runtime (idempotent).
+
+    On TPU pods arguments are auto-detected from the metadata server — call
+    with no arguments from every host (the analog of torchrun's env init,
+    initialize.py:146). Explicit args support GPU/CPU clusters:
+    ``coordinator_address`` like "10.0.0.1:1234" (or env
+    ``MEGATRON_COORDINATOR``), plus process count/id (or env
+    ``MEGATRON_NUM_PROCESSES`` / ``MEGATRON_PROCESS_ID``).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    # NOTE: nothing here may touch the backend (jax.process_count(),
+    # jax.devices(), ...) before jax.distributed.initialize — backend
+    # initialization would lock the process into single-host mode.
+    coordinator_address = coordinator_address or os.environ.get(
+        "MEGATRON_COORDINATOR"
+    )
+    if num_processes is None and "MEGATRON_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MEGATRON_NUM_PROCESSES"])
+    if process_id is None and "MEGATRON_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MEGATRON_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single-host run (or TPU-pod autodetection explicitly requested via
+        # MEGATRON_MULTIHOST=1): nothing to do
+        if not os.environ.get("MEGATRON_MULTIHOST"):
+            _INITIALIZED = True
+            return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+
+
+def process_batch_slice(global_batch_size: int) -> Tuple[int, int]:
+    """Row range [start, stop) of the global batch this host should load.
+
+    The analog of the reference sampler's DP-rank slicing
+    (data_samplers.py:75-97), at host granularity: batches are contiguous
+    row blocks per process, matching the row-major (dp, ep) batch sharding
+    of ``parallel/tp.data_spec`` so every row a host loads lands on its own
+    chips.
+    """
+    n = jax.process_count()
+    assert global_batch_size % n == 0, (
+        f"global_batch_size {global_batch_size} not divisible by "
+        f"process count {n}"
+    )
+    per = global_batch_size // n
+    pid = jax.process_index()
+    return pid * per, (pid + 1) * per
+
+
+def place_host_local_batch(batch: Dict[str, Any],
+                           shardings: Dict[str, Any]) -> Dict[str, Any]:
+    """Assemble global batch arrays from per-host local rows.
+
+    ``batch`` holds this host's rows (process_batch_slice) for every
+    batch-sharded key; ``token_idx`` is the one batch-invariant key by
+    contract (the [s] zigzag vector, parallel/tp.batch_shardings) and is
+    passed whole. Keys, not shapes, decide — so batch-size ramp-up (whose
+    per-iteration global batch is smaller than the configured one) places
+    correctly. Single-process: plain device_put (identical behavior).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(batch, shardings)
+
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        s = shardings[k]
+        if k != "token_idx":
+            out[k] = jax.make_array_from_process_local_data(s, v)
+        else:
+            out[k] = jax.device_put(v, s)
+    return out

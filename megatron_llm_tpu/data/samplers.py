@@ -2,10 +2,12 @@
 
 Reference: megatron/data/data_samplers.py (MegatronPretrainingSampler:49 with
 consumed_samples resume + DP-rank slicing; MegatronPretrainingRandomSampler
-cyclic). TPU-native difference: there is ONE host process feeding the whole
-mesh, so the sampler yields *global* batches and jit shards them over dp —
-there is no per-rank slicing or TP-rank-0 broadcast (data.py:22-105); those
-collectives disappear by construction.
+cyclic). TPU-native differences: samplers yield *global* batches and jit
+shards them over (dp, ep) — no per-GPU-rank slicing and no TP-rank-0
+broadcast (data.py:22-105). In multi-host runs slicing reappears at HOST
+granularity only (_ProcessSlicedSampler below): each host loads its
+contiguous row block of the shared global index stream, assembled back into
+global arrays by core/distributed.place_host_local_batch.
 """
 
 from __future__ import annotations
@@ -113,6 +115,22 @@ class DataIterator:
         return item
 
 
+class _ProcessSlicedSampler:
+    """Wrap a global-batch sampler to yield only this host's contiguous row
+    block (core/distributed.process_batch_slice) — the multi-host analog of
+    the reference's per-DP-rank slicing (data_samplers.py:75-97). Every host
+    iterates the same global index stream, so consumed_samples bookkeeping
+    stays global and identical across hosts."""
+
+    def __init__(self, sampler, start: int, stop: int):
+        self.sampler = sampler
+        self.start, self.stop = start, stop
+
+    def __iter__(self):
+        for batch in self.sampler:
+            yield batch[self.start:self.stop]
+
+
 def build_pretraining_data_loader(
     dataset,
     consumed_samples: int,
@@ -121,8 +139,13 @@ def build_pretraining_data_loader(
     seed: int = 1234,
     num_workers: int = 1,
     collate_fn=_collate,
+    process_sliced: bool = False,
 ) -> Optional[DataIterator]:
-    """Reference build_pretraining_data_loader (data_samplers.py:14) analog."""
+    """Reference build_pretraining_data_loader (data_samplers.py:14) analog.
+
+    ``process_sliced``: in multi-host runs, load only this host's rows of
+    each global batch (assembled back into global arrays by
+    core/distributed.place_host_local_batch)."""
     if dataset is None:
         return None
     if dataloader_type == "single":
@@ -135,4 +158,12 @@ def build_pretraining_data_loader(
         )
     else:
         raise ValueError(f"unknown dataloader_type {dataloader_type}")
+    if process_sliced:
+        import jax
+
+        if jax.process_count() > 1:
+            from megatron_llm_tpu.core.distributed import process_batch_slice
+
+            start, stop = process_batch_slice(global_batch_size)
+            sampler = _ProcessSlicedSampler(sampler, start, stop)
     return DataIterator(dataset, sampler, collate_fn=collate_fn)

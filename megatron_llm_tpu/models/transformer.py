@@ -404,6 +404,13 @@ def _remat_policy(name: str):
             jax.checkpoint_policies.checkpoint_dots,
             jax.checkpoint_policies.save_only_these_names("attn_out"),
         ),
+        # near-full recompute, but keep the flash-attention outputs: the one
+        # tensor whose recompute is a whole Pallas kernel run. Memory close
+        # to 'full' (enables the largest micro-batches), backward cost close
+        # to 'selective'.
+        "save_attn_only": jax.checkpoint_policies.save_only_these_names(
+            "attn_out"
+        ),
     }
     return policies.get(name, jax.checkpoint_policies.checkpoint_dots)
 

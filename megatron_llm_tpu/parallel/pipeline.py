@@ -881,4 +881,6 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
                 + cfg.model.moe_aux_loss_coeff * balance
                 + cfg.model.moe_z_loss_coeff * z)
         metrics["moe aux loss"] = balance
+        if cfg.model.moe_z_loss_coeff:
+            metrics["router z loss"] = z  # matches loss_from_batch reporting
     return loss, metrics

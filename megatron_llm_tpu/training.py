@@ -35,6 +35,7 @@ from megatron_llm_tpu.training_step import (
 from megatron_llm_tpu.utils.logging_utils import (
     SignalHandler,
     build_writer,
+    print0,
     set_global,
 )
 from megatron_llm_tpu.utils.timers import Timers
@@ -91,6 +92,7 @@ def _make_loader_factory(cfg, collate):
         return build_pretraining_data_loader(
             ds, consumed, batch_size or _loader_granularity(cfg),
             cfg.data.dataloader_type, cfg.training.seed, collate_fn=collate,
+            process_sliced=True,
         )
 
     return loader
@@ -224,8 +226,13 @@ def make_eval_step(cfg, loss_fn=None):
     return jax.jit(eval_step)
 
 
-def evaluate(cfg, params, eval_step, data_iterator, max_iters: Optional[int] = None):
-    """evaluate analog (training.py:773-860): mean loss over eval_iters."""
+def evaluate(cfg, params, eval_step, data_iterator,
+             max_iters: Optional[int] = None, place_batch=None):
+    """evaluate analog (training.py:773-860): mean loss over eval_iters.
+
+    ``place_batch`` (the training step's placer) must be passed in
+    multi-host runs: eval loaders are process-sliced like training loaders,
+    so the local rows need the same global-array assembly."""
     totals: Dict[str, float] = {}
     n = 0
     max_iters = max_iters or cfg.training.eval_iters
@@ -234,6 +241,8 @@ def evaluate(cfg, params, eval_step, data_iterator, max_iters: Optional[int] = N
             batch = next(data_iterator)
         except StopIteration:
             break
+        if place_batch is not None:
+            batch = place_batch(batch)
         metrics = eval_step(params, batch)
         for k, v in metrics.items():
             totals[k] = totals.get(k, 0.0) + float(v)
@@ -267,7 +276,7 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
         msg += f" | num zeros: {float(metrics['num_zeros']):.0f}"
     if "params_norm" in metrics:
         msg += f" | params norm: {float(metrics['params_norm']):.3f}"
-    print(msg, flush=True)
+    print0(msg, flush=True)
     if writer is not None:
         writer.add_scalar("lm-loss-training/lm loss", loss, iteration)
         if cfg.logging.log_learning_rate_to_tensorboard:
@@ -296,7 +305,7 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
     if timers is not None and cfg.logging.timing_log_level > 0:
         log = timers.log()
         if log:
-            print(f"    timers(ms): {log}", flush=True)
+            print0(f"    timers(ms): {log}", flush=True)
 
 
 def pretrain(
@@ -311,6 +320,9 @@ def pretrain(
     Returns final state dict for programmatic use/testing.
     """
     t0 = time.time()
+    from megatron_llm_tpu.core.distributed import initialize_distributed
+
+    initialize_distributed()  # no-op single-host; pod autodetect multi-host
     mesh = build_mesh_from_config(cfg)
     tokenizer = None
     if cfg.data.tokenizer_type and (cfg.data.data_path or cfg.data.tokenizer_model
@@ -348,7 +360,7 @@ def pretrain(
             )
             # a batch-size ramp runs fewer microbatches early on — this is
             # the steady-state (full global batch) figure
-            print(f"pipeline: schedule={ppl.pipeline_schedule} "
+            print0(f"pipeline: schedule={ppl.pipeline_schedule} "
                   f"vpp={ppl.virtual_pipeline_model_parallel_size or 1} "
                   f"steady-state bubble fraction={bubble:.3f}", flush=True)
         if cfg.optimizer.use_distributed_optimizer:
@@ -363,7 +375,7 @@ def pretrain(
                 cfg, params, opt_state, dp_ax, ep_size=ep_ax
             )
             over = f"dp={dp_ax}" + (f" x ep={ep_ax}" if ep_ax > 1 else "")
-            print(f"ZeRO-1: {frac * 100:.1f}% of optimizer-state elements "
+            print0(f"ZeRO-1: {frac * 100:.1f}% of optimizer-state elements "
                   f"sharded over {over}", flush=True)
 
         iteration, consumed_samples = 0, 0
@@ -376,12 +388,12 @@ def pretrain(
                 )
                 if loaded_opt is not None:
                     opt_state = loaded_opt
-                print(f"loaded checkpoint from {cfg.checkpoint.load} "
+                print0(f"loaded checkpoint from {cfg.checkpoint.load} "
                       f"at iteration {iteration}")
             except FileNotFoundError as e:
                 if cfg.checkpoint.exit_on_missing_checkpoint:
                     raise
-                print(f"WARNING: {e}; training from scratch")
+                print0(f"WARNING: {e}; training from scratch")
 
         # ---- data ----
         rebuild_full_loader = None
@@ -498,7 +510,7 @@ def pretrain(
             if profiling and iteration >= profile_stop_at:
                 jax.profiler.stop_trace()
                 profiling = False
-                print(f"profiler: xplane trace written to {profile_dir}",
+                print0(f"profiler: xplane trace written to {profile_dir}",
                       flush=True)
 
             if iteration % cfg.logging.log_interval == 0:
@@ -512,14 +524,15 @@ def pretrain(
                         loss_fn=loss_fn,
                     )
                     if spans:
-                        print("    span breakdown (ms): " + " | ".join(
+                        print0("    span breakdown (ms): " + " | ".join(
                             f"{k}: {v * 1e3:.1f}" for k, v in spans.items()),
                             flush=True)
 
             if (cfg.training.eval_interval and valid_iter_factory
                     and iteration % cfg.training.eval_interval == 0):
-                ev = evaluate(cfg, params, eval_step, valid_iter_factory())
-                print(f" validation loss at iteration {iteration}: "
+                ev = evaluate(cfg, params, eval_step, valid_iter_factory(),
+                              place_batch=shardings["place_batch"])
+                print0(f" validation loss at iteration {iteration}: "
                       + " | ".join(f"{k}: {v:.6E}" for k, v in ev.items()),
                       flush=True)
                 if writer:

@@ -290,7 +290,16 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
     )
 
     def place_batch(batch):
-        return jax.device_put(batch, batch_shardings(cfg, mesh, batch))
+        sh = batch_shardings(cfg, mesh, batch)
+        if jax.process_count() > 1:
+            # multi-host: hosts hold only their rows of the global batch
+            # (core/distributed.process_batch_slice); assemble global arrays
+            from megatron_llm_tpu.core.distributed import (
+                place_host_local_batch,
+            )
+
+            return place_host_local_batch(batch, sh)
+        return jax.device_put(batch, sh)
 
     return jstep, optimizer, {
         "params": p_shard,

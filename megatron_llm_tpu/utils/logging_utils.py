@@ -14,6 +14,16 @@ from typing import Any, Dict, Optional
 _GLOBALS: Dict[str, Any] = {}
 
 
+def print0(*args, **kwargs) -> None:
+    """Print on host process 0 only (reference print_rank_0,
+    megatron/utils.py:197-228) — multi-host runs would otherwise emit every
+    log line once per host."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
 def set_global(name: str, value: Any) -> None:
     _GLOBALS[name] = value
 
