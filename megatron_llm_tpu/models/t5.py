@@ -113,6 +113,95 @@ def t5_forward(
     return dec_hidden @ emb.T + params["lm_head_bias"].astype(dec_hidden.dtype)
 
 
+def t5_pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
+                        num_micro: Optional[int] = None, dropout_key=None):
+    """Pipelined T5 loss: encoder and decoder stacks each run through the
+    GPipe engine (parallel/pipeline.pipeline_apply), the TPU-native analog
+    of the reference's --pipeline_model_parallel_split_rank two-phase
+    encoder/decoder placement (parallel_state.py + schedules.py encoder_and_
+    decoder handling).
+
+    Design: both stacks shard their layer axis over the SAME pp ring (each
+    stage holds L_enc/pp encoder + L_dec/pp decoder layers, rather than the
+    reference's disjoint stage ranges) — two pipelined phases per step, with
+    the normed encoder output riding the aux dict into every decoder stage
+    for cross-attention. Self-attention padding is expressed as segment ids
+    (loss-equivalent to the additive-bias form for real rows — see
+    bert_pipeline_hooks); the encoder phase runs under a bidirectional
+    config copy.
+
+    Restrictions: deterministic only (dropout=0) and cp == 1.
+    """
+    import copy
+
+    from megatron_llm_tpu.parallel.pipeline import (
+        microbatched_head_loss,
+        pipeline_apply,
+    )
+
+    m = cfg.model
+    assert m.num_experts is None  # finalize enforces; belt and braces
+    assert m.hidden_dropout == 0.0 and m.attention_dropout == 0.0, (
+        "pipelined T5 currently supports deterministic training only"
+    )
+    assert cfg.parallel.context_parallel_size == 1, (
+        "pipelined T5 requires context_parallel_size == 1 (the encoder "
+        "output is replicated to decoder stages whole)"
+    )
+    M = num_micro or cfg.parallel.num_micro_batches or 1
+    gbs = batch["text_enc"].shape[0]
+    assert gbs % M == 0
+    mb = gbs // M
+
+    def split(x):
+        return x.reshape(M, mb, *x.shape[1:])
+
+    enc_tok, dec_tok = split(batch["text_enc"]), split(batch["text_dec"])
+    enc_mask, dec_mask = split(batch["enc_mask"]), split(batch["dec_mask"])
+    labels = split(batch["labels"])
+    loss_mask = split(batch["loss_mask"]).astype(jnp.float32)
+
+    # ---- encoder phase: bidirectional self-attention, pads as segments ----
+    cfg_enc = copy.deepcopy(cfg)
+    cfg_enc.model.bidirectional = True
+    enc_h0 = jax.vmap(lambda t: embed_tokens(cfg, params, t))(enc_tok)
+    enc_aux = {"segment_ids": 1 - enc_mask.astype(jnp.int32)}
+    enc_out, _ = pipeline_apply(
+        cfg_enc, mesh, params["layers"], enc_h0, enc_aux, None, True, None
+    )
+    enc_out = norm(enc_out, params["final_norm"], m.layernorm_epsilon,
+                   m.use_rms_norm)
+
+    # ---- decoder phase: causal self-attention + cross-attention ----
+    dec_h0 = jax.vmap(lambda t: embed_tokens(cfg, params, t))(dec_tok)
+    dec_aux = {
+        "segment_ids": 1 - dec_mask.astype(jnp.int32),
+        "encoder_hidden": enc_out,
+        # cross-attention bias precomputed here (the engine forwards aux
+        # keys generically): [M, mb, 1, 1, se] masking padded encoder keys
+        "enc_bias": jax.vmap(cross_bias)(enc_mask),
+    }
+    dec_out, _ = pipeline_apply(
+        cfg, mesh, params["decoder_layers"], dec_h0, dec_aux, None, True, None
+    )
+
+    # ---- head + CE per microbatch (shared remat-scan discipline) ----
+    denom = jnp.maximum(loss_mask.sum(), 1.0)
+
+    def head_loss(outer_p, hid, lbl, msk, aux):
+        h = norm(hid, outer_p["decoder_final_norm"], m.layernorm_epsilon,
+                 m.use_rms_norm)
+        emb = outer_p["embedding"]["word_embeddings"].astype(h.dtype)
+        logits = h @ emb.T + outer_p["lm_head_bias"].astype(h.dtype)
+        per_token = softmax_cross_entropy(logits, lbl)
+        return (per_token * msk).sum() / denom
+
+    loss = microbatched_head_loss(
+        head_loss, params, dec_out, labels, loss_mask, {}
+    )
+    return loss, {"lm loss": loss}
+
+
 def t5_loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
                        dropout_key=None, deterministic=True,
                        rope_cache=None, sp_constraint=None):

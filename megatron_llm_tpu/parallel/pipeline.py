@@ -67,12 +67,19 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
     layers_per_stage = jax.tree_util.tree_leaves(layers_local)[0].shape[0]
     if layer_offset is None:
         layer_offset = stage * layers_per_stage
+    # encoder-decoder stages (models/t5.py:t5_pipeline_loss_fn): the encoder
+    # output and the (caller-precomputed) cross-attention bias ride the aux
+    # dict to every stage — the engine stays model-agnostic
+    encoder_hidden = aux.get("encoder_hidden")
+    enc_bias = aux.get("enc_bias")
     hidden, _, moe_aux = transformer_forward(
         cfg, layers_local, x,
         rope=rope,
         position_ids=aux.get("position_ids"),
         segment_ids=aux.get("segment_ids"),
         token_idx=token_idx,
+        encoder_hidden=encoder_hidden,
+        enc_bias=enc_bias,
         dropout_key=dropout_key,
         deterministic=deterministic,
         layer_offset=layer_offset,
@@ -255,6 +262,33 @@ def _aux_data_spec(leaf):
     if leaf.ndim >= 3:
         return P(None, None, CP_AXIS)
     return P(*([None] * leaf.ndim))
+
+
+def microbatched_head_loss(head_loss_fn, outer, hidden, labels, loss_mask,
+                           aux_mb):
+    """Sum per-microbatch head-loss contributions over [M, ...] arrays.
+
+    One microbatch at a time: materializing [M, mb, s, v] logits for the
+    whole global batch (vocab 32k, seq 4k, M=16 -> tens of GB) would defeat
+    microbatching; the remat keeps the scan VJP from saving each
+    iteration's logits as residuals (the same footprint again). Shared by
+    pipeline_loss_fn and family-owned pipelines (models/t5.py).
+    """
+
+    @functools.partial(jax.checkpoint, policy=None)
+    def head_mb(hid, lbl, msk, i):
+        aux = jax.tree.map(lambda a: a[i], aux_mb)
+        return head_loss_fn(outer, hid, lbl, msk, aux)
+
+    def acc_mb(loss_sum, inp):
+        hid, lbl, msk, i = inp
+        return loss_sum + head_mb(hid, lbl, msk, i), None
+
+    loss, _ = jax.lax.scan(
+        acc_mb, jnp.float32(0.0),
+        (hidden, labels, loss_mask, jnp.arange(hidden.shape[0])),
+    )
+    return loss
 
 
 def _split_extra_keys(batch, split):
@@ -837,9 +871,6 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     if head_loss_fn is None:
         head_loss_fn = default_head
 
-    def aux_at(i):
-        return jax.tree.map(lambda a: a[i], aux_mb)
-
     # [M, mb, s, h] embeddings (vocab-parallel over tp under pjit); dropout
     # keys per microbatch, matching the pp=1 path (model_forward:149-152)
     if embed_keys is not None:
@@ -854,23 +885,8 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
         deterministic, rope, token_idx=token_idx, mb_keys=layer_keys,
     )
 
-    # Head + loss one microbatch at a time: materializing [M, mb, s, v]
-    # logits for the whole global batch (vocab 32k, seq 4k, M=16 -> tens of
-    # GB) would defeat microbatching. Matches the non-pp path's discipline
-    # (training_step.py grad-accumulation scan).
-    # remat: without it the scan's VJP saves each iteration's logits as
-    # residuals — cumulatively the same [M, mb, s, v] footprint again
-    @functools.partial(jax.checkpoint, policy=None)
-    def head_mb(hid, lbl, msk, i):
-        return head_loss_fn(outer, hid, lbl, msk, aux_at(i))
-
-    def acc_mb(loss_sum, inp):
-        hid, lbl, msk, i = inp
-        return loss_sum + head_mb(hid, lbl, msk, i), None
-
-    loss, _ = jax.lax.scan(
-        acc_mb, jnp.float32(0.0),
-        (hidden, labels, loss_mask, jnp.arange(M)),
+    loss = microbatched_head_loss(
+        head_loss_fn, outer, hidden, labels, loss_mask, aux_mb
     )
     metrics = {"lm loss": loss}
     if cfg.model.num_experts is not None:

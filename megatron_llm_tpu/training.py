@@ -314,6 +314,7 @@ def pretrain(
     params_provider: Optional[Callable] = None,
     loss_fn: Optional[Callable] = None,
     pipeline_hooks: Optional[Callable] = None,
+    pipeline_loss: Optional[Callable] = None,
 ) -> Dict[str, Any]:
     """End-to-end training (pretrain analog, training.py:55-196).
 
@@ -343,7 +344,8 @@ def pretrain(
         timers("model-setup", 0).start()
         params = jax.jit(init_fn, out_shardings=p_shardings)(key)
         step_fn, optimizer, shardings = make_jitted_train_step(
-            cfg, mesh, params, loss_fn=loss_fn, pipeline_hooks=pipeline_hooks
+            cfg, mesh, params, loss_fn=loss_fn, pipeline_hooks=pipeline_hooks,
+            pipeline_loss=pipeline_loss,
         )
         opt_state = shardings["opt_state_value"]
         timers("model-setup").stop()
@@ -472,7 +474,7 @@ def pretrain(
                 step_cache[num_micro] = make_jitted_train_step(
                     cfg, mesh, params, num_micro=num_micro,
                     optimizer=optimizer, opt_state=opt_state, loss_fn=loss_fn,
-                    pipeline_hooks=pipeline_hooks,
+                    pipeline_hooks=pipeline_hooks, pipeline_loss=pipeline_loss,
                 )[0]
             cur_step_fn = step_cache[num_micro]
             try:

@@ -69,7 +69,7 @@ def _split_microbatches(batch: Dict[str, jax.Array], num_micro: int):
 def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = None,
                     mesh: Optional[Mesh] = None,
                     num_micro: Optional[int] = None,
-                    loss_fn=None, pipeline_hooks=None):
+                    loss_fn=None, pipeline_hooks=None, pipeline_loss=None):
     """Build the pure train_step(params, opt_state, batch, iteration, seed).
 
     Returns (loss-averaged-over-microbatches, metrics dict) alongside the new
@@ -85,6 +85,12 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
     head_loss_fn)`` maps the family's batch onto the pipeline engine's
     tokens/labels/loss_mask/aux contract — see
     models/bert.py:bert_pipeline_hooks).
+
+    ``pipeline_loss`` replaces the schedule entirely for topologies the
+    single-stack engine cannot express (T5's encoder+decoder:
+    models/t5.py:t5_pipeline_loss_fn); signature ``(cfg, mesh, params,
+    batch, num_micro=, dropout_key=) -> (loss, metrics)``, differentiated
+    GPipe-style.
     """
     sp_constraint = make_sp_constraint(cfg)
     lr_fn = lr_schedule(cfg)
@@ -134,7 +140,23 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
         grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 
         loss_mets = None
-        if pp > 1:
+        if pp > 1 and pipeline_loss is not None:
+            # family-owned pipeline (T5 encoder+decoder): differentiated
+            # GPipe-style as one program
+            assert cfg.parallel.pipeline_schedule == "gpipe", (
+                "custom pipeline_loss implementations are GPipe-only"
+            )
+            deterministic = (
+                cfg.model.hidden_dropout == 0.0
+                and cfg.model.attention_dropout == 0.0
+            )
+            loss, grads = jax.value_and_grad(
+                lambda p: pipeline_loss(
+                    cfg, mesh, p, batch, num_micro=num_micro,
+                    dropout_key=None if deterministic else base_key,
+                )[0] * jax.lax.stop_gradient(scale)
+            )(params)
+        elif pp > 1:
             # pipelined path: the microbatch loop lives inside the pipeline
             assert loss_fn is loss_from_batch or pipeline_hooks is not None, (
                 "pipeline parallelism needs the GPT-family LM loss or a "
@@ -258,7 +280,8 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
                            num_micro: Optional[int] = None,
                            optimizer: Optional[optax.GradientTransformation] = None,
                            opt_state: Any = None,
-                           loss_fn=None, pipeline_hooks=None):
+                           loss_fn=None, pipeline_hooks=None,
+                           pipeline_loss=None):
     """Bind shardings and jit. Returns (step_fn, optimizer, shardings dict).
 
     Donates params/opt_state (the XLA analog of the reference's in-place
@@ -278,7 +301,8 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
     scalar = NamedSharding(mesh, P())
 
     step = make_train_step(cfg, optimizer, mesh=mesh, num_micro=num_micro,
-                           loss_fn=loss_fn, pipeline_hooks=pipeline_hooks)
+                           loss_fn=loss_fn, pipeline_hooks=pipeline_hooks,
+                           pipeline_loss=pipeline_loss)
     # batch in_sharding is UNSPECIFIED (follows the committed input): batches
     # may carry the [s] token_idx vector whose sharding differs per key —
     # callers place batches with place_batch / batch_shardings.

@@ -98,11 +98,14 @@ def main():
 
         build_tokenizer(cfg)  # sets cfg.model.vocab_size
     extend_vocab_for_t5(cfg)
+    from megatron_llm_tpu.models.t5 import t5_pipeline_loss_fn
+
     result = pretrain(
         cfg,
         data_iterators_provider=t5_data_provider,
         params_provider=lambda key: init_t5_params(cfg, key),
         loss_fn=t5_loss_from_batch,
+        pipeline_loss=t5_pipeline_loss_fn,
     )
     print(f"training done: {result['iteration']} iterations "
           f"({result['exit_reason']})")
