@@ -150,11 +150,15 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                 cfg.model.hidden_dropout == 0.0
                 and cfg.model.attention_dropout == 0.0
             )
-            loss, grads = jax.value_and_grad(
-                lambda p: pipeline_loss(
+            def scaled_pipe(p):
+                l, mets = pipeline_loss(
                     cfg, mesh, p, batch, num_micro=num_micro,
                     dropout_key=None if deterministic else base_key,
-                )[0] * jax.lax.stop_gradient(scale)
+                )
+                return l * jax.lax.stop_gradient(scale), mets
+
+            (loss, loss_mets), grads = jax.value_and_grad(
+                scaled_pipe, has_aux=True
             )(params)
         elif pp > 1:
             # pipelined path: the microbatch loop lives inside the pipeline

@@ -361,6 +361,35 @@ def test_moe_gpipe_pipeline_matches_unpipelined():
         )
 
 
+def test_moe_interleaved_gpipe_pipeline_matches_unpipelined():
+    """MoE + virtual-pipeline GPipe (pp=2, vpp=2): the per-chunk aux
+    accumulation must still count every layer exactly once per microbatch."""
+    from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
+
+    cfg = tiny_cfg(seq_length=32, global_batch_size=4, num_layers=4)
+    cfg.parallel.pipeline_model_parallel_size = 2
+    cfg.parallel.pipeline_schedule = "gpipe"
+    cfg.parallel.virtual_pipeline_model_parallel_size = 2
+    cfg.parallel.num_micro_batches = 2
+    cfg.finalize()
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, jax.random.PRNGKey(1), gbs=4)
+
+    cfg1 = tiny_cfg(seq_length=32, global_batch_size=4, num_layers=4)
+    ref_loss = float(jax.jit(
+        lambda p: loss_from_batch(cfg1, p, batch, deterministic=True)[0]
+    )(params))
+
+    mesh = build_mesh(pipeline_model_parallel_size=2,
+                      devices=jax.devices()[:2])
+    with global_mesh(mesh):
+        loss, mets = jax.jit(
+            lambda p: pipeline_loss_fn(cfg, mesh, p, batch, num_micro=2)
+        )(params)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-5)
+    assert np.isfinite(float(mets["moe aux loss"]))
+
+
 def test_moe_1f1b_pipeline_rejected():
     cfg = tiny_cfg()
     cfg.parallel.pipeline_model_parallel_size = 2
