@@ -205,17 +205,22 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     embed_fn=embed_fn, head_loss_fn=head_loss_fn,
                 )
             else:
-                # GPipe-style: autodiff through the tick scan
+                # GPipe-style: autodiff through the tick scan; metrics
+                # (MoE router losses etc.) ride through has_aux
                 from megatron_llm_tpu.parallel.pipeline import pipeline_loss_fn
 
-                loss, grads = jax.value_and_grad(
-                    lambda p: pipeline_loss_fn(
+                def scaled_gpipe(p):
+                    l, mets = pipeline_loss_fn(
                         cfg, mesh, p, pipe_batch,
                         dropout_key=None if deterministic else base_key,
                         deterministic=deterministic, rope=rope,
                         sp_constraint=sp_constraint, num_micro=num_micro,
                         embed_fn=embed_fn, head_loss_fn=head_loss_fn,
-                    )[0] * jax.lax.stop_gradient(scale)
+                    )
+                    return l * jax.lax.stop_gradient(scale), mets
+
+                (loss, loss_mets), grads = jax.value_and_grad(
+                    scaled_gpipe, has_aux=True
                 )(params)
         elif num_micro == 1:
             (loss, loss_mets), grads = grad_fn(params, batch, base_key)
