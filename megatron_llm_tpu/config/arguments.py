@@ -83,6 +83,10 @@ class ModelConfig:
     # --- Mixture of Experts (beyond-reference: the reference has no MoE) ---
     # number of experts per MoE layer; None = dense model
     num_experts: Optional[int] = None
+    # 'topk' (token-choice, GShard/Mixtral) | 'expert_choice' (Zhou et al.
+    # 2022: experts pick tokens — balanced by construction; leaks future
+    # tokens within a routing group, so prefer it for encoders)
+    moe_router_type: str = "topk"
     moe_router_topk: int = 2
     # expert capacity = ceil(topk * tokens * capacity_factor / num_experts)
     moe_capacity_factor: float = 1.25
@@ -404,6 +408,9 @@ class Config:
                     "context_parallel_size == 1"
                 )
             assert self.model.moe_router_topk <= self.model.num_experts
+            assert self.model.moe_router_type in ("topk", "expert_choice"), (
+                f"unknown moe_router_type {self.model.moe_router_type!r}"
+            )
             if self.parallel.data_parallel_size is not None:
                 # auto-inferred dp (None) is validated later by build_mesh
                 assert self.parallel.data_parallel_size % ep == 0, (

@@ -213,12 +213,13 @@ def loss_from_batch(cfg, params, batch: Dict[str, jax.Array], *,
     loss = (per_token * mask).sum() / denom
     metrics = {"lm loss": loss}
     if moe:
+        from megatron_llm_tpu.models.moe import aux_loss_coeffs
+
         balance, z = out[2][0], out[2][1]
-        total = (loss
-                 + cfg.model.moe_aux_loss_coeff * balance
-                 + cfg.model.moe_z_loss_coeff * z)
+        c_bal, c_z = aux_loss_coeffs(cfg)
+        total = loss + c_bal * balance + c_z * z
         metrics["moe aux loss"] = balance
-        if cfg.model.moe_z_loss_coeff:
+        if c_z:
             metrics["router z loss"] = z
         return total, metrics
     return loss, metrics

@@ -44,11 +44,21 @@ Params = Dict[str, Any]
 
 
 def moe_capacity(cfg, tokens_per_group: int) -> int:
-    """Expert capacity C for one routing group of T tokens."""
+    """Expert capacity C for one routing group of T tokens (token-choice:
+    ceil(topk * T * cf / E), GShard convention)."""
     m = cfg.model
     cap = int(-(-m.moe_router_topk * tokens_per_group * m.moe_capacity_factor
                 // m.num_experts))  # ceil
     return max(cap, m.moe_min_capacity)
+
+
+def moe_capacity_expert_choice(cfg, tokens_per_group: int) -> int:
+    """Expert-choice capacity: ceil(T * cf / E) (Zhou et al. definition —
+    no topk factor; that knob is token-choice-only), clamped to T because
+    an expert cannot select more tokens than the group holds."""
+    m = cfg.model
+    cap = int(-(-tokens_per_group * m.moe_capacity_factor // m.num_experts))
+    return min(max(cap, m.moe_min_capacity), tokens_per_group)
 
 
 def init_moe_params(cfg, key: jax.Array) -> Params:
@@ -90,6 +100,34 @@ def _ep_constraint(x: jax.Array, expert_axis: int) -> jax.Array:
     spec[0] = ps.DP_AXIS
     spec[expert_axis] = ps.EP_AXIS
     return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def route_expert_choice(
+    cfg, router_logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Expert-choice routing (Zhou et al. 2022): each expert selects its
+    top-C tokens by router affinity — perfectly balanced by construction,
+    so no load-balance aux loss is needed (only the optional z-loss).
+
+    Note: within a routing group, experts compare tokens across positions,
+    which leaks future-token information into the selection — fine for
+    encoders/bidirectional models and for research runs; causal-LM training
+    should prefer the default top-k token-choice routing.
+
+    Returns (combine [G,T,E,C], dispatch bool, aux[2]) like route_tokens.
+    """
+    g_, t_, e_ = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)  # token-over-experts affinity
+    # experts pick tokens: top-C over the T axis of [G, E, T]
+    vals, idx = jax.lax.top_k(probs.transpose(0, 2, 1), capacity)  # [G,E,C]
+    sel = jax.nn.one_hot(idx, t_, dtype=jnp.float32)  # [G,E,C,T]
+    combine = (sel * vals[..., None]).transpose(0, 3, 1, 2)  # [G,T,E,C]
+    dispatch = combine > 0.0
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    # balance loss is identically its optimum under EC; report 1.0 so the
+    # "moe aux loss" metric stays comparable across router types
+    aux = jnp.stack([jnp.float32(1.0), z])
+    return combine, dispatch, aux
 
 
 def route_tokens(
@@ -156,11 +194,19 @@ def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         f"seq_length {s} not a multiple of moe_group_size {gsz}"
     )
     x = x.reshape(b * (s // gsz), gsz, h)
-    capacity = moe_capacity(cfg, gsz)
 
     w_router = p["router"]["kernel"]  # fp32
     router_logits = x.astype(jnp.float32) @ w_router  # [G, T, E]
-    combine, dispatch, aux = route_tokens(cfg, router_logits, capacity)
+    if m.moe_router_type == "expert_choice":
+        combine, dispatch, aux = route_expert_choice(
+            cfg, router_logits, moe_capacity_expert_choice(cfg, gsz)
+        )
+    elif m.moe_router_type == "topk":
+        combine, dispatch, aux = route_tokens(
+            cfg, router_logits, moe_capacity(cfg, gsz)
+        )
+    else:  # loud failure for configs that bypassed finalize validation
+        raise ValueError(f"unknown moe_router_type {m.moe_router_type!r}")
 
     dt = x.dtype
     xe = jnp.einsum("gtec,gth->gech", dispatch.astype(dt), x)  # [b, E, C, h]
@@ -192,3 +238,16 @@ def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def zero_aux() -> jax.Array:
     """Aux-loss placeholder for dense layers (keeps scan carries uniform)."""
     return jnp.zeros((2,), jnp.float32)
+
+
+def aux_loss_coeffs(cfg) -> Tuple[float, float]:
+    """(balance_coeff, z_coeff) to apply to the summed aux pair.
+
+    Expert-choice routing is balanced by construction: its reported balance
+    metric is the constant 1.0/layer, which must NOT enter the trained loss
+    (it would add a constant offset and skew loss curves vs token-choice
+    runs) — so the balance coefficient is zeroed there.
+    """
+    m = cfg.model
+    balance = 0.0 if m.moe_router_type == "expert_choice" else m.moe_aux_loss_coeff
+    return balance, m.moe_z_loss_coeff

@@ -890,13 +890,14 @@ def pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     )
     metrics = {"lm loss": loss}
     if cfg.model.num_experts is not None:
+        from megatron_llm_tpu.models.moe import aux_loss_coeffs
+
         # aux_acc summed every microbatch; the pp=1 path averages the
         # per-microbatch aux (loss_from_batch + grad-accum mean) — match it
         balance, z = moe_aux[0] / M, moe_aux[1] / M
-        loss = (loss
-                + cfg.model.moe_aux_loss_coeff * balance
-                + cfg.model.moe_z_loss_coeff * z)
+        c_bal, c_z = aux_loss_coeffs(cfg)
+        loss = loss + c_bal * balance + c_z * z
         metrics["moe aux loss"] = balance
-        if cfg.model.moe_z_loss_coeff:
+        if c_z:
             metrics["router z loss"] = z  # matches loss_from_batch reporting
     return loss, metrics

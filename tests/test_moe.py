@@ -398,6 +398,76 @@ def test_moe_1f1b_pipeline_rejected():
         cfg.finalize()
 
 
+def test_expert_choice_routing_is_balanced():
+    """EC routing: every expert fills exactly C slots with its top-C tokens
+    by affinity (Zhou et al. 2022) — balanced by construction."""
+    from megatron_llm_tpu.models.moe import route_expert_choice
+
+    cfg = tiny_cfg(moe_router_type="expert_choice")
+    g_, t_, e_, cap = 2, 16, 4, 4
+    logits = jax.random.normal(jax.random.PRNGKey(0), (g_, t_, e_))
+    combine, dispatch, aux = route_expert_choice(cfg, logits, cap)
+    # each (expert, slot) seats exactly one token
+    np.testing.assert_array_equal(
+        np.asarray(dispatch.sum(1)), np.ones((g_, e_, cap)))
+    # seated tokens are the top-C by affinity
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    for g in range(g_):
+        for e in range(e_):
+            seated = set(np.where(np.asarray(dispatch)[g, :, e].any(-1))[0])
+            want = set(np.argsort(-probs[g, :, e])[:cap])
+            assert seated == want
+    assert float(aux[0]) == 1.0
+
+
+def test_expert_choice_capacity_clamps_to_group():
+    """EC capacity never exceeds tokens-per-group (top_k would reject k > T):
+    few-expert configs and s=1 decode groups must not crash."""
+    from megatron_llm_tpu.models.moe import (
+        init_moe_params,
+        moe_capacity_expert_choice,
+    )
+
+    cfg = tiny_cfg(num_experts=2, moe_router_topk=1,
+                   moe_router_type="expert_choice", moe_capacity_factor=4.0)
+    assert moe_capacity_expert_choice(cfg, 16) == 16  # ceil(16*4/2)=32 -> 16
+    assert moe_capacity_expert_choice(cfg, 1) == 1    # decode: one token
+    p = init_moe_params(cfg, jax.random.PRNGKey(0))
+    out, _ = moe_sublayer(cfg, p, jax.random.normal(
+        jax.random.PRNGKey(1), (2, 1, cfg.model.hidden_size)))
+    assert out.shape == (2, 1, cfg.model.hidden_size)
+
+
+def test_expert_choice_balance_term_not_in_loss():
+    """EC's constant balance metric must not offset the trained loss."""
+    from megatron_llm_tpu.models.moe import aux_loss_coeffs
+
+    cfg = tiny_cfg(moe_router_type="expert_choice")
+    assert aux_loss_coeffs(cfg)[0] == 0.0
+    cfg2 = tiny_cfg()
+    assert aux_loss_coeffs(cfg2)[0] == cfg2.model.moe_aux_loss_coeff
+
+
+def test_expert_choice_model_trains():
+    cfg = tiny_cfg(moe_router_type="expert_choice", global_batch_size=2)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, jax.random.PRNGKey(1), gbs=2)
+
+    @jax.jit
+    def step(p):
+        loss, g = jax.value_and_grad(
+            lambda q: loss_from_batch(cfg, q, batch, deterministic=True)[0]
+        )(p)
+        return loss, jax.tree.map(lambda w, gg: w - 0.3 * gg, p, g)
+
+    p = params
+    losses = []
+    for _ in range(15):
+        loss, p = step(p)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
 def test_moe_rejects_encoder_families():
     with pytest.raises(AssertionError):
         make_config("bert", vocab_size=256, num_experts=4)
