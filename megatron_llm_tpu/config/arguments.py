@@ -84,8 +84,9 @@ class ModelConfig:
     # number of experts per MoE layer; None = dense model
     num_experts: Optional[int] = None
     # 'topk' (token-choice, GShard/Mixtral) | 'expert_choice' (Zhou et al.
-    # 2022: experts pick tokens — balanced by construction; leaks future
-    # tokens within a routing group, so prefer it for encoders)
+    # 2022: experts pick tokens — balanced by construction; a research/
+    # training configuration: it leaks future tokens within a routing
+    # group, see docs/guide/moe.md)
     moe_router_type: str = "topk"
     moe_router_topk: int = 2
     # expert capacity = ceil(topk * tokens * capacity_factor / num_experts)
