@@ -101,6 +101,44 @@ def flops_per_token(n_params: int, num_layers: int, hidden: int, seq: int) -> fl
     return 6.0 * n_params + 6.0 * num_layers * seq * hidden
 
 
+def timed_multistep(step, params, opt_state, batch, iters: int,
+                    metric_keys=("lm loss",), reps: int = 3):
+    """Compile + time `iters` train steps inside ONE jitted lax.scan dispatch
+    (per-call axon-tunnel latency would otherwise pollute the measurement;
+    the forced float() fetch is the completion barrier). Shared by bench.py
+    and tools/moe_bench.py. Donates and returns the training state: callers
+    must use the RETURNED params/opt_state (the passed-in buffers are gone).
+    Returns (best_seconds_per_step, compile_s, first_metrics, last_metrics,
+    params, opt_state)."""
+    import jax
+    import jax.numpy as jnp
+
+    def multi(p, o, b):
+        def body(c, it):
+            p, o = c
+            p, o, m = step(p, o, b, it)
+            return (p, o), tuple(m[k] for k in metric_keys)
+
+        (p, o), ms = jax.lax.scan(body, (p, o), jnp.arange(iters))
+        return p, o, ms
+
+    multi = jax.jit(multi, donate_argnums=(0, 1))
+    t0 = time.perf_counter()
+    params, opt_state, ms = multi(params, opt_state, batch)
+    first = [float(x[0]) for x in ms]
+    compile_s = time.perf_counter() - t0
+    best, last = float("inf"), first
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        params, opt_state, ms = multi(params, opt_state, batch)
+        barrier = float(ms[0][-1])  # ONE forced fetch = completion barrier
+        best = min(best, (time.perf_counter() - t0) / iters)
+        # remaining metrics fetched outside the timed window (each float()
+        # costs a tunnel round trip — the latency this helper excludes)
+        last = [barrier] + [float(x[-1]) for x in ms[1:]]
+    return best, compile_s, first, last, params, opt_state
+
+
 def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
               policy: str = None) -> dict:
     import jax
@@ -153,34 +191,11 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
             "loss_mask": jnp.ones((mbs, seq), jnp.float32),
         })
 
-        # multi-step scan: one dispatch per `iters` steps, so per-call
-        # latency of the axon HTTP tunnel (100ms+, absent on a directly
-        # attached TPU) does not pollute the throughput measurement
-        def multi_step(p, o, b):
-            def body(carry, it):
-                p, o = carry
-                p, o, m = step(p, o, b, it)
-                return (p, o), m["lm loss"]
-
-            (p, o), losses = jax.lax.scan(body, (p, o), jnp.arange(iters))
-            return p, o, losses
-
-        multi_step = jax.jit(multi_step, donate_argnums=(0, 1))
-
-        # compile + warmup; float() forces a real round trip through the
-        # tunnel (block_until_ready alone returns early through axon)
-        t0 = time.perf_counter()
-        params, opt_state, losses = multi_step(params, opt_state, batch)
-        loss0 = float(losses[0])
-        compile_s = time.perf_counter() - t0
-
-        reps = []
-        for _ in range(1 if on_cpu else 3):
-            t0 = time.perf_counter()
-            params, opt_state, losses = multi_step(params, opt_state, batch)
-            loss = float(losses[-1])  # forced fetch = completion barrier
-            reps.append((time.perf_counter() - t0) / iters)
-        dt = min(reps)
+        dt, compile_s, first, last, params, opt_state = timed_multistep(
+            step, params, opt_state, batch, iters,
+            reps=1 if on_cpu else 3,
+        )
+        loss0, loss = first[0], last[0]
 
         # secondary: per-dispatch step time (what a host-driven loop sees
         # through this tunnel; on directly attached TPUs dispatch is ~us)

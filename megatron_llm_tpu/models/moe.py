@@ -102,6 +102,11 @@ def _ep_constraint(x: jax.Array, expert_axis: int) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, P(*spec))
 
 
+def _router_z_loss(router_logits: jax.Array) -> jax.Array:
+    """ST-MoE router z-loss: mean(logsumexp(logits)^2) keeps logits small."""
+    return jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+
+
 def route_expert_choice(
     cfg, router_logits: jax.Array, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -123,10 +128,9 @@ def route_expert_choice(
     sel = jax.nn.one_hot(idx, t_, dtype=jnp.float32)  # [G,E,C,T]
     combine = (sel * vals[..., None]).transpose(0, 3, 1, 2)  # [G,T,E,C]
     dispatch = combine > 0.0
-    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
     # balance loss is identically its optimum under EC; report 1.0 so the
     # "moe aux loss" metric stays comparable across router types
-    aux = jnp.stack([jnp.float32(1.0), z])
+    aux = jnp.stack([jnp.float32(1.0), _router_z_loss(router_logits)])
     return combine, dispatch, aux
 
 
@@ -166,9 +170,7 @@ def route_tokens(
     frac_tokens = mask.sum(2).mean((0, 1)) / k_    # [E]
     frac_probs = probs.mean((0, 1))                # [E]
     balance = e_ * jnp.sum(frac_tokens * frac_probs)
-    # router z-loss (ST-MoE): keeps logits small/stable
-    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
-    aux = jnp.stack([balance, z])
+    aux = jnp.stack([balance, _router_z_loss(router_logits)])
 
     gate_kept = gate * fits.astype(gate.dtype)                  # [G, T, K]
     slot = jax.nn.one_hot(pos_tk, capacity, dtype=jnp.float32)  # [G, T, K, C]
@@ -214,18 +216,16 @@ def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
     experts = p["experts"]
     fc1 = experts["fc1"]["kernel"].astype(dt)
-    if m.glu_activation is not None:
+    glu = m.glu_activation is not None
+    # [g,e,c,(2,)f]; the bias broadcast [1,e,1,(2,)f] covers both layouts
+    y = jnp.einsum("gech,ehuf->gecuf" if glu else "gech,ehf->gecf", xe, fc1)
+    if "bias" in experts["fc1"]:
+        y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
+    if glu:
         act = GLU_BASE_ACTIVATIONS[m.glu_activation]
-        y = jnp.einsum("gech,ehuf->gecuf", xe, fc1)  # u = 2 (value, gate)
-        if "bias" in experts["fc1"]:
-            y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
         inter = y[..., 0, :] * act(y[..., 1, :])
     else:
-        act = get_mlp_activation(None, m.activation)
-        y = jnp.einsum("gech,ehf->gecf", xe, fc1)
-        if "bias" in experts["fc1"]:
-            y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
-        inter = act(y)
+        inter = get_mlp_activation(None, m.activation)(y)
     out_e = jnp.einsum("gecf,efh->gech", inter, experts["fc2"]["kernel"].astype(dt))
     if "bias" in experts["fc2"]:
         out_e = out_e + experts["fc2"]["bias"].astype(dt)[None, :, None]

@@ -15,11 +15,15 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import flops_per_token, peak_flops, probe_backend  # noqa: E402
+from bench import (  # noqa: E402
+    flops_per_token,
+    peak_flops,
+    probe_backend,
+    timed_multistep,
+)
 
 
 def main():
@@ -71,27 +75,10 @@ def main():
             "loss_mask": jnp.ones((mbs, seq), jnp.float32),
         })
         o = sh["opt_state_value"]
-
-        def multi(p, o, b):
-            def body(c, it):
-                p, o = c
-                p, o, m = step(p, o, b, it)
-                return (p, o), (m["lm loss"], m["moe aux loss"])
-
-            (p, o), ms = jax.lax.scan(body, (p, o), jnp.arange(args.iters))
-            return p, o, ms
-
-        multi = jax.jit(multi, donate_argnums=(0, 1))
-        t0 = time.perf_counter()
-        p, o, ms = multi(params, o, batch)
-        _ = float(ms[0][0])
-        compile_s = time.perf_counter() - t0
-        best = float("inf")
-        for _rep in range(3):
-            t0 = time.perf_counter()
-            p, o, ms = multi(p, o, batch)
-            _ = float(ms[0][-1])
-            best = min(best, (time.perf_counter() - t0) / args.iters)
+        best, compile_s, _first, last = timed_multistep(
+            step, params, o, batch, args.iters,
+            metric_keys=("lm loss", "moe aux loss"),
+        )[:4]
 
         expert_params = L * E * 3 * h * f
         active = n_params - expert_params * (E - K) // E
@@ -106,8 +93,8 @@ def main():
             "compile_time_s": round(compile_s, 1),
             "n_params": n_params,
             "n_active_params": active,
-            "loss": round(float(ms[0][-1]), 4),
-            "aux": round(float(ms[1][-1]), 4),
+            "loss": round(last[0], 4),
+            "aux": round(last[1], 4),
             "backend": jax.devices()[0].platform,
         }), flush=True)
 
