@@ -36,7 +36,8 @@ def _flash_available() -> bool:
         return False
 
 
-def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q, block_kv):
+def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
+                   block_kv, causal=True):
     """Run the Pallas kernel, wrapped in shard_map when a non-trivial mesh is
     active.
 
@@ -49,7 +50,7 @@ def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q, block_k
     from megatron_llm_tpu.core import parallel_state as ps
     from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
 
-    kwargs = dict(causal=True, sliding_window=sliding_window, scale=scale,
+    kwargs = dict(causal=causal, sliding_window=sliding_window, scale=scale,
                   block_q=block_q, block_kv=block_kv)
     if not ps.mesh_is_initialized():
         return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
@@ -192,7 +193,8 @@ def attention(
         use_flash
         and bias is None
         and dropout_rate == 0.0
-        and causal
+        # bidirectional (BERT / T5 encoder) runs the kernel with causal
+        # masking off — full or segment-gated attention
         and token_idx is None  # kernel masks by storage order only
         and on_tpu
         and sq >= 128
@@ -201,7 +203,8 @@ def attention(
     )
     if flash_ok:
         return _flash_sharded(
-            q, k, v, segment_ids, scale, sliding_window, block_q, block_kv
+            q, k, v, segment_ids, scale, sliding_window, block_q, block_kv,
+            causal=causal,
         )
     if bias is None:
         seg_q = seg_kv = segment_ids
