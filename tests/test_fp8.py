@@ -118,3 +118,42 @@ def test_fp8_vs_bf16_logits_close():
     # getting_started.md:152-155) — fp8 is coarser, gate on avg abs err
     avg = float(jnp.abs(got - ref).mean())
     assert avg < 0.2, avg
+
+
+def test_fp8_tp_parity():
+    """fp8 quantization under tensor parallelism: the per-tensor amax is a
+    global reduction under GSPMD, so tp=2 must reproduce the unsharded
+    loss/grads (a sharding-local amax would silently change the scales)."""
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.parallel.tp import batch_shardings, param_shardings
+
+    common = dict(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, vocab_size=256, seq_length=32,
+        max_position_embeddings=64, params_dtype="float32",
+        use_flash_attn=False, fp8="hybrid",
+    )
+    cfg = make_config("llama2", **common)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 256)
+    batch = {"tokens": tok[:, :-1], "labels": tok[:, 1:],
+             "loss_mask": jnp.ones((2, 32), jnp.float32)}
+
+    def run(mesh, cfg):
+        with global_mesh(mesh):
+            p = jax.device_put(params, param_shardings(mesh, params))
+            b = jax.device_put(batch, batch_shardings(cfg, mesh, batch))
+            loss, grads = jax.jit(jax.value_and_grad(
+                lambda q: loss_from_batch(cfg, q, b)[0]
+            ))(p, )
+            return float(loss), jax.device_get(grads)
+
+    ref_loss, ref_grads = run(build_mesh(devices=jax.devices()[:1]), cfg)
+    cfg2 = make_config("llama2", **common, tensor_model_parallel_size=2)
+    loss, grads = run(build_mesh(tensor_model_parallel_size=2,
+                                 devices=jax.devices()[:2]), cfg2)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_grads),
+                    jax.tree_util.tree_leaves(grads)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-4)
