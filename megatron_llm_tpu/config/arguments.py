@@ -72,6 +72,11 @@ class ModelConfig:
     # throughput win only on fp8-capable TPU generations.
     fp8: Optional[str] = None
     fp8_margin: int = 0  # back off scales by 2^-margin (reference --fp8_margin)
+    # Fuse the LM-head matmul with cross entropy, scanned over this many
+    # vocab chunks, so the full [b, s, vocab] fp32 logits are never
+    # materialized in the training loss (ops/cross_entropy.py:
+    # chunked_softmax_cross_entropy_from_hidden). None = off.
+    ce_vocab_chunks: Optional[int] = None
     # BERT next-sentence/sentence-order binary head (bert_model.py:125)
     bert_binary_head: bool = False
     # bidirectional (non-causal) self-attention — BERT / T5 encoder

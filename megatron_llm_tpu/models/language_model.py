@@ -176,6 +176,21 @@ def model_forward(
     if not logits_postprocess:
         return ret(hidden)
 
+    if labels is not None and cfg.model.ce_vocab_chunks:
+        # head matmul fused into a vocab-chunked CE: the [b, s, vocab] fp32
+        # logits are never materialized (large-vocab memory lever)
+        from megatron_llm_tpu.ops.cross_entropy import (
+            chunked_softmax_cross_entropy_from_hidden,
+        )
+
+        w = (params["embedding"]["word_embeddings"].T
+             if cfg.model.tie_embed_logits else params["lm_head"]["kernel"])
+        loss = chunked_softmax_cross_entropy_from_hidden(
+            hidden, w.astype(hidden.dtype), labels,
+            cfg.model.ce_vocab_chunks,
+        )
+        return ret(loss)
+
     logits = compute_logits(cfg, params, hidden)
     if labels is None:
         return ret(logits)

@@ -49,6 +49,68 @@ def softmax_cross_entropy(
     return loss
 
 
+def chunked_softmax_cross_entropy_from_hidden(
+    hidden: jax.Array,      # [..., h] final-normed hidden states
+    head_kernel: jax.Array,  # [h, v] (tied embedding passed transposed)
+    labels: jax.Array,       # [...] int
+    num_chunks: int,
+    head_bias: jax.Array | None = None,  # [v]
+) -> jax.Array:
+    """Per-token CE fused with the LM-head matmul, scanned over vocab chunks.
+
+    The default path materializes the full [..., v] fp32 logits before
+    :func:`softmax_cross_entropy`; at large vocab x long seq x big
+    micro-batch that tensor dominates activation memory (vocab 32k, mbs 16,
+    seq 1024 -> 2 GiB fp32). Here a ``lax.scan`` over ``num_chunks`` vocab
+    slices keeps only [..., v/num_chunks] logits live at a time, carrying
+    the running (max, sum-exp, target-logit) triple — the same three
+    quantities the reference's vocab-PARALLEL CE tracks across TP ranks
+    (cross_entropy.py:21-60), re-cut along the vocab axis sequentially
+    instead of spatially. The chunk body is rematerialized so the backward
+    also never holds more than one chunk's logits.
+
+    Gradient-exact (not an approximation): d(loss)/d(logits_c) is recomputed
+    per chunk from the carried log-partition.
+    """
+    v = head_kernel.shape[-1]
+    assert v % num_chunks == 0, (v, num_chunks)
+    vc = v // num_chunks
+    lead = hidden.shape[:-1]
+
+    @jax.checkpoint  # bwd re-runs the chunk GEMM instead of saving logits
+    def chunk(carry, off):
+        m, s, tgt = carry
+        # slice in place: the kernel keeps its native layout/sharding (a
+        # pre-reshaped [nc, h, vc] xs would copy + re-lay-out the whole
+        # kernel every loss call and fight the tp vocab sharding)
+        wc = jax.lax.dynamic_slice_in_dim(head_kernel, off, vc, axis=1)
+        logits_c = (hidden @ wc).astype(jnp.float32)
+        if head_bias is not None:
+            logits_c = logits_c + jax.lax.dynamic_slice_in_dim(
+                head_bias, off, vc, axis=0
+            )
+        m_c = jax.lax.stop_gradient(jnp.max(logits_c, axis=-1))
+        m_new = jnp.maximum(m, m_c)
+        scale_old = jnp.exp(m - m_new)
+        s = s * scale_old + jnp.sum(
+            jnp.exp(logits_c - m_new[..., None]), axis=-1
+        )
+        local = labels - off
+        in_chunk = (local >= 0) & (local < vc)
+        safe = jnp.clip(local, 0, vc - 1)
+        picked = jnp.take_along_axis(logits_c, safe[..., None], -1)[..., 0]
+        tgt = jnp.where(in_chunk, picked, tgt)
+        return (m_new, s, tgt), None
+
+    init = (
+        jnp.full(lead, -jnp.inf, jnp.float32),
+        jnp.zeros(lead, jnp.float32),
+        jnp.zeros(lead, jnp.float32),
+    )
+    (m, s, tgt), _ = jax.lax.scan(chunk, init, jnp.arange(num_chunks) * vc)
+    return jnp.log(s) + m - tgt
+
+
 def vocab_parallel_cross_entropy(
     logits_shard: jax.Array,
     labels: jax.Array,

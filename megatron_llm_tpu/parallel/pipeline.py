@@ -317,8 +317,21 @@ def _default_gpt_fns(cfg, batch, use_dropout):
     def head_loss_fn(outer_p, hidden, lbl, msk, aux):
         h = norm(hidden, outer_p["final_norm"], cfg.model.layernorm_epsilon,
                  cfg.model.use_rms_norm)
-        logits = lm.compute_logits(cfg, outer_p, h)
-        per_token = softmax_cross_entropy(logits, lbl)
+        if cfg.model.ce_vocab_chunks:
+            # same vocab-chunked head fusion as the pp=1 path (model_forward)
+            from megatron_llm_tpu.ops.cross_entropy import (
+                chunked_softmax_cross_entropy_from_hidden,
+            )
+
+            w = (outer_p["embedding"]["word_embeddings"].T
+                 if cfg.model.tie_embed_logits
+                 else outer_p["lm_head"]["kernel"])
+            per_token = chunked_softmax_cross_entropy_from_hidden(
+                h, w.astype(h.dtype), lbl, cfg.model.ce_vocab_chunks
+            )
+        else:
+            logits = lm.compute_logits(cfg, outer_p, h)
+            per_token = softmax_cross_entropy(logits, lbl)
         return (per_token * msk.astype(jnp.float32)).sum() / denom
 
     return embed_fn, head_loss_fn
