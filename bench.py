@@ -140,7 +140,7 @@ def timed_multistep(step, params, opt_state, batch, iters: int,
 
 
 def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
-              policy: str = None) -> dict:
+              policy: str = None, ce_chunks: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -177,6 +177,9 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
     )
     if policy is not None:
         cfg.training.remat_policy = policy
+    if ce_chunks:
+        # head-fused vocab-chunked CE (ops/cross_entropy.py) — sweep knob
+        cfg.model.ce_vocab_chunks = ce_chunks
     mesh = build_mesh(devices=jax.devices()[:1])
     with mesh:
         params = init_model_params(cfg, jax.random.PRNGKey(0))
@@ -246,6 +249,8 @@ def main() -> None:
                     help="remat policy when --recompute selective "
                          "(default: the config default, "
                          "save_dots_except_logits)")
+    ap.add_argument("--ce_chunks", type=int, default=0,
+                    help="vocab chunks for head-fused CE (0 = off)")
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=1500.0)
     args = ap.parse_args()
@@ -268,7 +273,8 @@ def main() -> None:
         pin_cpu_platform()
     try:
         result = run_bench(args.iters, args.mbs, args.seq,
-                           recompute=args.recompute, policy=args.policy)
+                           recompute=args.recompute, policy=args.policy,
+                           ce_chunks=args.ce_chunks)
         finished.set()
         dog.cancel()
         emit(result)

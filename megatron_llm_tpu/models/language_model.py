@@ -21,7 +21,10 @@ from megatron_llm_tpu.models.transformer import (
     init_stacked_layers,
     transformer_forward,
 )
-from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.cross_entropy import (
+    chunked_softmax_cross_entropy_from_hidden,
+    softmax_cross_entropy,
+)
 from megatron_llm_tpu.ops.norms import init_norm_params, norm
 from megatron_llm_tpu.ops.rope import precompute_freqs
 
@@ -106,12 +109,18 @@ def embed_tokens(
     return hidden.astype(_compute_dtype(cfg))
 
 
+def head_weight(cfg, params: Params) -> jax.Array:
+    """The LM-head kernel [h, v]: the transposed tied embedding table or the
+    untied lm_head (language_model.py:24-53 tie handling) — single source of
+    truth for every head consumer (compute_logits, chunked CE, pipeline)."""
+    if cfg.model.tie_embed_logits:
+        return params["embedding"]["word_embeddings"].T
+    return params["lm_head"]["kernel"]
+
+
 def compute_logits(cfg, params: Params, hidden: jax.Array) -> jax.Array:
     """parallel_lm_logits analog (language_model.py:24-53): tied or untied head."""
-    if cfg.model.tie_embed_logits:
-        w = params["embedding"]["word_embeddings"].astype(hidden.dtype)
-        return hidden @ w.T
-    return hidden @ params["lm_head"]["kernel"].astype(hidden.dtype)
+    return hidden @ head_weight(cfg, params).astype(hidden.dtype)
 
 
 def _compute_dtype(cfg):
@@ -179,14 +188,8 @@ def model_forward(
     if labels is not None and cfg.model.ce_vocab_chunks:
         # head matmul fused into a vocab-chunked CE: the [b, s, vocab] fp32
         # logits are never materialized (large-vocab memory lever)
-        from megatron_llm_tpu.ops.cross_entropy import (
-            chunked_softmax_cross_entropy_from_hidden,
-        )
-
-        w = (params["embedding"]["word_embeddings"].T
-             if cfg.model.tie_embed_logits else params["lm_head"]["kernel"])
         loss = chunked_softmax_cross_entropy_from_hidden(
-            hidden, w.astype(hidden.dtype), labels,
+            hidden, head_weight(cfg, params).astype(hidden.dtype), labels,
             cfg.model.ce_vocab_chunks,
         )
         return ret(loss)

@@ -73,7 +73,7 @@ def chunked_softmax_cross_entropy_from_hidden(
     per chunk from the carried log-partition.
     """
     v = head_kernel.shape[-1]
-    assert v % num_chunks == 0, (v, num_chunks)
+    assert num_chunks > 0 and v % num_chunks == 0, (v, num_chunks)
     vc = v // num_chunks
     lead = hidden.shape[:-1]
 

@@ -41,7 +41,10 @@ from megatron_llm_tpu.core import rng as rng_mod
 from megatron_llm_tpu.core.parallel_state import CP_AXIS, PP_AXIS
 from megatron_llm_tpu.models import language_model as lm
 from megatron_llm_tpu.models.transformer import transformer_forward
-from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+from megatron_llm_tpu.ops.cross_entropy import (
+    chunked_softmax_cross_entropy_from_hidden,
+    softmax_cross_entropy,
+)
 from megatron_llm_tpu.ops.norms import norm
 
 
@@ -319,15 +322,9 @@ def _default_gpt_fns(cfg, batch, use_dropout):
                  cfg.model.use_rms_norm)
         if cfg.model.ce_vocab_chunks:
             # same vocab-chunked head fusion as the pp=1 path (model_forward)
-            from megatron_llm_tpu.ops.cross_entropy import (
-                chunked_softmax_cross_entropy_from_hidden,
-            )
-
-            w = (outer_p["embedding"]["word_embeddings"].T
-                 if cfg.model.tie_embed_logits
-                 else outer_p["lm_head"]["kernel"])
             per_token = chunked_softmax_cross_entropy_from_hidden(
-                h, w.astype(h.dtype), lbl, cfg.model.ce_vocab_chunks
+                h, lm.head_weight(cfg, outer_p).astype(h.dtype), lbl,
+                cfg.model.ce_vocab_chunks,
             )
         else:
             logits = lm.compute_logits(cfg, outer_p, h)
