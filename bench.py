@@ -272,9 +272,26 @@ def main() -> None:
 
         pin_cpu_platform()
     try:
-        result = run_bench(args.iters, args.mbs, args.seq,
-                           recompute=args.recompute, policy=args.policy,
-                           ce_chunks=args.ce_chunks)
+        # insurance: if the TUNED DEFAULT config fails on this chip (e.g. an
+        # HBM regression), fall back to the conservative selective + mbs 8
+        # config rather than reporting nothing. Only the stock invocation is
+        # eligible — sweeps must surface their own errors.
+        stock = (args.mbs, args.recompute, args.policy, args.ce_chunks) == (
+            16, "full", None, 0)
+        first_error = None
+        try:
+            result = run_bench(args.iters, args.mbs, args.seq,
+                               recompute=args.recompute, policy=args.policy,
+                               ce_chunks=args.ce_chunks)
+        except Exception as e:
+            if not stock:
+                raise
+            # keep only the message: the traceback would pin the failed
+            # attempt's device buffers through the retry (re-OOM)
+            first_error = f"{type(e).__name__}: {e}"[:200]
+        if first_error is not None:
+            result = run_bench(args.iters, 8, args.seq, recompute="selective")
+            result["fallback_config"] = f"mbs8-selective ({first_error})"
         finished.set()
         dog.cancel()
         emit(result)
