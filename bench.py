@@ -276,8 +276,8 @@ def main() -> None:
         # HBM regression), fall back to the conservative selective + mbs 8
         # config rather than reporting nothing. Only the stock invocation is
         # eligible — sweeps must surface their own errors.
-        stock = (args.mbs, args.recompute, args.policy, args.ce_chunks) == (
-            16, "full", None, 0)
+        stock = (args.mbs, args.seq, args.recompute, args.policy,
+                 args.ce_chunks) == (16, 1024, "full", None, 0)
         first_error = None
         try:
             result = run_bench(args.iters, args.mbs, args.seq,
@@ -298,7 +298,8 @@ def main() -> None:
     except Exception as e:  # structured error, never a bare traceback
         finished.set()
         dog.cancel()
-        fail(f"{type(e).__name__}: {e}")
+        extra = {"first_error": first_error} if first_error else {}
+        fail(f"{type(e).__name__}: {e}", **extra)
         sys.exit(1)
 
 

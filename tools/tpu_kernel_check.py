@@ -54,15 +54,18 @@ def numerics_checks():
     from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
 
     cases = [
-        # name, b, s, n, nkv, d, window, segmented
-        ("causal", 2, 1024, 8, 8, 128, None, False),
-        ("gqa4", 2, 1024, 8, 2, 128, None, False),
-        ("sliding256", 1, 2048, 4, 4, 128, 256, False),
-        ("segments", 1, 1024, 4, 4, 128, None, True),
-        ("gqa_sliding", 1, 2048, 8, 2, 128, 512, False),
-        ("d256", 1, 2048, 4, 4, 256, None, False),  # VMEM cap path
+        # name, b, s, n, nkv, d, window, segmented, causal
+        ("causal", 2, 1024, 8, 8, 128, None, False, True),
+        ("gqa4", 2, 1024, 8, 2, 128, None, False, True),
+        ("sliding256", 1, 2048, 4, 4, 128, 256, False, True),
+        ("segments", 1, 1024, 4, 4, 128, None, True, True),
+        ("gqa_sliding", 1, 2048, 8, 2, 128, 512, False, True),
+        ("d256", 1, 2048, 4, 4, 256, None, False, True),  # VMEM cap path
+        # bidirectional dispatch (BERT / pipelined T5 encoder)
+        ("bidir", 2, 1024, 8, 8, 128, None, False, False),
+        ("bidir_segments", 1, 1024, 4, 4, 128, None, True, False),
     ]
-    for name, b, s, n, nkv, d, window, segmented in cases:
+    for name, b, s, n, nkv, d, window, segmented, causal in cases:
         q, k, v = rand_qkv(jax.random.PRNGKey(17), b, s, n, nkv, d)
         seg = None
         if segmented:
@@ -70,7 +73,7 @@ def numerics_checks():
             seg = jnp.broadcast_to(seg, (b, s))
 
         def f(q, k, v, interpret):
-            out = flash_attention(q, k, v, causal=True, sliding_window=window,
+            out = flash_attention(q, k, v, causal=causal, sliding_window=window,
                                   segment_ids=seg, interpret=interpret)
             return (out.astype(jnp.float32) * 0.01).sum(), out
 
