@@ -17,7 +17,15 @@ Robustness (the round-1 bench died with a raw traceback when the TPU tunnel
 was down, and its `block_until_ready`-based timing is unreliable through the
 axon tunnel — it understated MFU by ~3x):
   * the backend is probed in a subprocess with a bounded timeout, falling
-    back to CPU (nominal peak) with `"backend": "cpu"` in the output;
+    back to CPU with `"backend": "cpu"` in the output; off-TPU the headline
+    fields are ``value: 0 / vs_baseline: 0`` by contract (a CPU timing is
+    not an MFU measurement) — the sanity timing moves under ``cpu_sanity``;
+  * every successful TPU measurement is persisted to a timestamped
+    ``BENCH_LAST_TPU.json`` next to this script (config + MFU + tok/s +
+    HBM), and the off-TPU fallback line carries that record verbatim under
+    ``last_measured_tpu`` so one tunnel-up window during the round leaves
+    durable, driver-visible evidence (see tools/tpu_watch.py for the
+    re-probing loop);
   * a watchdog thread emits a structured JSON error line and exits if the
     whole run exceeds --watchdog seconds;
   * timing forces real device->host fetches (float()), which the tunnel
@@ -49,15 +57,112 @@ PEAK_BF16_FLOPS = {
 }
 BASELINE_MFU = 0.117  # reference 8xA100 node, see module docstring
 METRIC = "train_mfu_llama_470m_seq1024_1chip"
+LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_LAST_TPU.json")
 
 
 def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
-def fail(reason: str, **extra) -> None:
-    emit({"metric": METRIC, "value": 0.0, "unit": "%MFU", "vs_baseline": 0.0,
-          "error": reason, **extra})
+def metric_name(seq: int) -> str:
+    return METRIC.replace("seq1024", f"seq{seq}")
+
+
+def _evidence_path(seq: int = 1024, tag: str | None = None) -> str:
+    base = LAST_TPU_PATH[:-len(".json")]
+    if tag:
+        return f"{base}_{tag}.json"
+    if seq != 1024:
+        return f"{base}_seq{seq}.json"
+    return LAST_TPU_PATH
+
+
+def load_last_tpu(seq: int = 1024) -> dict | None:
+    """The most recent persisted TPU measurement for this seq, or None."""
+    try:
+        with open(_evidence_path(seq)) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def attach_last_tpu(line: dict, seq: int = 1024) -> dict:
+    """Attach the persisted TPU record matching this run's seq (falling back
+    to the headline record) under ``last_measured_tpu``."""
+    last = load_last_tpu(seq)
+    if last is None and seq != 1024:
+        last = load_last_tpu(1024)
+    if last is not None:
+        line["last_measured_tpu"] = last
+    return line
+
+
+def persist_tpu_result(result: dict, invocation: dict,
+                       stock: bool = False, tag: str | None = None) -> None:
+    """Write the successful TPU measurement to BENCH_LAST_TPU.json.
+
+    Atomic replace so a crash mid-write cannot destroy the previous record;
+    the file is committed to the repo, making the evidence durable across
+    tunnel outages (VERDICT round-2 item 1)."""
+    rec = {
+        "timestamp_unix": int(time.time()),
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "invocation": invocation,
+        **result,
+    }
+    # Only the STOCK invocation may write the headline record (the off-TPU
+    # fallback presents it as evidence for the headline metric, so a sweep
+    # row must never clobber it). Non-stock seq lengths (e.g. the 32K
+    # long-context row) get their own per-seq file; other sweeps land in
+    # a shared _sweep file.
+    seq = invocation.get("seq", 1024)
+    if tag:
+        path = _evidence_path(tag=tag)
+    elif stock:
+        path = LAST_TPU_PATH
+    elif seq != 1024:
+        path = _evidence_path(seq)
+    else:
+        path = _evidence_path(tag="sweep")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass  # persistence is best-effort; the JSON line already went out
+
+
+def fail(reason: str, seq: int = 1024, **extra) -> None:
+    emit(attach_last_tpu(
+        {"metric": metric_name(seq), "value": 0.0, "unit": "%MFU",
+         "vs_baseline": 0.0, "error": reason, **extra}, seq))
+
+
+def cpu_contract_line(result: dict, seq: int = 1024) -> dict:
+    """Off-TPU contract shared by bench.py and tools/moe_bench.py: the
+    headline fields report 0 (a CPU step time divided by a nominal "peak" is
+    not an MFU measurement — round-2 judging flagged the plausible-looking
+    line it produced), the run's numbers survive under ``cpu_sanity`` as a
+    liveness check, and the last persisted TPU record rides along."""
+    sanity = dict(result)
+    metric = sanity.pop("metric", METRIC)
+    unit = sanity.pop("unit", "%MFU")
+    has_vs = "vs_baseline" in sanity
+    for k in ("value", "vs_baseline"):
+        sanity.pop(k, None)
+    line = {"metric": metric, "value": 0.0, "unit": unit}
+    if has_vs:
+        line["vs_baseline"] = 0.0
+    line.update({
+        "backend": "cpu",
+        "note": ("off-TPU: headline 0 by contract; cpu_sanity is a "
+                 "liveness check, last_measured_tpu is the evidence"),
+        "cpu_sanity": sanity,
+    })
+    return attach_last_tpu(line, seq)
 
 
 def probe_backend(timeout_s: float = 120.0) -> str:
@@ -140,7 +245,8 @@ def timed_multistep(step, params, opt_state, batch, iters: int,
 
 
 def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
-              policy: str = None, ce_chunks: int = 0) -> dict:
+              policy: str = None, ce_chunks: int = 0,
+              rope_scaling: float = 1.0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -148,21 +254,28 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
     from megatron_llm_tpu.models import init_model_params, make_config
     from megatron_llm_tpu.training_step import make_jitted_train_step
 
-    layers, hidden = 24, 1024
+    layers, hidden, heads, kv, ffn, vocab = 24, 1024, 16, 16, 4096, 32000
     on_cpu = jax.default_backend() == "cpu"
     if on_cpu:
         # fallback exists to produce *a* line, not a meaningful number
         iters, mbs, layers = 2, 2, 2
+        if seq > 2048:
+            # long-context liveness check: keep the full sequence (RoPE
+            # scaling + masking path under test) but shrink width — the CPU
+            # XLA-attention fallback materializes [sq, skv] scores, which at
+            # real width would run for tens of minutes or OOM
+            mbs, hidden, heads, kv, ffn, vocab = 1, 256, 4, 4, 1024, 2048
     cfg = make_config(
         "llama2",
         num_layers=layers,
         hidden_size=hidden,
-        num_attention_heads=16,
-        num_attention_heads_kv=16,
-        ffn_hidden_size=4096,
-        vocab_size=32000,
+        num_attention_heads=heads,
+        num_attention_heads_kv=kv,
+        ffn_hidden_size=ffn,
+        vocab_size=vocab,
         seq_length=seq,
         max_position_embeddings=max(2048, seq),
+        rope_scaling_factor=rope_scaling,
         params_dtype="bfloat16",
         micro_batch_size=mbs,
         global_batch_size=mbs,
@@ -187,7 +300,7 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
         step, _opt, sh = make_jitted_train_step(cfg, mesh, params)
         opt_state = sh["opt_state_value"]
 
-        tok = jax.random.randint(jax.random.PRNGKey(1), (mbs, seq + 1), 0, 32000)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (mbs, seq + 1), 0, vocab)
         batch = sh["place_batch"]({
             "tokens": tok[:, :-1],
             "labels": tok[:, 1:],
@@ -220,7 +333,7 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
 
     mfu = flops_per_token(n_params, layers, hidden, seq) * mbs * seq / dt / peak_flops()
     return {
-        "metric": METRIC,
+        "metric": metric_name(seq),
         "value": round(mfu * 100, 2),
         "unit": "%MFU",
         "vs_baseline": round(mfu / BASELINE_MFU, 3),
@@ -251,6 +364,9 @@ def main() -> None:
                          "save_dots_except_logits)")
     ap.add_argument("--ce_chunks", type=int, default=0,
                     help="vocab chunks for head-fused CE (0 = off)")
+    ap.add_argument("--rope_scaling", type=float, default=1.0,
+                    help="RoPE position-interpolation factor (long-context "
+                         "mode, e.g. --seq 32768 --rope_scaling 8)")
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=1500.0)
     args = ap.parse_args()
@@ -260,29 +376,31 @@ def main() -> None:
     def on_timeout():
         if finished.is_set():  # result already emitted; don't double-print
             return
-        fail(f"watchdog: bench exceeded {args.watchdog}s")
+        fail(f"watchdog: bench exceeded {args.watchdog}s", seq=args.seq)
         os._exit(3)
 
     dog = threading.Timer(args.watchdog, on_timeout)
     dog.daemon = True
     dog.start()
 
-    if probe_backend(args.probe_timeout) == "cpu":
-        from megatron_llm_tpu.utils.platform import pin_cpu_platform
-
-        pin_cpu_platform()
+    first_error = None
     try:
+        if probe_backend(args.probe_timeout) == "cpu":
+            from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+            pin_cpu_platform()
         # insurance: if the TUNED DEFAULT config fails on this chip (e.g. an
         # HBM regression), fall back to the conservative selective + mbs 8
         # config rather than reporting nothing. Only the stock invocation is
         # eligible — sweeps must surface their own errors.
-        stock = (args.mbs, args.seq, args.recompute, args.policy,
-                 args.ce_chunks) == (16, 1024, "full", None, 0)
-        first_error = None
+        stock = (args.iters, args.mbs, args.seq, args.recompute, args.policy,
+                 args.ce_chunks, args.rope_scaling) == (20, 16, 1024, "full",
+                                                        None, 0, 1.0)
         try:
             result = run_bench(args.iters, args.mbs, args.seq,
                                recompute=args.recompute, policy=args.policy,
-                               ce_chunks=args.ce_chunks)
+                               ce_chunks=args.ce_chunks,
+                               rope_scaling=args.rope_scaling)
         except Exception as e:
             if not stock:
                 raise
@@ -294,12 +412,28 @@ def main() -> None:
             result["fallback_config"] = f"mbs8-selective ({first_error})"
         finished.set()
         dog.cancel()
-        emit(result)
+        if result["backend"] != "cpu":
+            persist_tpu_result(result, {
+                "iters": args.iters, "mbs": args.mbs, "seq": args.seq,
+                "recompute": args.recompute, "policy": args.policy,
+                "ce_chunks": args.ce_chunks,
+                "rope_scaling": args.rope_scaling,
+                "fallback_config": result.get("fallback_config"),
+            }, stock=stock)
+            emit(result)
+        else:
+            # Off-TPU the headline MUST be 0 — a CPU step time divided by a
+            # nominal "peak" is not an MFU measurement, and round-2 judging
+            # flagged the plausible-looking 6.75%MFU/0.577 line it produced.
+            # The run still proves the train step executes end to end, so
+            # its numbers survive under cpu_sanity, and the last committed
+            # TPU measurement rides along for the driver.
+            emit(cpu_contract_line(result, args.seq))
     except Exception as e:  # structured error, never a bare traceback
         finished.set()
         dog.cancel()
         extra = {"first_error": first_error} if first_error else {}
-        fail(f"{type(e).__name__}: {e}", **extra)
+        fail(f"{type(e).__name__}: {e}", seq=args.seq, **extra)
         sys.exit(1)
 
 

@@ -19,8 +19,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402
+    cpu_contract_line,
     flops_per_token,
     peak_flops,
+    persist_tpu_result,
     probe_backend,
     timed_multistep,
 )
@@ -84,7 +86,7 @@ def main():
         active = n_params - expert_params * (E - K) // E
         flops_tok = flops_per_token(active, L, h, seq)  # shared accounting
         mfu = flops_tok * mbs * seq / best / peak_flops()
-        print(json.dumps({
+        result = {
             "metric": f"train_active_mfu_moe{E}x{K}_seq{seq}_1chip",
             "value": round(mfu * 100, 2),
             "unit": "%MFU(active)",
@@ -96,7 +98,13 @@ def main():
             "loss": round(last[0], 4),
             "aux": round(last[1], 4),
             "backend": jax.devices()[0].platform,
-        }), flush=True)
+        }
+        if result["backend"] != "cpu":
+            persist_tpu_result(result, vars(args), tag=f"moe{E}x{K}")
+        else:
+            # same off-TPU contract as bench.py: never a nominal-peak MFU
+            result = cpu_contract_line(result, seq)
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

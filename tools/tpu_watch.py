@@ -1,0 +1,163 @@
+"""Re-probe the TPU tunnel through the round; capture evidence when it's up.
+
+The axon tunnel to the single v5e chip goes down for hours at a time (both
+prior rounds' driver bench runs hit an outage window). This watcher makes one
+tunnel-up window sufficient: it probes the backend every --interval seconds
+(default 15 min, VERDICT round-2 item 1c) in a bounded subprocess, and when
+the TPU answers it runs the evidence jobs in order:
+
+  1. ``python bench.py``                      -> writes BENCH_LAST_TPU.json
+  2. ``python tools/tpu_kernel_check.py``     -> compiled-vs-interpret incl.
+                                                 the bidirectional cases
+  3. ``python bench.py --seq 32768 ...``      -> long-context HBM + MFU row
+
+A job only counts as captured if its OUTPUT proves it ran on TPU (every job
+exits 0 on its graceful CPU fallback, so rc alone is meaningless when the
+tunnel drops between the probe and the job). Each attempt's outcome (rc +
+output tail) is appended to TPU_WATCH_LOG.jsonl so the history itself is
+committable evidence.
+
+Operational caveat (learned round 2): the tunnel wedges for hours if a client
+is killed mid-step, so the bench jobs get NO subprocess timeout — bench.py
+carries its own watchdog that exits the process cleanly. Only the kernel
+check (no internal watchdog) gets a generous last-resort --job_timeout.
+
+Usage:  python tools/tpu_watch.py [--once] [--interval 900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import probe_backend  # bounded-subprocess probe
+
+LOG_PATH = os.path.join(REPO, "TPU_WATCH_LOG.jsonl")
+
+
+def _bench_on_tpu(tail: str) -> bool:
+    """Did a bench.py invocation actually measure on TPU? Parse its one
+    JSON line; the CPU-contract fallback reports backend 'cpu' and must
+    not count as captured evidence."""
+    for line in reversed(tail.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return rec.get("backend") not in (None, "cpu")
+    return False
+
+
+def _kernel_check_on_tpu(tail: str) -> bool:
+    # prints "backend: tpu (TPU v5e...)" on hardware; "not on TPU —
+    # numerics-only" on the CPU fallback (tools/tpu_kernel_check.py:227-231)
+    return "backend: tpu" in tail or "backend: TPU" in tail
+
+
+JOBS = [
+    # (name, cmd, needs_timeout, tpu_evidence_predicate)
+    ("bench_stock", [sys.executable, "bench.py"], False, _bench_on_tpu),
+    ("kernel_check", [sys.executable, "tools/tpu_kernel_check.py", "--quick"],
+     True, _kernel_check_on_tpu),
+    ("bench_32k", [sys.executable, "bench.py", "--seq", "32768",
+                   "--rope_scaling", "8", "--mbs", "1", "--iters", "4"],
+     False, _bench_on_tpu),
+]
+
+
+def log(event: dict) -> None:
+    event = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **event}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(event) + "\n")
+    print(json.dumps(event), flush=True)
+
+
+def run_job(name: str, cmd: list[str], timeout_s: float | None,
+            on_tpu) -> bool:
+    """Returns True iff the job produced TPU evidence (ran on hardware).
+
+    A job that ran on TPU and FAILED still counts as captured — a confirmed
+    hardware failure is the round's most important evidence, and re-running
+    a deterministic failure every probe window would burn the scarce
+    tunnel-up time. rc is logged alongside so the log distinguishes
+    pass/fail."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log({"job": name, "rc": -1, "error": f"timeout {timeout_s}s",
+             "seconds": round(time.time() - t0, 1)})
+        return False
+    # predicate sees FULL stdout (the kernel check prints its "backend: tpu"
+    # header first, well before the last-2000-char log tail)
+    captured = on_tpu(r.stdout or "")
+    tail = (r.stdout or "")[-2000:]
+    err_tail = (r.stderr or "")[-500:] if r.returncode != 0 else ""
+    log({"job": name, "rc": r.returncode, "tpu_evidence": captured,
+         "passed": r.returncode == 0,
+         "seconds": round(time.time() - t0, 1),
+         "tail": tail, **({"stderr_tail": err_tail} if err_tail else {})})
+    return captured
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0,
+                    help="seconds between backend probes")
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--job_timeout", type=float, default=3600.0,
+                    help="last-resort kill for jobs without an internal "
+                         "watchdog (the bench jobs are exempt — killing a "
+                         "mid-step tunnel client wedges the tunnel)")
+    ap.add_argument("--once", action="store_true",
+                    help="probe once, run jobs if TPU is up, exit")
+    ap.add_argument("--max_hours", type=float, default=12.0)
+    ap.add_argument("--jobs", default=None,
+                    help="comma-separated subset of job names to run")
+    args = ap.parse_args()
+
+    names = {n for n, _, _, _ in JOBS}
+    wanted = set(args.jobs.split(",")) if args.jobs else names
+    unknown = wanted - names
+    if unknown:
+        ap.error(f"unknown --jobs {sorted(unknown)}; valid: {sorted(names)}")
+
+    deadline = time.time() + args.max_hours * 3600
+    captured: set[str] = set()
+    attempts: dict[str, int] = {}
+    MAX_ATTEMPTS = 5  # evidence-free attempts per job (tunnel drop mid-job)
+
+    while time.time() < deadline:
+        backend = probe_backend(args.probe_timeout)
+        log({"probe": backend})
+        if backend == "tpu":
+            for name, cmd, bounded, on_tpu in JOBS:
+                if (name not in wanted or name in captured
+                        or attempts.get(name, 0) >= MAX_ATTEMPTS):
+                    continue
+                attempts[name] = attempts.get(name, 0) + 1
+                timeout_s = args.job_timeout if bounded else None
+                if run_job(name, cmd, timeout_s, on_tpu):
+                    captured.add(name)
+            exhausted = {n for n, k in attempts.items() if k >= MAX_ATTEMPTS}
+            if captured | exhausted >= wanted:
+                log({"done": sorted(captured),
+                     **({"gave_up": sorted(exhausted - captured)}
+                        if exhausted - captured else {})})
+                return
+        if args.once:
+            return
+        time.sleep(args.interval)
+    log({"deadline_reached": True, "captured": sorted(captured)})
+
+
+if __name__ == "__main__":
+    main()
