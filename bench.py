@@ -78,20 +78,21 @@ def _evidence_path(seq: int = 1024, tag: str | None = None) -> str:
     return LAST_TPU_PATH
 
 
-def load_last_tpu(seq: int = 1024) -> dict | None:
-    """The most recent persisted TPU measurement for this seq, or None."""
+def load_last_tpu(seq: int = 1024, tag: str | None = None) -> dict | None:
+    """The most recent persisted TPU measurement for this seq/tag, or None."""
     try:
-        with open(_evidence_path(seq)) as f:
+        with open(_evidence_path(seq, tag)) as f:
             return json.load(f)
     except Exception:
         return None
 
 
-def attach_last_tpu(line: dict, seq: int = 1024) -> dict:
-    """Attach the persisted TPU record matching this run's seq (falling back
-    to the headline record) under ``last_measured_tpu``."""
-    last = load_last_tpu(seq)
-    if last is None and seq != 1024:
+def attach_last_tpu(line: dict, seq: int = 1024,
+                    tag: str | None = None) -> dict:
+    """Attach the persisted TPU record matching this run's seq/tag (falling
+    back to the headline record) under ``last_measured_tpu``."""
+    last = load_last_tpu(seq, tag)
+    if last is None and (seq != 1024 or tag):
         last = load_last_tpu(1024)
     if last is not None:
         line["last_measured_tpu"] = last
@@ -141,7 +142,8 @@ def fail(reason: str, seq: int = 1024, **extra) -> None:
          "vs_baseline": 0.0, "error": reason, **extra}, seq))
 
 
-def cpu_contract_line(result: dict, seq: int = 1024) -> dict:
+def cpu_contract_line(result: dict, seq: int = 1024,
+                      tag: str | None = None) -> dict:
     """Off-TPU contract shared by bench.py and tools/moe_bench.py: the
     headline fields report 0 (a CPU step time divided by a nominal "peak" is
     not an MFU measurement — round-2 judging flagged the plausible-looking
@@ -162,7 +164,7 @@ def cpu_contract_line(result: dict, seq: int = 1024) -> dict:
                  "liveness check, last_measured_tpu is the evidence"),
         "cpu_sanity": sanity,
     })
-    return attach_last_tpu(line, seq)
+    return attach_last_tpu(line, seq, tag)
 
 
 def probe_backend(timeout_s: float = 120.0) -> str:

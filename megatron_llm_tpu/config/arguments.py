@@ -225,6 +225,10 @@ class OptimizerConfig:
     adam_beta2: float = 0.999
     adam_eps: float = 1e-8
     sgd_momentum: float = 0.9
+    # memory-bounded per-layer-slice Adam update (same math as the optax
+    # chain; the TPU analog of apex multi-tensor FusedAdam's bounded
+    # working set — see optimizer.scanned_adam)
+    scanned_update: bool = True
     # ZeRO-1: shard fp32 optimizer state over dp (reference distrib_optimizer.py)
     use_distributed_optimizer: bool = False
 
@@ -383,9 +387,11 @@ class Config:
         # sequence parallelism requires TP>1 to do anything
         if self.parallel.tensor_model_parallel_size == 1:
             self.parallel.sequence_parallel = False
-        # bf16 training accumulates grads in fp32 (reference validate_args:139-148)
-        if t.params_dtype in ("bfloat16", "float16"):
-            t.accumulate_allreduce_grads_in_fp32 = True
+        # bf16 training accumulates grads in fp32 by DEFAULT (reference
+        # validate_args:139-148 forces it; here an explicit False is
+        # honored — halving the accumulator is what fits Llama-7B TP=8 on
+        # 16-GiB v5e chips, tools/aot_scale_check.py) — the dataclass
+        # default is already True, so nothing to force.
         if self.model.num_attention_heads_kv is not None:
             assert (
                 self.model.num_attention_heads % self.model.num_attention_heads_kv == 0
@@ -417,6 +423,22 @@ class Config:
             assert self.model.moe_router_type in ("topk", "expert_choice"), (
                 f"unknown moe_router_type {self.model.moe_router_type!r}"
             )
+            if self.model.moe_router_type == "expert_choice":
+                # EC routing compares tokens across positions within a
+                # routing group, leaking future-token information into the
+                # selection — unsound for causal-LM TRAINING (the only
+                # families MoE attaches to here). Loud warning rather than
+                # an error: fine for encoders-to-come and research runs.
+                import warnings
+
+                warnings.warn(
+                    "moe_router_type='expert_choice' leaks future-token "
+                    "information within each routing group; a causal LM "
+                    "trained with it can exploit the leak. Use the default "
+                    "'topk' token-choice routing for production causal-LM "
+                    "training (models/moe.py:route_expert_choice).",
+                    stacklevel=2,
+                )
             if self.parallel.data_parallel_size is not None:
                 # auto-inferred dp (None) is validated later by build_mesh
                 assert self.parallel.data_parallel_size % ep == 0, (

@@ -89,6 +89,49 @@ def process_batch_slice(global_batch_size: int) -> Tuple[int, int]:
     return pid * per, (pid + 1) * per
 
 
+_VALIDATED_SLICES: set = set()
+
+
+def validate_process_batch_slice(sharding, global_shape) -> None:
+    """Fail fast (and clearly) when hosts' loaded rows don't match their chips.
+
+    ``process_batch_slice`` assumes each host's devices own exactly its
+    contiguous dp-row block of the global batch. That holds when model axes
+    (tp/pp/cp) stay INTRA-host (each host's chips share all dp rows), but a
+    mesh whose tp group spans hosts (e.g. 4-chip hosts with tp=8) breaks
+    it: make_array_from_process_local_data would then fail with a shape
+    error far from the root cause, or worse, place wrong rows. Memoized on
+    (sharding, shape): runs once per configuration, not per step (ADVICE
+    round 2); see docs/guide/multihost.md for the layout rules.
+    """
+    global_shape = tuple(global_shape)
+    memo_key = (sharding, global_shape)
+    if memo_key in _VALIDATED_SLICES:
+        return
+    gbs = global_shape[0]
+    start, stop = process_batch_slice(gbs)
+    pid = jax.process_index()
+    rows: set = set()
+    # dim-0 index range each addressable device reads, per the sharding
+    for d, idx in sharding.devices_indices_map(global_shape).items():
+        if d.process_index != pid:
+            continue
+        r = idx[0]
+        rows.update(range(r.start or 0, gbs if r.stop is None else r.stop))
+    expected = set(range(start, stop))
+    if rows != expected:
+        raise ValueError(
+            "multi-host batch layout mismatch: process "
+            f"{pid} loads global rows [{start}, {stop}) but its devices "
+            f"are assigned rows {sorted(rows)}. This happens when a model "
+            "axis (tp/pp/cp) spans hosts so dp rows interleave across "
+            "processes. Keep tp/pp/cp groups intra-host, or load rows "
+            "matching the sharding's addressable indices "
+            "(docs/guide/multihost.md)."
+        )
+    _VALIDATED_SLICES.add(memo_key)
+
+
 def place_host_local_batch(batch: Dict[str, Any],
                            shardings: Dict[str, Any]) -> Dict[str, Any]:
     """Assemble global batch arrays from per-host local rows.
@@ -103,11 +146,16 @@ def place_host_local_batch(batch: Dict[str, Any],
     if jax.process_count() == 1:
         return jax.device_put(batch, shardings)
 
+    validated = False
     out = {}
     for k, v in batch.items():
         v = np.asarray(v)
         s = shardings[k]
         if k != "token_idx":
+            if not validated:
+                gshape = (v.shape[0] * jax.process_count(), *v.shape[1:])
+                validate_process_batch_slice(s, gshape)
+                validated = True
             out[k] = jax.make_array_from_process_local_data(s, v)
         else:
             out[k] = jax.device_put(v, s)

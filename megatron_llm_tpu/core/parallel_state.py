@@ -136,6 +136,22 @@ def destroy_global_mesh() -> None:
     _GLOBAL_MESH = None
 
 
+def target_platform() -> str:
+    """Platform the current mesh's devices belong to ('tpu'/'cpu').
+
+    Kernel dispatch must key on the COMPILE TARGET, not the host default
+    backend: AOT-lowering a TPU-topology mesh (tools/aot_scale_check.py)
+    happens on a CPU host, and the compiled program must still contain the
+    Pallas flash path it will run on hardware. Falls back to
+    jax.default_backend() when no mesh is set (single-chip eager use)."""
+    if _GLOBAL_MESH is not None:
+        try:
+            return _GLOBAL_MESH.devices.flat[0].platform
+        except (AttributeError, IndexError):
+            pass  # AbstractMesh has no devices; fall through
+    return jax.default_backend()
+
+
 @contextlib.contextmanager
 def global_mesh(mesh: Mesh):
     global _GLOBAL_MESH

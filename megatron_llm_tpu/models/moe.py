@@ -128,9 +128,15 @@ def route_expert_choice(
     sel = jax.nn.one_hot(idx, t_, dtype=jnp.float32)  # [G,E,C,T]
     combine = (sel * vals[..., None]).transpose(0, 3, 1, 2)  # [G,T,E,C]
     dispatch = combine > 0.0
-    # balance loss is identically its optimum under EC; report 1.0 so the
-    # "moe aux loss" metric stays comparable across router types
-    aux = jnp.stack([jnp.float32(1.0), _router_z_loss(router_logits)])
+    # The Switch balance loss is identically at its optimum under EC (every
+    # expert serves exactly C tokens), so reporting it would be a constant.
+    # The balance slot instead carries EC's real health signal: the
+    # DROPPED-TOKEN fraction (tokens selected by no expert). 0.0 = full
+    # coverage. Metric-only: aux_loss_coeffs zeroes the balance coefficient
+    # for expert_choice, so this never enters the training loss.
+    covered = dispatch.any(axis=(2, 3))  # [G, T]
+    dropped = 1.0 - covered.mean().astype(jnp.float32)
+    aux = jnp.stack([dropped, _router_z_loss(router_logits)])
     return combine, dispatch, aux
 
 
@@ -243,10 +249,12 @@ def zero_aux() -> jax.Array:
 def aux_loss_coeffs(cfg) -> Tuple[float, float]:
     """(balance_coeff, z_coeff) to apply to the summed aux pair.
 
-    Expert-choice routing is balanced by construction: its reported balance
-    metric is the constant 1.0/layer, which must NOT enter the trained loss
-    (it would add a constant offset and skew loss curves vs token-choice
-    runs) — so the balance coefficient is zeroed there.
+    Expert-choice routing is balanced by construction, so it has no
+    balance LOSS; its aux[0] slot instead reports the dropped-token
+    fraction (route_expert_choice) as a metric. That value is
+    piecewise-constant in the router weights (gradient-free) and must NOT
+    enter the trained loss — the balance coefficient stays zeroed for EC
+    regardless of what the slot reports.
     """
     m = cfg.model
     balance = 0.0 if m.moe_router_type == "expert_choice" else m.moe_aux_loss_coeff

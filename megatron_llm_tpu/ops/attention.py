@@ -36,6 +36,32 @@ def _flash_available() -> bool:
         return False
 
 
+def _nested_manual_dp_and_tp() -> bool:
+    """True when flash would need a nested shard_map over BOTH dp and tp
+    inside an enclosing manual (pipeline) context — a combination that hits
+    an XLA SPMD-partitioner CHECK crash (spmd_partitioner_util.cc:506:
+    partition_group_list counts; reproduced by tools/aot_scale_check.py's
+    70B tp8 x pp8 x dp4 config and minimized to dp2 x pp2 x tp2). The
+    dispatcher falls back to xla_attention for exactly this combination:
+    pp x dp x tp configs run at moderate seq (bias fits), and long-seq
+    configs add cp>1 which routes to ring attention before this check.
+    Revisit when the XLA bug is fixed."""
+    import jax.sharding as jsh
+
+    from megatron_llm_tpu.core import parallel_state as ps
+
+    abstract = jsh.get_abstract_mesh()
+    if abstract is None or abstract.empty or not abstract.manual_axes:
+        return False
+    if not ps.mesh_is_initialized():
+        return False
+    shape = ps.get_global_mesh().shape
+    dp = 1
+    for ax in ps.DATA_AXES:
+        dp *= shape.get(ax, 1)
+    return dp > 1 and shape.get(ps.TP_AXIS, 1) > 1
+
+
 def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
                    block_kv, causal=True):
     """Run the Pallas kernel, wrapped in shard_map when a non-trivial mesh is
@@ -62,18 +88,35 @@ def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
+    # Nested-manual composition: called from inside an enclosing shard_map
+    # (the pipeline engine manualizes pp/cp), the inner shard_map must bind
+    # the CONTEXT abstract mesh — passing the concrete global mesh raises a
+    # mesh-mismatch. The specs below reference only dp/ep/tp, which remain
+    # Auto in that context (same pattern as parallel/ring.cp_is_manual).
+    # Manualize every axis not already manual in the enclosing context:
+    # Mosaic kernels reject being left under ANY auto axis (even size-1),
+    # and an enclosing pipeline shard_map has already manualized pp/cp.
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and not abstract.empty and abstract.manual_axes:
+        mesh = abstract
+        names = set(mesh.axis_names) - set(mesh.manual_axes)
+    else:
+        names = set(mesh.axis_names)
+
     qs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
     kvs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
     segs = P(ps.DATA_AXES, None)
     if segment_ids is None:
         fn = shard_map(
             lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
-            mesh=mesh, in_specs=(qs, kvs, kvs), out_specs=qs, check_vma=False,
+            mesh=mesh, in_specs=(qs, kvs, kvs), out_specs=qs,
+            axis_names=names, check_vma=False,
         )
         return fn(q, k, v)
     fn = shard_map(
         lambda q_, k_, v_, s_: flash_attention(q_, k_, v_, segment_ids=s_, **kwargs),
-        mesh=mesh, in_specs=(qs, kvs, kvs, segs), out_specs=qs, check_vma=False,
+        mesh=mesh, in_specs=(qs, kvs, kvs, segs), out_specs=qs,
+        axis_names=names, check_vma=False,
     )
     return fn(q, k, v, segment_ids)
 
@@ -169,9 +212,12 @@ def attention(
     """Dispatch between ring attention (cp > 1), the Pallas flash kernel,
     and the XLA fallback."""
     sq = q.shape[1]
-    on_tpu = jax.default_backend() == "tpu"
 
     from megatron_llm_tpu.core import parallel_state as ps
+
+    # compile-TARGET platform, not the host backend: AOT lowering for a TPU
+    # topology on a CPU host must still pick the flash kernel
+    on_tpu = ps.target_platform() == "tpu"
 
     cp = (
         ps.get_context_parallel_world_size()
@@ -200,6 +246,7 @@ def attention(
         and sq >= 128
         and q.shape[-1] in (64, 128, 256)
         and _flash_available()
+        and not _nested_manual_dp_and_tp()
     )
     if flash_ok:
         return _flash_sharded(

@@ -19,7 +19,7 @@ clip_grads.py, grad_scaler.py). The TPU design collapses most of that code:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,9 +44,169 @@ def _no_weight_decay_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def _cast_updates_like_params(params: Any) -> optax.GradientTransformation:
+    """Cast Adam's fp32 update tree to each param's storage dtype.
+
+    HBM, not numerics: the fp32 ``updates`` tree XLA materializes between
+    chain stages is 2x the bf16 param size per leaf, and on a
+    params+optimizer-bound config (Llama-7B TP=8 on 16-GiB v5e chips,
+    tools/aot_scale_check.py) those temps are the difference between
+    fitting and OOM. For bf16 params the final ``p + u`` rounds to bf16
+    regardless, so casting u early loses nothing it wasn't already losing;
+    for fp32 params (fp16 master mode) the cast is a no-op."""
+    dtypes = jax.tree.map(lambda p: jnp.asarray(p).dtype if not hasattr(
+        p, "dtype") else p.dtype, params)
+
+    def update_fn(updates, state, params=None):
+        del params
+        return jax.tree.map(
+            lambda u, d: u.astype(d), updates, dtypes), state
+
+    return optax.GradientTransformation(
+        lambda _: optax.EmptyState(), update_fn)
+
+
+_SCAN_UPDATE_MIN_ELEMENTS = 1 << 24  # 16M: ~64 MiB of fp32 moments
+# slice only layer-STACK leaves (leading axis = num_layers, tens of
+# entries). A big 2-D leaf like a [32000, h] embedding must update whole:
+# fori-looping its rows would mean tens of thousands of sequential tiny
+# updates (measured: turned the 2-layer CPU bench from ~150 s into >9 min)
+_SCAN_UPDATE_MAX_LEADING = 256
+
+
+class FusedGradientTransformation(NamedTuple):
+    """optax GradientTransformation + a memory-bounded direct-apply form.
+
+    Ducks as a GradientTransformation (init/update); ``fused_apply(grads,
+    state, params, prescale) -> (new_params, new_state)`` additionally
+    updates params in place slice-by-slice (see scanned_adam)."""
+
+    init: Callable
+    update: Callable
+    fused_apply: Callable
+
+
+def scanned_adam(cfg, params: Any) -> optax.GradientTransformation:
+    """Adam + global clip + weight decay + lr with a memory-bounded apply.
+
+    The TPU analog of the reference's multi-tensor apex FusedAdam
+    (optimizer/optimizer.py:58), which exists for the same reason: a
+    whole-tree optax chain materializes fp32 temps (upcast grads, moment
+    double-buffers, updates) the size of the full parameter stack, and with
+    scan-stacked layers one leaf is gigabytes. On a params-bound config
+    (Llama-7B TP=8 on 16-GiB v5e: tools/aot_scale_check.py) those temps +
+    fragmentation are the difference between fitting and OOM.
+
+    Two call forms:
+
+    * the standard optax ``update`` (used under the fp16 scaler wrapper):
+      whole-leaf math, same temps as the chain;
+    * ``fused_apply(grads, state, params, prescale=1.0) -> (new_params,
+      new_state)`` — the memory-bounded form ``make_train_step`` uses
+      directly for bf16/fp32. Adam is elementwise, so each large leaf is
+      updated IN PLACE slice-by-slice with ``lax.fori_loop`` +
+      ``.at[i].set`` on the donated buffers (while-loop carries alias;
+      ``lax.scan`` outputs cannot — measured: scan ys cost three extra
+      fc1-stack AllocateBuffers on the 7B config). ``prescale`` folds the
+      1/num_micro grad average in, saving another full-tree temp.
+
+    Semantics match the optax chain in :func:`get_optimizer` stage for
+    stage: clip_by_global_norm -> scale_by_adam(b1,b2,eps) ->
+    add_decayed_weights(masked) -> scale_by_learning_rate -> cast to param
+    dtype (tests/test_optimizer.py parity). State is an
+    ``optax.ScaleByAdamState`` so ZeRO-1 sharding (path-suffix matching)
+    and checkpointing see the familiar structure.
+    """
+    o = cfg.optimizer
+    lr_fn = lr_schedule(cfg)
+    wd_fn = wd_schedule(cfg)
+    b1, b2, eps = o.adam_beta1, o.adam_beta2, o.adam_eps
+    clip = o.clip_grad if (o.clip_grad and o.clip_grad > 0) else None
+    wd_mask = _no_weight_decay_mask(params)
+    wd_const = (o.weight_decay
+                if o.weight_decay_incr_style == "constant" else None)
+
+    def init_fn(params):
+        f32_zeros = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(f32_zeros, params),
+            nu=jax.tree.map(f32_zeros, params),
+        )
+
+    def _scalars(state, grads, prescale):
+        c = optax.safe_int32_increment(state.count)
+        cf = c.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+        # lr stage counts from 0 in the optax chain (its own state starts
+        # at 0 and is read before increment)
+        lr = lr_fn(state.count)
+        wd = wd_const if wd_const is not None else wd_fn(state.count)
+        if clip is not None:
+            gnorm = optax.global_norm(grads) * prescale
+            clip_scale = jnp.minimum(1.0, clip / (gnorm + 1e-6)) * prescale
+        else:
+            clip_scale = jnp.float32(1.0) * prescale
+        return c, bc1, bc2, lr, wd, clip_scale
+
+    def make_one(bc1, bc2, lr, wd, clip_scale):
+        def one(g, mu, nu, p, decay):
+            gf = g.astype(jnp.float32) * clip_scale
+            mu2 = b1 * mu + (1.0 - b1) * gf
+            nu2 = b2 * nu + (1.0 - b2) * gf * gf
+            u = (mu2 / bc1) / (jnp.sqrt(nu2 / bc2) + eps)
+            if decay:
+                u = u + wd * p.astype(jnp.float32)
+            return mu2, nu2, (-lr * u).astype(p.dtype)
+
+        return one
+
+    def update_fn(grads, state, params):
+        assert params is not None, "scanned_adam needs params (weight decay)"
+        c, bc1, bc2, lr, wd, clip_scale = _scalars(state, grads, 1.0)
+        one = make_one(bc1, bc2, lr, wd, clip_scale)
+        out = jax.tree.map(one, grads, state.mu, state.nu, params, wd_mask)
+        tup = lambda t: isinstance(t, tuple)  # noqa: E731
+        mu2 = jax.tree.map(lambda t: t[0], out, is_leaf=tup)
+        nu2 = jax.tree.map(lambda t: t[1], out, is_leaf=tup)
+        updates = jax.tree.map(lambda t: t[2], out, is_leaf=tup)
+        return updates, optax.ScaleByAdamState(count=c, mu=mu2, nu=nu2)
+
+    def fused_apply(grads, state, params, prescale=1.0):
+        c, bc1, bc2, lr, wd, clip_scale = _scalars(state, grads, prescale)
+        one = make_one(bc1, bc2, lr, wd, clip_scale)
+
+        def leaf(g, mu, nu, p, decay):
+            if (p.ndim >= 2 and 1 < p.shape[0] <= _SCAN_UPDATE_MAX_LEADING
+                    and p.size >= _SCAN_UPDATE_MIN_ELEMENTS):
+                def body(i, carry):
+                    mu, nu, p = carry
+                    mu_i, nu_i, u_i = one(g[i], mu[i], nu[i], p[i], decay)
+                    return (mu.at[i].set(mu_i), nu.at[i].set(nu_i),
+                            p.at[i].set(p[i] + u_i))
+
+                return jax.lax.fori_loop(0, p.shape[0], body, (mu, nu, p))
+            mu2, nu2, u = one(g, mu, nu, p, decay)
+            return mu2, nu2, p + u
+
+        out = jax.tree.map(leaf, grads, state.mu, state.nu, params, wd_mask)
+        tup = lambda t: isinstance(t, tuple)  # noqa: E731
+        mu2 = jax.tree.map(lambda t: t[0], out, is_leaf=tup)
+        nu2 = jax.tree.map(lambda t: t[1], out, is_leaf=tup)
+        new_params = jax.tree.map(lambda t: t[2], out, is_leaf=tup)
+        return new_params, optax.ScaleByAdamState(count=c, mu=mu2, nu=nu2)
+
+    return FusedGradientTransformation(init_fn, update_fn, fused_apply)
+
+
 def get_optimizer(cfg, params: Any) -> optax.GradientTransformation:
     """get_megatron_optimizer analog (optimizer/__init__.py:63-144)."""
     o = cfg.optimizer
+    if o.optimizer == "adam" and o.scanned_update:
+        from megatron_llm_tpu.optimizer.grad_scaler import scaler_from_config
+
+        return scaler_from_config(cfg, scanned_adam(cfg, params))
     lr_fn = lr_schedule(cfg)
     wd_fn = wd_schedule(cfg)
     chain = []
@@ -68,6 +228,9 @@ def get_optimizer(cfg, params: Any) -> optax.GradientTransformation:
             optax.add_decayed_weights(weight_decay=wd, mask=_no_weight_decay_mask(params))
         )
     chain.append(optax.scale_by_learning_rate(lr_fn))
+    # LAST stage (jnp promotion would undo an earlier cast: f32 lr scalar x
+    # bf16 updates -> f32): keep the final update tree in param storage dtype
+    chain.append(_cast_updates_like_params(params))
     opt = optax.chain(*chain)
     # fp16 wraps the whole chain in loss-scale bookkeeping + skip-on-overflow
     # (grad_scaler.py + MixedPrecisionOptimizer.step semantics); bf16/fp32

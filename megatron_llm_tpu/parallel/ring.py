@@ -42,6 +42,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from megatron_llm_tpu.core import parallel_state as ps
 from megatron_llm_tpu.ops.attention import NEG_INF
 
+# Row-blocking of the ring online softmax (see _ring_attention_local):
+# local seqs above the threshold process Q rows in blocks of this size.
+_Q_BLOCK_THRESHOLD = 4096
+_Q_BLOCK_ROWS = 2048
+
 
 # ---------------------------------------------------------------------------
 # Zigzag load balancing (pure data transform)
@@ -116,42 +121,94 @@ def _ring_attention_local(
     g = n // nkv
     qg = (q.astype(jnp.float32) * scale).reshape(b, sq, nkv, g, d)
 
+    # Row-block the online softmax: a full [.., sq, skv] fp32 score tensor
+    # is ~8.6 GiB per layer at the 32K/cp=2 BASELINE config (heads 8, 16K x
+    # 16K) and OOMs v5p during backward (tools/aot_scale_check.py found
+    # this). Q rows are independent in online softmax, so scanning blocks
+    # of rows inside each ring step bounds the live score temps to
+    # [.., blk, skv] with bitwise-identical results.
+    if sq <= _Q_BLOCK_THRESHOLD:
+        blk = sq
+    else:
+        # largest divisor of sq within the block budget — NOT a fall back
+        # to one full-seq block, which would silently reintroduce the OOM
+        # for seqs that don't divide evenly (e.g. local seq 5120)
+        blk = max(d for d in range(1, _Q_BLOCK_ROWS + 1) if sq % d == 0)
+    nb = sq // blk
+
     # send chunk i -> i+1 each step; after t steps a device holds the K/V
     # chunk of cp-rank (i - t) % cp. The rotated kv_idx tracks that for us.
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def allowed_mask(kv_idx_t, seg_kv_t):
-        ok = jnp.ones((1, sq, k.shape[1]), dtype=bool)
-        qi = q_idx[:, None]
+    def allowed_mask(qi_b, kv_idx_t, seg_q_b, seg_kv_t):
+        # [1 or b, blk, skv] for one row block
+        ok = jnp.ones((1, qi_b.shape[0], k.shape[1]), dtype=bool)
+        qi = qi_b[:, None]
         ki = kv_idx_t[None, :]
         if causal:
             ok &= (qi >= ki)[None]
         if sliding_window is not None:
             ok &= (qi - ki < sliding_window)[None]
         if seg_q is not None:
-            ok = ok & (seg_q[:, :, None] == seg_kv_t[:, None, :])
-        return ok  # [1 or b, sq, skv]
+            ok = ok & (seg_q_b[:, :, None] == seg_kv_t[:, None, :])
+        return ok
 
     def step(carry, _):
         o, m, l, k_t, v_t, kv_idx_t, seg_kv_t = carry
-        # scores [b, nkv, g, sq, skv] in fp32
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_t.astype(jnp.float32))
-        ok = allowed_mask(kv_idx_t, seg_kv_t)[:, None, None]  # [b,1,1,sq,skv]
-        s_masked = jnp.where(ok, s, NEG_INF)
-        m_new = jnp.maximum(m, s_masked.max(axis=-1))
-        # mask applied to p directly — never rely on exp(-inf - -inf)
-        p = jnp.where(ok, jnp.exp(s - m_new[..., None]), 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhgqk,bkhd->bhgqd", p, v_t.astype(jnp.float32)
-        )
+        kf = k_t.astype(jnp.float32)
+        vf = v_t.astype(jnp.float32)
+
+        def row_block(_, xs):
+            qg_b, qi_b, seg_q_b, o_b, m_b, l_b = xs
+            # scores [b, nkv, g, blk, skv] in fp32
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg_b, kf)
+            ok = allowed_mask(qi_b, kv_idx_t, seg_q_b, seg_kv_t)[:, None, None]
+            s_masked = jnp.where(ok, s, NEG_INF)
+            m_new = jnp.maximum(m_b, s_masked.max(axis=-1))
+            # mask applied to p directly — never rely on exp(-inf - -inf)
+            p = jnp.where(ok, jnp.exp(s - m_new[..., None]), 0.0)
+            alpha = jnp.exp(m_b - m_new)
+            l_new = l_b * alpha + p.sum(axis=-1)
+            o_new = o_b * alpha[..., None] + jnp.einsum(
+                "bhgqk,bkhd->bhgqd", p, vf
+            )
+            return None, (o_new, m_new, l_new)
+
+        def rows(x, axis):  # [.., sq, ..] -> [nb, .., blk, ..] for scan xs
+            return jnp.moveaxis(
+                x.reshape(*x.shape[:axis], nb, blk, *x.shape[axis + 1:]),
+                axis, 0)
+
+        qg_r = rows(qg, 1)                       # [nb, b, blk, nkv, g, d]
+        qi_r = q_idx.reshape(nb, blk)
+        seg_q_r = (rows(seg_q, 1) if seg_q is not None
+                   else jnp.zeros((nb, 1, blk), jnp.int32))
+        o_r = rows(o, 3)                         # [nb, b, nkv, g, blk, d]
+        m_r = rows(m, 3)
+        l_r = rows(l, 3)
+        # checkpoint per block: without it, autodiff-of-scan STACKS every
+        # block's [.., blk, skv] probability tensor as residuals — 16 GiB
+        # at the 32K config, defeating the blocking. Recomputing scores in
+        # the backward is the same FLOPs-for-memory trade flash attention
+        # makes.
+        _, (o2, m2, l2) = lax.scan(
+            jax.checkpoint(row_block), None,
+            (qg_r, qi_r, seg_q_r, o_r, m_r, l_r))
+
+        def back(x, axis, tail):  # [nb, .., blk, ..] -> [.., sq, ..]
+            y = jnp.moveaxis(x, 0, axis)
+            return y.reshape(*y.shape[:axis], sq, *y.shape[axis + 2:]) \
+                if tail else y.reshape(*y.shape[:axis], sq)
+
+        o = back(o2, 3, True)
+        m = back(m2, 3, False)
+        l = back(l2, 3, False)
         k_t = lax.ppermute(k_t, axis_name, perm)
         v_t = lax.ppermute(v_t, axis_name, perm)
         kv_idx_t = lax.ppermute(kv_idx_t, axis_name, perm)
         if seg_kv_t is not None:
             seg_kv_t = lax.ppermute(seg_kv_t, axis_name, perm)
-        return (o_new, m_new, l_new, k_t, v_t, kv_idx_t, seg_kv_t), None
+        return (o, m, l, k_t, v_t, kv_idx_t, seg_kv_t), None
 
     o0 = jnp.zeros((b, nkv, g, sq, d), jnp.float32)
     m0 = jnp.full((b, nkv, g, sq), NEG_INF, jnp.float32)

@@ -4,7 +4,13 @@ which the reference cannot do)."""
 
 # Must run before any jax backend init: tests are hermetic on an 8-device
 # virtual CPU mesh even when the axon TPU tunnel env is present.
-from megatron_llm_tpu.utils.platform import pin_cpu_platform
+import os
+
+# compile-only TPU topology clients (tests/test_aot_scale.py) grab the
+# libtpu lockfile; allow coexistence with other local libtpu users
+os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+
+from megatron_llm_tpu.utils.platform import pin_cpu_platform  # noqa: E402
 
 pin_cpu_platform(n_devices=8)
 

@@ -417,7 +417,13 @@ def test_expert_choice_routing_is_balanced():
             seated = set(np.where(np.asarray(dispatch)[g, :, e].any(-1))[0])
             want = set(np.argsort(-probs[g, :, e])[:cap])
             assert seated == want
-    assert float(aux[0]) == 1.0
+    # aux[0] reports EC's health signal: the dropped-token fraction
+    # (tokens selected by NO expert) — metric-only, never enters the loss
+    # (aux_loss_coeffs zeroes the balance coefficient for expert_choice)
+    covered = np.asarray(dispatch).any(axis=(2, 3))  # [G, T]
+    expected_dropped = 1.0 - covered.mean()
+    np.testing.assert_allclose(float(aux[0]), expected_dropped, rtol=1e-6)
+    assert 0.0 <= float(aux[0]) < 1.0
 
 
 def test_expert_choice_capacity_clamps_to_group():

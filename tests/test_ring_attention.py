@@ -298,3 +298,36 @@ def test_zigzag_batch_transform():
     assert np.array_equal(out["token_idx"], perm)
     assert np.array_equal(out["tokens"], batch["tokens"][:, perm])
     assert np.array_equal(out["position_ids"][0], perm)
+
+
+def test_ring_q_row_blocking_parity(eight_devices, monkeypatch):
+    """The long-seq row-blocked online softmax (ring._Q_BLOCK_THRESHOLD)
+    matches the unblocked path exactly — forced on at small seq by
+    shrinking the threshold, against the same exact reference."""
+    from megatron_llm_tpu.parallel import ring as ring_mod
+
+    q, k, v = _qkv(jax.random.PRNGKey(7))
+    ref = _reference(q, k, v)
+    mesh = build_mesh(context_parallel_size=2, devices=eight_devices[:2])
+    monkeypatch.setattr(ring_mod, "_Q_BLOCK_THRESHOLD", 8)
+    monkeypatch.setattr(ring_mod, "_Q_BLOCK_ROWS", 8)
+    with global_mesh(mesh):
+        out = ring_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               atol=1e-5, rtol=1e-5)
+    # and with segments + zigzag token_idx (the full masking surface)
+    seg = jnp.stack([
+        jnp.concatenate([jnp.zeros(24, jnp.int32), jnp.ones(40, jnp.int32)]),
+        jnp.concatenate([jnp.zeros(50, jnp.int32), jnp.ones(14, jnp.int32)]),
+    ])
+    ref_seg = _reference(q, k, v, segment_ids=seg)
+    perm = zigzag_permutation(64, 2)
+    tok_idx = jnp.asarray(perm, jnp.int32)
+    qp, kp, vp = q[:, perm], k[:, perm], v[:, perm]
+    with global_mesh(mesh):
+        outp = ring_attention(qp, kp, vp, segment_ids=seg[:, perm],
+                              token_idx=tok_idx)
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(np.asarray(ref_seg),
+                               np.asarray(outp)[:, inv],
+                               atol=1e-5, rtol=1e-5)

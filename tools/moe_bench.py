@@ -102,8 +102,9 @@ def main():
         if result["backend"] != "cpu":
             persist_tpu_result(result, vars(args), tag=f"moe{E}x{K}")
         else:
-            # same off-TPU contract as bench.py: never a nominal-peak MFU
-            result = cpu_contract_line(result, seq)
+            # same off-TPU contract as bench.py: never a nominal-peak MFU;
+            # the tag routes to this metric's own evidence file
+            result = cpu_contract_line(result, seq, tag=f"moe{E}x{K}")
         print(json.dumps(result), flush=True)
 
 

@@ -289,6 +289,13 @@ def config_from_hf(hf_config, model_name: str):
             kw["moe_aux_loss_coeff"] = float(
                 getattr(hf_config, "router_aux_loss_coef", 0.01)
             )
+            # HF Mixtral routes DROPLESSLY; the default capacity_factor
+            # 1.25 would silently drop tokens relative to the source model
+            # during finetune/inference. num_experts/topk guarantees every
+            # token a slot at either expert it routes to (ADVICE round 2).
+            kw["moe_capacity_factor"] = (
+                hf_config.num_local_experts / hf_config.num_experts_per_tok
+            )
     return make_config(model_name, **kw)
 
 
