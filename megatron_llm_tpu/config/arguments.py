@@ -149,6 +149,12 @@ class ParallelConfig:
     virtual_pipeline_model_parallel_size: Optional[int] = None
     # 'gpipe' (all-fwd-then-all-bwd, differentiable scan) or '1f1b'
     pipeline_schedule: str = "1f1b"
+    # 1F1B lockstep-SPMD head: shard the LM-head vocab over the pp axis so
+    # every stage computes a USEFUL 1/pp of the head each tick instead of a
+    # masked-out full head (parallel/pipeline.py pp-vocab head). Applies to
+    # the default GPT head under the 1F1B schedules when the padded vocab
+    # divides pp; custom family hooks keep the replicated head.
+    pp_vocab_parallel_head: bool = True
     # activation recompute: None | 'full' | 'selective'
     recompute_granularity: Optional[str] = "selective"
     # shard stacked-layer scan carries over tp when sequence_parallel
@@ -388,10 +394,14 @@ class Config:
         if self.parallel.tensor_model_parallel_size == 1:
             self.parallel.sequence_parallel = False
         # bf16 training accumulates grads in fp32 by DEFAULT (reference
-        # validate_args:139-148 forces it; here an explicit False is
-        # honored — halving the accumulator is what fits Llama-7B TP=8 on
-        # 16-GiB v5e chips, tools/aot_scale_check.py) — the dataclass
-        # default is already True, so nothing to force.
+        # validate_args:139-148 forces it; for bfloat16 an explicit False
+        # is honored — halving the accumulator is what fits Llama-7B TP=8
+        # on 16-GiB v5e chips, tools/aot_scale_check.py). float16 keeps
+        # the force: its grads carry the dynamic loss scale, and summing
+        # scaled fp16 microbatch grads overflows the accumulator at
+        # scales the backoff can never escape.
+        if t.params_dtype == "float16":
+            t.accumulate_allreduce_grads_in_fp32 = True
         if self.model.num_attention_heads_kv is not None:
             assert (
                 self.model.num_attention_heads % self.model.num_attention_heads_kv == 0
@@ -407,14 +417,12 @@ class Config:
                 f"expert_parallel_size {ep}"
             )
             if self.parallel.pipeline_model_parallel_size > 1:
-                # GPipe differentiates the router aux loss through the tick
-                # scan; the 1F1B schedules compute grads with explicit vjps
-                # that do not carry the aux term (parallel/pipeline.py)
-                assert self.parallel.pipeline_schedule == "gpipe", (
-                    "MoE with pipeline parallelism requires "
-                    "pipeline_schedule='gpipe' (1F1B drops the router "
-                    "aux-loss gradient)"
-                )
+                # All schedules carry the router aux-loss gradient: GPipe
+                # through the tick-scan transpose, the 1F1B schedules by
+                # seeding the stage vjp's aux output with the loss scale at
+                # each stage's own backward tick (the aux term is
+                # stage-local, so no cross-stage aux gradient exists —
+                # parallel/pipeline.py:_1f1b_setup).
                 assert self.parallel.context_parallel_size == 1, (
                     "MoE with pipeline parallelism requires "
                     "context_parallel_size == 1"

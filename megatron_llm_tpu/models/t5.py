@@ -130,7 +130,14 @@ def t5_pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     bert_pipeline_hooks); the encoder phase runs under a bidirectional
     config copy.
 
-    Restrictions: deterministic only (dropout=0) and cp == 1.
+    Dropout: per-microbatch keys split into (encoder, decoder) streams,
+    matching t5_forward's dk_enc/dk_dec split of the per-microbatch
+    fold_in key — with cp == 1 pipelined dropout is bit-identical to the
+    pp=1 grad-accumulation path. Context parallelism (cp > 1): both
+    stacks' self-attention runs cp-sharded (ring attention, bidirectional
+    for the encoder); cross-attention keys (encoder_hidden/enc_bias) stay
+    REPLICATED over cp (parallel/pipeline._aux_specs) so every cp-local
+    decoder query chunk sees the full encoder sequence.
     """
     import copy
 
@@ -141,13 +148,6 @@ def t5_pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
 
     m = cfg.model
     assert m.num_experts is None  # finalize enforces; belt and braces
-    assert m.hidden_dropout == 0.0 and m.attention_dropout == 0.0, (
-        "pipelined T5 currently supports deterministic training only"
-    )
-    assert cfg.parallel.context_parallel_size == 1, (
-        "pipelined T5 requires context_parallel_size == 1 (the encoder "
-        "output is replicated to decoder stages whole)"
-    )
     M = num_micro or cfg.parallel.num_micro_batches or 1
     gbs = batch["text_enc"].shape[0]
     assert gbs % M == 0
@@ -161,13 +161,28 @@ def t5_pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
     labels = split(batch["labels"])
     loss_mask = split(batch["loss_mask"]).astype(jnp.float32)
 
+    # per-microbatch dropout keys: fold_in(base, i) then split to the
+    # (encoder, decoder) streams — exactly t5_forward's split of the key
+    # the pp=1 grad-accumulation path passes per microbatch
+    use_dropout = dropout_key is not None and (
+        m.hidden_dropout > 0.0 or m.attention_dropout > 0.0
+    )
+    if use_dropout:
+        keys = jax.vmap(
+            lambda i: jax.random.split(jax.random.fold_in(dropout_key, i))
+        )(jnp.arange(M))
+        enc_keys, dec_keys = keys[:, 0], keys[:, 1]
+    else:
+        enc_keys = dec_keys = None
+
     # ---- encoder phase: bidirectional self-attention, pads as segments ----
     cfg_enc = copy.deepcopy(cfg)
     cfg_enc.model.bidirectional = True
     enc_h0 = jax.vmap(lambda t: embed_tokens(cfg, params, t))(enc_tok)
     enc_aux = {"segment_ids": 1 - enc_mask.astype(jnp.int32)}
     enc_out, _ = pipeline_apply(
-        cfg_enc, mesh, params["layers"], enc_h0, enc_aux, None, True, None
+        cfg_enc, mesh, params["layers"], enc_h0, enc_aux, None,
+        not use_dropout, None, mb_keys=enc_keys,
     )
     enc_out = norm(enc_out, params["final_norm"], m.layernorm_epsilon,
                    m.use_rms_norm)
@@ -182,7 +197,8 @@ def t5_pipeline_loss_fn(cfg, mesh, params, batch: Dict[str, jax.Array], *,
         "enc_bias": jax.vmap(cross_bias)(enc_mask),
     }
     dec_out, _ = pipeline_apply(
-        cfg, mesh, params["decoder_layers"], dec_h0, dec_aux, None, True, None
+        cfg, mesh, params["decoder_layers"], dec_h0, dec_aux, None,
+        not use_dropout, None, mb_keys=dec_keys,
     )
 
     # ---- head + CE per microbatch (shared remat-scan discipline) ----

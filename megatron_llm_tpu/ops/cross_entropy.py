@@ -128,9 +128,11 @@ def vocab_parallel_cross_entropy(
     rank = jax.lax.axis_index(axis_name)
     vocab_start = rank * vp
 
-    local_max = jnp.max(logits_shard, axis=-1)
+    # stop_gradient BEFORE pmax: the max shift is gradient-free anyway and
+    # pmax has no differentiation rule (hit by the pp-vocab head's vjp)
+    local_max = jax.lax.stop_gradient(jnp.max(logits_shard, axis=-1))
     global_max = jax.lax.pmax(local_max, axis_name)
-    shifted = logits_shard - jax.lax.stop_gradient(global_max)[..., None]
+    shifted = logits_shard - global_max[..., None]
 
     exp = jnp.exp(shifted)
     sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), axis_name)

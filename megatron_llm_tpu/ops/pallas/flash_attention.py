@@ -31,15 +31,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# compile-TARGET platform: AOT lowering for a TPU topology on a CPU
+# host must compile the real kernel, not interpret mode
+from megatron_llm_tpu.core.parallel_state import target_platform
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-def _target_platform():
-    # compile-TARGET platform (AOT lowering for a TPU topology on a CPU
-    # host must compile the real kernel, not interpret mode)
-    from megatron_llm_tpu.core.parallel_state import target_platform
-    return target_platform()
-
 
 NEG_INF = -1e30
 
@@ -502,7 +499,7 @@ def flash_attention(
         block_kv = _auto_block(k.shape[1], cap)
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
-        interpret = _target_platform() == "cpu"
+        interpret = target_platform() == "cpu"
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)

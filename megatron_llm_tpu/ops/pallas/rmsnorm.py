@@ -18,15 +18,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+# compile-TARGET platform: AOT lowering for a TPU topology on a CPU
+# host must compile the real kernel, not interpret mode
+from megatron_llm_tpu.core.parallel_state import target_platform
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-
-def _target_platform():
-    # compile-TARGET platform (AOT lowering for a TPU topology on a CPU
-    # host must compile the real kernel, not interpret mode)
-    from megatron_llm_tpu.core.parallel_state import target_platform
-    return target_platform()
-
 
 
 def _fwd_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -86,19 +83,19 @@ def fused_rms_norm(x, w, eps: float = 1e-6, block_rows: int = 256,
                    interpret: bool | None = None):
     """RMSNorm over the last axis; any leading shape."""
     if interpret is None:
-        interpret = _target_platform() == "cpu"
+        interpret = target_platform() == "cpu"
     return _fwd_call(x, w, eps, block_rows, interpret)
 
 
 def _vjp_fwd(x, w, eps, block_rows, interpret):
     if interpret is None:
-        interpret = _target_platform() == "cpu"
+        interpret = target_platform() == "cpu"
     return _fwd_call(x, w, eps, block_rows, interpret), (x, w)
 
 
 def _vjp_bwd(eps, block_rows, interpret, res, g):
     if interpret is None:
-        interpret = _target_platform() == "cpu"
+        interpret = target_platform() == "cpu"
     x, w = res
     x2 = _reshape_2d(x)
     g2 = _reshape_2d(g)

@@ -57,8 +57,9 @@ def _stage_body(cfg, layers_local, x, aux, token_idx, dropout_key,
     so with cp=1, pipelined dropout is bit-identical to the pp=1 run.
 
     Returns (hidden, moe_aux[2]) — the stage-local MoE router losses
-    (zeros for dense models; the GPipe schedule accumulates them, the 1F1B
-    schedules require dense models, config finalize enforces it).
+    (zeros for dense models). The GPipe schedule accumulates them through
+    the tick scan; the 1F1B schedules fold them into the per-stage vjp's
+    aux output (see _1f1b_setup's aux_scalar).
     """
     stage = jax.lax.axis_index(PP_AXIS)
     if dropout_key is not None and cfg.parallel.context_parallel_size > 1:
@@ -242,7 +243,7 @@ def pipeline_apply(cfg, mesh, stacked_layers, hidden_mb: jax.Array,
         in_specs=(
             jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             hidden_spec,
-            jax.tree.map(_aux_data_spec, aux_mb),
+            _aux_specs(aux_mb),
             P(CP_AXIS),
             P(),
         ),
@@ -265,6 +266,19 @@ def _aux_data_spec(leaf):
     if leaf.ndim >= 3:
         return P(None, None, CP_AXIS)
     return P(*([None] * leaf.ndim))
+
+
+def _aux_specs(aux_mb):
+    """Key-aware aux specs: cross-attention KEYS stay replicated over cp —
+    every cp-local decoder query chunk attends the FULL encoder sequence
+    (models/t5.py), so sharding encoder_hidden/enc_bias over cp would
+    silently truncate cross-attention to 1/cp of the keys."""
+    P = jax.sharding.PartitionSpec
+    return {
+        k: (P() if k in ("encoder_hidden", "enc_bias")
+            else _aux_data_spec(v))
+        for k, v in aux_mb.items()
+    }
 
 
 def microbatched_head_loss(head_loss_fn, outer, hidden, labels, loss_mask,
@@ -382,6 +396,49 @@ def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
         layer_keys = jnp.zeros((M, 2), jnp.uint32)
     s["embed_keys"], s["layer_keys"] = embed_keys, layer_keys
 
+    # pp-vocab-parallel head (cfg.parallel.pp_vocab_parallel_head): in
+    # lockstep SPMD a "last-stage-only" head is structurally impossible —
+    # every stage executes every tick — so instead of pp-1 stages computing
+    # a masked-out FULL head, the vocab is sharded over pp and every stage
+    # computes a USEFUL 1/pp of it (logits chunk + the 3-psum
+    # vocab-parallel CE over the pp axis; ops/cross_entropy.py). Only for
+    # the default GPT head; the padded vocab must divide pp.
+    pp_ = cfg.parallel.pipeline_model_parallel_size
+    s["pp_head"] = (
+        cfg.parallel.pp_vocab_parallel_head
+        and head_loss_fn is None
+        and pp_ > 1
+        and lm.padded_vocab_size(cfg.model.vocab_size, cfg) % pp_ == 0
+    )
+    if s["pp_head"]:
+        from megatron_llm_tpu.ops.cross_entropy import (
+            vocab_parallel_cross_entropy,
+        )
+
+        denom_ = s["denom"]
+        scale_ = s["scale"]
+
+        def pp_head_loss_fn(outer_p, hidden, lbl, msk, aux):
+            """SCALED per-microbatch loss from this stage's vocab chunk.
+
+            ``hidden`` is the last stage's output broadcast to every stage
+            (psum of a one-hot selection); the psums inside the
+            vocab-parallel CE make the returned value identical on every
+            stage — the caller counts it once and psums the partial
+            weight/hidden grads."""
+            h = norm(hidden, outer_p["final_norm"],
+                     cfg.model.layernorm_epsilon, cfg.model.use_rms_norm)
+            w = lm.head_weight(cfg, outer_p).astype(h.dtype)
+            vc = w.shape[1] // pp_
+            rank = jax.lax.axis_index(PP_AXIS)
+            wc = jax.lax.dynamic_slice_in_dim(w, rank * vc, vc, axis=1)
+            per_token = vocab_parallel_cross_entropy(
+                h @ wc, lbl, axis_name=PP_AXIS)
+            return ((per_token * msk.astype(jnp.float32)).sum()
+                    / denom_ * scale_)
+
+        s["pp_head_loss_fn"] = pp_head_loss_fn
+
     default_embed, default_head = _default_gpt_fns(cfg, batch, use_dropout)
     if embed_fn is None:
         embed_fn = default_embed
@@ -400,7 +457,61 @@ def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
         jnp.full((s["tokens"].shape[2],), -1, jnp.int32)
         if s["token_idx"] is None else s["token_idx"]
     )
+
+    # MoE router aux losses under 1F1B: the aux term is stage-LOCAL (each
+    # stage's routers see only that stage's layers), so its gradient never
+    # crosses stage boundaries through dy — seeding the aux output of the
+    # per-stage vjp with the loss scale at the stage's own backward tick
+    # recovers exactly the gradient GPipe gets through the scan transpose.
+    # The /M matches the pp=1 grad-accum mean (pipeline_loss_fn does the
+    # same division).
+    s["has_moe"] = cfg.model.num_experts is not None
+    if s["has_moe"]:
+        from megatron_llm_tpu.models.moe import aux_loss_coeffs
+
+        c_bal, c_z = aux_loss_coeffs(cfg)
+        M_ = s["M"]
+
+        def aux_scalar(moe_aux):
+            return (c_bal * moe_aux[0] + c_z * moe_aux[1]) / M_
+    else:
+        def aux_scalar(moe_aux):
+            del moe_aux
+            return jnp.float32(0.0)
+    s["aux_scalar"] = aux_scalar
     return s
+
+
+def _pp_head_tick(st, pp, outer_p, y, labels, loss_mask, aux_at,
+                  use_head, emitted, e_idx, loss_acc, acc_outer):
+    """Shared pp-vocab-head step of the 1F1B ticks (both engines).
+
+    Broadcasts the emitting stage's output, runs THIS stage's vocab-chunk
+    head vjp, and returns the updated (loss_acc, acc_outer, dy_total).
+    ``emitted``/``e_idx`` are tick-derived and identical on every stage
+    (each engine computes them from its own schedule); ``use_head`` is the
+    emitting stage's own flag. vjp seed is 1/pp: inside shard_map a
+    replicated cotangent of 1.0 per rank counts pp times through the CE's
+    internal psums (verified with a 2-rank psum-vjp probe that returned
+    2x the chunk partials); 1/pp makes each rank's vjp the clean chunk
+    partial, which the psums assemble.
+    """
+    y_b = jax.lax.psum(
+        jnp.where(use_head, y, jnp.zeros_like(y)), PP_AXIS)
+    loss_f, head_vjp = jax.vjp(
+        lambda op, yy: st["pp_head_loss_fn"](
+            op, yy, labels[e_idx], loss_mask[e_idx], aux_at(e_idx)),
+        outer_p, y_b,
+    )
+    d_outer_head, dy_p = head_vjp(jnp.float32(1.0 / pp))
+    # loss_f is already the GLOBAL value on every stage (CE psums
+    # internally) — count it once (the emitting stage)
+    loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
+    acc_outer = jax.tree.map(
+        lambda a, g: a + jnp.where(emitted, g, jnp.zeros_like(g)),
+        acc_outer, d_outer_head,
+    )
+    return loss_acc, acc_outer, jax.lax.psum(dy_p, PP_AXIS)
 
 
 def pipeline_1f1b_loss_and_grads(
@@ -472,11 +583,14 @@ def pipeline_1f1b_loss_and_grads(
         dtype = st["dtype"]
 
         def stage_fwd(L, x, aux, dk):
-            return _stage_body(
+            y, moe_aux = _stage_body(
                 cfg, L, x, aux,
                 token_idx_local if token_idx is not None else None,
                 dk if use_dropout else None, not use_dropout, rope,
-            )[0]  # MoE aux unsupported under 1F1B (finalize enforces)
+            )
+            # (hidden, stage-local scaled-down aux loss); the aux output's
+            # vjp seed at the backward tick carries the router gradient
+            return y, st["aux_scalar"](moe_aux)
 
         def aux_at(i):
             return jax.tree.map(lambda a: a[i], aux_mb)
@@ -500,21 +614,39 @@ def pipeline_1f1b_loss_and_grads(
                 saved, x_in, f_idx % depth, 0
             )
             saved = jnp.where(do_f, saved_upd, saved)
-            y = stage_fwd(layers_local, x_in, aux_at(f_idx), layer_keys[f_idx])
+            y, aux_f = stage_fwd(layers_local, x_in, aux_at(f_idx),
+                                 layer_keys[f_idx])
+            # every stage adds its own (already /M) router aux once per
+            # valid microbatch; loss_acc psums over pp below
+            loss_acc = loss_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
 
             # ---- head + loss on the last stage's fresh output ----
-            loss_f, head_vjp = jax.vjp(
-                lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
-                                            loss_mask[f_idx], aux_at(f_idx)),
-                outer_p, y,
-            )
             use_head = jnp.logical_and(stage == last, do_f)
-            d_outer_head, dy = head_vjp(jnp.float32(1.0))
-            loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
-            acc_outer = jax.tree.map(
-                lambda a, g: a + jnp.where(use_head, g, jnp.zeros_like(g)),
-                acc_outer, d_outer_head,
-            )
+            if st["pp_head"]:
+                # pp-vocab head (_pp_head_tick): every stage computes its
+                # vocab chunk's partial CE + grads (USEFUL work, 1/pp of
+                # the head each). emitted/e_idx are tick-derived — the
+                # EMITTED microbatch, identical on all stages (f_idx is
+                # stage-specific and differs on non-last stages)
+                emitted = jnp.logical_and(t - last >= 0, t - last < M)
+                e_idx = jnp.clip(t - last, 0, M - 1)
+                loss_acc, acc_outer, dy = _pp_head_tick(
+                    st, pp, outer_p, y, labels, loss_mask, aux_at,
+                    use_head, emitted, e_idx, loss_acc, acc_outer)
+            else:
+                loss_f, head_vjp = jax.vjp(
+                    lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
+                                                loss_mask[f_idx],
+                                                aux_at(f_idx)),
+                    outer_p, y,
+                )
+                d_outer_head, dy = head_vjp(jnp.float32(1.0))
+                loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
+                acc_outer = jax.tree.map(
+                    lambda a, g: a + jnp.where(use_head, g,
+                                               jnp.zeros_like(g)),
+                    acc_outer, d_outer_head,
+                )
 
             # ---- backward for the older microbatch (remat from saved x) ----
             g_in = jnp.where(stage == last, dy.astype(dtype), g_recv)
@@ -526,7 +658,10 @@ def pipeline_1f1b_loss_and_grads(
                                         layer_keys[b_idx]),
                 layers_local, x_saved,
             )
-            dlayers, dx = stage_vjp(g_in)
+            # aux cotangent = loss scale: the router-aux gradient enters
+            # here (stage-local); for dense models the aux output is a
+            # constant 0 and the seed is a no-op
+            dlayers, dx = stage_vjp((g_in, st["scale"]))
             acc_L = jax.tree.map(
                 lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)),
                 acc_L, dlayers,
@@ -580,7 +715,7 @@ def pipeline_1f1b_loss_and_grads(
             jax.tree.map(lambda _: P(PP_AXIS), layers),
             jax.tree.map(lambda _: P(), outer),
             data_spec, data_spec, data_spec,
-            jax.tree.map(_aux_data_spec, aux_mb),
+            _aux_specs(aux_mb),
             P(CP_AXIS),
             P(), P(),
         ),
@@ -676,12 +811,13 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             )
 
         def stage_fwd(ch_params, x, aux, dk, layer_offset):
-            return _stage_body(
+            y, moe_aux = _stage_body(
                 cfg, ch_params, x, aux,
                 token_idx_local if token_idx is not None else None,
                 dk if use_dropout else None, not use_dropout, rope,
                 layer_offset=layer_offset,
-            )[0]  # MoE aux unsupported under 1F1B (finalize enforces)
+            )
+            return y, st["aux_scalar"](moe_aux)
 
         def aux_at(i):
             return jax.tree.map(lambda a: a[i], aux_mb)
@@ -715,22 +851,42 @@ def pipeline_1f1b_interleaved_loss_and_grads(
                 saved, x_in, slot_f, 0
             )
             saved = jnp.where(do_f, saved_upd, saved)
-            y = stage_fwd(chunk_at(c_f), x_in, aux_at(f_idx),
-                          layer_keys[f_idx], (c_f * pp + stage) * chunk_layers)
+            y, aux_f = stage_fwd(chunk_at(c_f), x_in, aux_at(f_idx),
+                                 layer_keys[f_idx],
+                                 (c_f * pp + stage) * chunk_layers)
+            # each (stage, chunk) hop adds its own (already /M) router aux
+            # once per valid microbatch; psum over pp totals the layers
+            loss_acc = loss_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
 
             # ---- head vjp at the final forward hop; dy parked one tick ----
-            loss_f, head_vjp = jax.vjp(
-                lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
-                                            loss_mask[f_idx], aux_at(f_idx)),
-                outer_p, y,
-            )
             use_head = jnp.logical_and(last_hop, do_f)
-            d_outer_head, dy = head_vjp(jnp.float32(1.0))
-            loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
-            acc_outer = jax.tree.map(
-                lambda a, g: a + jnp.where(use_head, g, jnp.zeros_like(g)),
-                acc_outer, d_outer_head,
-            )
+            if st["pp_head"]:
+                # pp-vocab head (_pp_head_tick); the emission condition of
+                # the LAST stage's final hop, derived from t alone so it is
+                # identical on every stage
+                u_l = t - last
+                w_l = u_l % V
+                mb_l = (u_l // V) * pp + w_l % pp
+                emitted = jnp.logical_and(
+                    jnp.logical_and(u_l >= 0, w_l // pp == v - 1), mb_l < M)
+                e_idx = jnp.clip(mb_l, 0, M - 1)
+                loss_acc, acc_outer, dy = _pp_head_tick(
+                    st, pp, outer_p, y, labels, loss_mask, aux_at,
+                    use_head, emitted, e_idx, loss_acc, acc_outer)
+            else:
+                loss_f, head_vjp = jax.vjp(
+                    lambda op, yy: head_loss_fn(op, yy, labels[f_idx],
+                                                loss_mask[f_idx],
+                                                aux_at(f_idx)),
+                    outer_p, y,
+                )
+                d_outer_head, dy = head_vjp(jnp.float32(1.0))
+                loss_acc = loss_acc + jnp.where(use_head, loss_f, 0.0)
+                acc_outer = jax.tree.map(
+                    lambda a, g: a + jnp.where(use_head, g,
+                                               jnp.zeros_like(g)),
+                    acc_outer, d_outer_head,
+                )
             dy_prev = jax.lax.dynamic_index_in_dim(
                 dybuf, f_idx % pp, 0, keepdims=False)
             dybuf = jax.lax.dynamic_update_index_in_dim(
@@ -764,7 +920,8 @@ def pipeline_1f1b_interleaved_loss_and_grads(
                                          (c_b * pp + stage) * chunk_layers),
                 chunk_at(c_b), x_saved,
             )
-            dchunk, dx = stage_vjp(g_in)
+            # aux cotangent = loss scale (router grads; no-op for dense)
+            dchunk, dx = stage_vjp((g_in, st["scale"]))
             acc_L = add_chunk(acc_L, dchunk, c_b, do_b)
 
             # ---- embedding backward at the last backward hop ----
@@ -813,7 +970,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             jax.tree.map(lambda _: P(), outer),
             data_spec, data_spec, data_spec,
-            jax.tree.map(_aux_data_spec, aux_mb),
+            _aux_specs(aux_mb),
             P(CP_AXIS),
             P(), P(),
         ),

@@ -234,7 +234,21 @@ def build_tokenizer(cfg) -> AbstractTokenizer:
         assert name, "--tokenizer_model (HF name or path) required"
         tok = HFTokenizer(name, d.vocab_extra_ids_list)
     elif t == "GPT2BPETokenizer":
-        tok = HFTokenizer(d.tokenizer_model or "gpt2")
+        if d.vocab_file and d.merge_file:
+            # air-gapped path: vendored byte-level BPE from local files
+            # (reference gpt2_tokenization.py capability — no HF runtime)
+            from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
+
+            tok = GPT2BPETokenizer(d.vocab_file, d.merge_file)
+        else:
+            tok = HFTokenizer(d.tokenizer_model or "gpt2")
+    elif t in ("BertWordPieceLowerCase", "BertWordPieceCase"):
+        # vendored WordPiece (reference bert_tokenization.py capability)
+        assert d.vocab_file, "--vocab_file required for BertWordPiece*"
+        from megatron_llm_tpu.tokenizer.vendored import WordPieceTokenizer
+
+        tok = WordPieceTokenizer(
+            d.vocab_file, lower_case=(t == "BertWordPieceLowerCase"))
     elif t == "NullTokenizer":
         tok = _NullTokenizer(cfg.model.vocab_size or 32000)
     else:

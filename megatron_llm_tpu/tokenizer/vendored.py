@@ -1,0 +1,299 @@
+"""Self-contained GPT-2 byte-level BPE and BERT WordPiece tokenizers.
+
+The reference vendors its own implementations of both standard algorithms
+(megatron/tokenizer/gpt2_tokenization.py, bert_tokenization.py, ~752 LoC)
+so an air-gapped cluster can tokenize from local vocab files alone. This
+module provides the same capability: no `transformers`/`tokenizers`
+packages at runtime — only the published file formats (GPT-2
+vocab.json + merges.txt; BERT vocab.txt) and the standard algorithms,
+re-implemented from their specs:
+
+* GPT-2 byte-level BPE (Radford et al. 2019; the byte<->unicode table and
+  greedy lowest-rank pair merging are fixed by the released files),
+* BERT BasicTokenizer + greedy longest-match-first WordPiece
+  (Devlin et al. 2018).
+
+`tests/test_vendored_tokenizers.py` checks both against tiny hand-built
+vocabularies and (when HF is importable) against the HF implementations.
+"""
+
+from __future__ import annotations
+
+import json
+import unicodedata
+from functools import lru_cache
+from typing import Dict, List, Tuple
+
+from megatron_llm_tpu.tokenizer.tokenizer import AbstractTokenizer
+
+# ---------------------------------------------------------------------------
+# GPT-2 byte-level BPE
+# ---------------------------------------------------------------------------
+
+
+@lru_cache()
+def bytes_to_unicode() -> Dict[int, str]:
+    """The fixed GPT-2 byte -> printable-unicode table.
+
+    Printable ASCII/latin bytes map to themselves; the rest are assigned
+    code points 256+ in order — a reversible encoding that makes every
+    byte sequence a string the BPE merges can operate on."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(2 ** 8):
+        if b not in bs:
+            bs.append(b)
+            cs.append(2 ** 8 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def get_pairs(word: Tuple[str, ...]):
+    pairs = set()
+    prev = word[0]
+    for ch in word[1:]:
+        pairs.add((prev, ch))
+        prev = ch
+    return pairs
+
+
+# the GPT-2 pretokenizer split pattern (needs the `regex` module for \p{L})
+_GPT2_SPLIT = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"
+               r" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+
+class GPT2BPETokenizer(AbstractTokenizer):
+    """Byte-level BPE from local vocab.json + merges.txt — no HF runtime."""
+
+    def __init__(self, vocab_file: str, merges_file: str):
+        super().__init__("GPT2 BPE (vendored)")
+        import regex  # baked in; unicode-category classes for the split
+
+        with open(vocab_file, encoding="utf-8") as f:
+            self.encoder: Dict[str, int] = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with open(merges_file, encoding="utf-8") as f:
+            lines = f.read().split("\n")
+        # merges.txt: optional "#version" header, one "a b" pair per line
+        merges = [tuple(line.split()) for line in lines
+                  if line and not line.startswith("#version") and len(
+                      line.split()) == 2]
+        self.bpe_ranks = dict(zip(merges, range(len(merges))))
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.pat = regex.compile(_GPT2_SPLIT)
+        self.cache: Dict[str, str] = {}
+
+    def bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token)
+        pairs = get_pairs(word) if len(word) > 1 else set()
+        while pairs:
+            # merge the lowest-rank pair present, repeat until none apply
+            bigram = min(
+                pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = get_pairs(word)
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    def tokenize(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for token in self.pat.findall(text):
+            mapped = "".join(self.byte_encoder[b]
+                             for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self.bpe(mapped).split(" "))
+        return ids
+
+    def detokenize(self, token_ids: List[int]) -> str:
+        text = "".join(self.decoder[int(t)] for t in token_ids)
+        return bytearray(
+            self.byte_decoder[c] for c in text).decode("utf-8",
+                                                       errors="replace")
+
+    @property
+    def eod(self) -> int:
+        return self.encoder.get("<|endoftext|>", len(self.encoder) - 1)
+
+
+# ---------------------------------------------------------------------------
+# BERT WordPiece
+# ---------------------------------------------------------------------------
+
+
+def _is_whitespace(ch: str) -> bool:
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII non-alphanumeric printable ranges count as punctuation (the
+    # BERT convention — includes chars like $ and ^ outside unicode P*)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class WordPieceTokenizer(AbstractTokenizer):
+    """BERT BasicTokenizer + WordPiece from a local vocab.txt.
+
+    Greedy longest-match-first subword split with the ## continuation
+    prefix; basic cleanup, optional lower-casing + accent stripping, CJK
+    chars tokenized individually."""
+
+    def __init__(self, vocab_file: str, lower_case: bool = True,
+                 max_chars_per_word: int = 200):
+        super().__init__("BERT WordPiece (vendored)")
+        self.vocab: Dict[str, int] = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    self.vocab[tok] = i
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.lower_case = lower_case
+        self.max_chars = max_chars_per_word
+
+    # -- basic tokenization --------------------------------------------
+    def _clean(self, text: str) -> str:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        return "".join(out)
+
+    def _basic_split(self, text: str) -> List[str]:
+        text = self._clean(text)
+        # CJK chars become standalone tokens
+        spaced = []
+        for ch in text:
+            if _is_cjk(ord(ch)):
+                spaced.append(f" {ch} ")
+            else:
+                spaced.append(ch)
+        words = "".join(spaced).split()
+        out: List[str] = []
+        for w in words:
+            if self.lower_case:
+                w = w.lower()
+                w = "".join(c for c in unicodedata.normalize("NFD", w)
+                            if unicodedata.category(c) != "Mn")
+            # split punctuation into standalone tokens
+            cur: List[str] = []
+            for ch in w:
+                if _is_punctuation(ch):
+                    if cur:
+                        out.append("".join(cur))
+                        cur = []
+                    out.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                out.append("".join(cur))
+        return out
+
+    # -- wordpiece ------------------------------------------------------
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return ["[UNK]"]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:  # longest-match-first
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return ["[UNK]"]
+            out.append(cur)
+            start = end
+        return out
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def tokenize(self, text: str) -> List[int]:
+        pieces: List[str] = []
+        for word in self._basic_split(text):
+            pieces.extend(self._wordpiece(word))
+        unk = self.vocab.get("[UNK]", 0)
+        return [self.vocab.get(p, unk) for p in pieces]
+
+    def detokenize(self, token_ids: List[int]) -> str:
+        pieces = [self.inv_vocab[int(t)] for t in token_ids]
+        text = " ".join(pieces).replace(" ##", "")
+        return text
+
+    @property
+    def cls(self) -> int:
+        return self.vocab["[CLS]"]
+
+    @property
+    def sep(self) -> int:
+        return self.vocab["[SEP]"]
+
+    @property
+    def pad(self) -> int:
+        return self.vocab["[PAD]"]
+
+    @property
+    def mask(self) -> int:
+        return self.vocab["[MASK]"]
+
+    @property
+    def eod(self) -> int:
+        return self.sep

@@ -390,12 +390,61 @@ def test_moe_interleaved_gpipe_pipeline_matches_unpipelined():
     assert np.isfinite(float(mets["moe aux loss"]))
 
 
-def test_moe_1f1b_pipeline_rejected():
-    cfg = tiny_cfg()
+def _moe_1f1b_parity(vpp, num_layers):
+    """MoE under the true-1F1B schedules (round-3 VERDICT item 3): the
+    router aux term enters the loss and its gradient reaches the router
+    and expert weights via the per-stage vjp aux seed — parity with the
+    unpipelined computation, mirroring test_pipeline.py's dense suite."""
+    from megatron_llm_tpu.parallel.pipeline import (
+        pipeline_1f1b_interleaved_loss_and_grads,
+        pipeline_1f1b_loss_and_grads,
+    )
+
+    cfg = tiny_cfg(seq_length=32, global_batch_size=4, num_layers=num_layers)
     cfg.parallel.pipeline_model_parallel_size = 2
     cfg.parallel.pipeline_schedule = "1f1b"
-    with pytest.raises(AssertionError, match="gpipe"):
-        cfg.finalize()
+    if vpp > 1:
+        cfg.parallel.virtual_pipeline_model_parallel_size = vpp
+    cfg.parallel.num_micro_batches = 4
+    cfg.finalize()
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, jax.random.PRNGKey(1), gbs=4)
+
+    cfg1 = tiny_cfg(seq_length=32, global_batch_size=4,
+                    num_layers=num_layers)
+    cfg1.parallel.num_micro_batches = 4
+    ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+        lambda p: loss_from_batch(cfg1, p, batch, deterministic=True)[0]
+    ))(params)
+
+    engine = (pipeline_1f1b_interleaved_loss_and_grads if vpp > 1
+              else pipeline_1f1b_loss_and_grads)
+    mesh = build_mesh(pipeline_model_parallel_size=2,
+                      devices=jax.devices()[:2])
+    with global_mesh(mesh):
+        loss, grads = jax.jit(
+            lambda p: engine(cfg, mesh, p, batch, num_micro=4)
+        )(params)
+
+    # the aux normalization gap vs the full-batch reference is ~coeff*1e-3
+    # (same situation as the GPipe parity test's docstring)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-4,
+            err_msg=f"grad mismatch at {pa}",
+        )
+
+
+def test_moe_1f1b_pipeline_matches_unpipelined():
+    _moe_1f1b_parity(vpp=1, num_layers=2)
+
+
+def test_moe_interleaved_1f1b_pipeline_matches_unpipelined():
+    _moe_1f1b_parity(vpp=2, num_layers=4)
 
 
 def test_expert_choice_routing_is_balanced():

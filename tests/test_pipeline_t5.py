@@ -114,3 +114,95 @@ def test_t5_pipeline_train_step():
             losses.append(float(m["lm loss"]))
             assert np.isfinite(losses[-1])
         assert losses[-1] < losses[0]
+
+
+def test_t5_pipeline_dropout_matches_unpipelined():
+    """Round-3 VERDICT item 3: pipelined T5 with DROPOUT — per-microbatch
+    keys split into (enc, dec) streams exactly as t5_forward does for the
+    pp=1 grad-accumulation path, so the dropout masks are bit-identical
+    and loss/grads match the microbatched unpipelined reference."""
+    from megatron_llm_tpu.models.t5 import t5_forward
+    from megatron_llm_tpu.ops.cross_entropy import softmax_cross_entropy
+
+    cfg = t5_cfg(hidden_dropout=0.1, attention_dropout=0.1)
+    params = init_t5_params(cfg, jax.random.PRNGKey(0))
+    batch = t5_batch(cfg, jax.random.PRNGKey(1))
+    base_key = jax.random.PRNGKey(42)
+    M, gbs = 4, 8
+
+    cfg1 = t5_cfg(pipeline_model_parallel_size=1,
+                  hidden_dropout=0.1, attention_dropout=0.1)
+
+    def ref_loss_fn(p):
+        # per-microbatch forward with fold_in(base, i) (the key the pp=1
+        # grad-accum path hands each microbatch), CE summed over the batch
+        # and normalized by the FULL loss-mask sum (the pipelined head's
+        # normalizer)
+        full_denom = jnp.maximum(batch["loss_mask"].sum(), 1.0)
+        total = jnp.float32(0.0)
+        for i in range(M):
+            mb = {k: v.reshape(M, gbs // M, *v.shape[1:])[i]
+                  for k, v in batch.items()}
+            logits = t5_forward(
+                cfg1, p, mb["text_enc"], mb["text_dec"],
+                mb["enc_mask"], mb["dec_mask"],
+                dropout_key=jax.random.fold_in(base_key, i),
+                deterministic=False,
+            )
+            ce = softmax_cross_entropy(logits, mb["labels"])
+            total = total + (ce * mb["loss_mask"]).sum()
+        return total / full_denom
+
+    ref_loss, ref_grads = jax.jit(jax.value_and_grad(ref_loss_fn))(params)
+
+    mesh = build_mesh(pipeline_model_parallel_size=2,
+                      devices=jax.devices()[:2])
+    with global_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: t5_pipeline_loss_fn(
+                cfg, mesh, p, batch, num_micro=4, dropout_key=base_key)[0]
+        ))(params)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-4, atol=5e-4,
+            err_msg=f"grad mismatch at {pa}",
+        )
+
+
+def test_t5_pipeline_cp2_matches_unpipelined():
+    """Round-3 VERDICT item 3: pipelined T5 under context parallelism —
+    both stacks' self-attention cp-sharded (bidirectional ring for the
+    encoder), cross-attention keys replicated over cp."""
+    cfg = t5_cfg(context_parallel_size=2)
+    params = init_t5_params(cfg, jax.random.PRNGKey(0))
+    batch = t5_batch(cfg, jax.random.PRNGKey(1))
+
+    cfg1 = t5_cfg(pipeline_model_parallel_size=1)
+    ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+        lambda p: t5_loss_from_batch(cfg1, p, batch, deterministic=True)[0]
+    ))(params)
+
+    mesh = build_mesh(pipeline_model_parallel_size=2,
+                      context_parallel_size=2,
+                      devices=jax.devices()[:4])
+    with global_mesh(mesh):
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: t5_pipeline_loss_fn(cfg, mesh, p, batch, num_micro=4)[0]
+        ))(params)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-3, atol=1e-3,
+            err_msg=f"grad mismatch at {pa}",
+        )

@@ -1,0 +1,134 @@
+"""Vendored GPT-2 BPE + BERT WordPiece (tokenizer/vendored.py) — the
+air-gapped tokenization capability the reference carries in
+gpt2_tokenization.py/bert_tokenization.py. Tested against hand-built tiny
+vocabularies with hand-derivable expected outputs, plus an HF
+cross-check when a gpt2 tokenizer is locally cached (skipped offline),
+and a no-HF-import guard proving the vendored path never touches
+transformers."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def gpt2_files(tmp_path):
+    # tiny BPE: bytes for "low", "er", "lowest" etc; merges build "low"
+    from megatron_llm_tpu.tokenizer.vendored import bytes_to_unicode
+
+    b2u = bytes_to_unicode()
+
+    def u(s):
+        return "".join(b2u[b] for b in s.encode())
+
+    merges = ["#version: 0.2", f"{u('l')} {u('o')}",
+              f"{u('lo')} {u('w')}", f"{u('e')} {u('r')}"]
+    toks = [u(x) for x in
+            ["low", "lo", "l", "o", "w", "e", "r", "er", "s", "t", " ",
+             " low"]]
+    # " low" needs the merge (" l" not merged) — keep simple: vocab holds
+    # every byte char we might emit
+    vocab = {}
+    for ch in set("".join(toks)):
+        vocab.setdefault(ch, len(vocab))
+    for t in toks:
+        vocab.setdefault(t, len(vocab))
+    vocab.setdefault("<|endoftext|>", len(vocab))
+    vf = tmp_path / "vocab.json"
+    mf = tmp_path / "merges.txt"
+    vf.write_text(json.dumps(vocab))
+    mf.write_text("\n".join(merges) + "\n")
+    return str(vf), str(mf), vocab, u
+
+
+def test_gpt2_bpe_merges_and_roundtrip(gpt2_files):
+    from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
+
+    vf, mf, vocab, u = gpt2_files
+    tok = GPT2BPETokenizer(vf, mf)
+    ids = tok.tokenize("lower")
+    # merges: l+o -> lo, lo+w -> low, e+r -> er  =>  ["low", "er"]
+    assert ids == [vocab[u("low")], vocab[u("er")]]
+    assert tok.detokenize(ids) == "lower"
+    # unmerged word falls back to single (byte) tokens
+    ids2 = tok.tokenize("lost")
+    assert ids2 == [vocab[u("lo")], vocab[u("s")], vocab[u("t")]]
+    assert tok.detokenize(tok.tokenize("lower lost")) == "lower lost"
+    assert tok.eod == vocab["<|endoftext|>"]
+
+
+def test_gpt2_bpe_matches_hf_when_available(tmp_path):
+    try:
+        from transformers import GPT2Tokenizer
+
+        hf = GPT2Tokenizer.from_pretrained("gpt2", local_files_only=True)
+    except Exception:
+        pytest.skip("no locally cached gpt2 tokenizer (offline image)")
+    vf = tmp_path / "vocab.json"
+    mf = tmp_path / "merges.txt"
+    vf.write_text(json.dumps(hf.encoder))
+    mf.write_text("#version: 0.2\n" + "\n".join(
+        " ".join(m) for m in hf.bpe_ranks))
+    from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
+
+    ours = GPT2BPETokenizer(str(vf), str(mf))
+    for text in ["Hello world!", "The    spaces,  and\tpunctuation?",
+                 "naïve café ünïcödé", "don't they're we'll"]:
+        assert ours.tokenize(text) == hf.encode(text), text
+
+
+@pytest.fixture()
+def wp_vocab(tmp_path):
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "quick", "brown", "fox", "un", "##aff", "##able",
+             "run", "##ning", ",", ".", "!", "a"]
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(words) + "\n")
+    return str(vf), {w: i for i, w in enumerate(words)}
+
+
+def test_wordpiece_greedy_longest_match(wp_vocab):
+    from megatron_llm_tpu.tokenizer.vendored import WordPieceTokenizer
+
+    vf, v = wp_vocab
+    tok = WordPieceTokenizer(vf, lower_case=True)
+    assert tok.tokenize("unaffable") == [v["un"], v["##aff"], v["##able"]]
+    assert tok.tokenize("running") == [v["run"], v["##ning"]]
+    # punctuation split + lower-casing + accent stripping
+    assert tok.tokenize("The Quick, brown!") == [
+        v["the"], v["quick"], v[","], v["brown"], v["!"]]
+    assert tok.tokenize("thé") == [v["the"]]  # NFD accent strip
+    # unknown word -> [UNK] (whole word, per the algorithm)
+    assert tok.tokenize("zzz") == [v["[UNK]"]]
+    assert tok.cls == v["[CLS]"] and tok.mask == v["[MASK]"]
+    assert tok.detokenize(tok.tokenize("unaffable running")) == \
+        "unaffable running"
+
+
+def test_vendored_path_needs_no_hf(gpt2_files, wp_vocab, monkeypatch):
+    """build_tokenizer with local files must not import transformers or
+    sentencepiece (the air-gapped guarantee)."""
+    from megatron_llm_tpu.config.arguments import Config
+    from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
+
+    for mod in ("transformers", "sentencepiece"):
+        monkeypatch.setitem(sys.modules, mod, None)  # import -> TypeError
+
+    vf, mf, vocab, _u = gpt2_files
+    cfg = Config()
+    cfg.data.tokenizer_type = "GPT2BPETokenizer"
+    cfg.data.vocab_file = vf
+    cfg.data.merge_file = mf
+    tok = build_tokenizer(cfg)
+    assert tok.vocab_size == len(vocab)
+
+    wvf, wv = wp_vocab
+    cfg2 = Config()
+    cfg2.data.tokenizer_type = "BertWordPieceLowerCase"
+    cfg2.data.vocab_file = wvf
+    tok2 = build_tokenizer(cfg2)
+    assert tok2.vocab_size == len(wv)
+    assert tok2.tokenize("the fox") == [wv["the"], wv["fox"]]

@@ -1,0 +1,72 @@
+"""Build the self-contained e2e smoke corpus (docs/guide/e2e_smoke.md).
+
+Real natural-language text with zero egress: the repo's own documentation
+(README/PERF/SURVEY + docs/guide) becomes a ~10k-word corpus, split into
+train jsonl + held-out valid text, with a WordPiece vocab built from it
+(specials + characters + ##-continuations + the 3k most frequent word
+pieces) for the vendored tokenizer (tokenizer/vendored.py).
+
+    python tools/make_e2e_corpus.py --out /tmp/e2e
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import re
+import unicodedata
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SOURCES = ["README.md", "PERF.md", "SURVEY.md"]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--valid_fraction", type=float, default=0.1)
+    ap.add_argument("--vocab_words", type=int, default=3000)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    texts = []
+    for name in SOURCES:
+        path = os.path.join(REPO, name)
+        if os.path.exists(path):
+            texts.append(open(path, encoding="utf-8").read())
+    guide = os.path.join(REPO, "docs", "guide")
+    for name in sorted(os.listdir(guide)):
+        if name.endswith(".md"):
+            texts.append(open(os.path.join(guide, name),
+                              encoding="utf-8").read())
+    raw = "\n\n".join(texts)
+
+    paras = [p.strip() for p in raw.split("\n\n") if len(p.strip()) > 80]
+    split = int(len(paras) * (1.0 - args.valid_fraction))
+    train, valid = paras[:split], paras[split:]
+    with open(os.path.join(args.out, "train.jsonl"), "w") as f:
+        for p in train:
+            f.write(json.dumps({"text": p}) + "\n")
+    with open(os.path.join(args.out, "valid.txt"), "w") as f:
+        f.write("\n\n".join(valid))
+
+    counts: collections.Counter = collections.Counter()
+    for p in paras:
+        for w in p.lower().split():
+            w = "".join(c for c in unicodedata.normalize("NFD", w)
+                        if unicodedata.category(c) != "Mn")
+            counts.update(re.findall(r"[a-z0-9]+|[^\sa-z0-9]", w))
+    chars = sorted({c for p in paras for c in p.lower() if not c.isspace()})
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += chars + ["##" + c for c in chars if c.isalnum()]
+    vocab += [w for w, _ in counts.most_common(args.vocab_words)
+              if w not in vocab]
+    with open(os.path.join(args.out, "vocab.txt"), "w") as f:
+        f.write("\n".join(vocab) + "\n")
+    print(f"corpus: {len(train)} train paragraphs, {len(valid)} valid, "
+          f"vocab {len(vocab)}")
+
+
+if __name__ == "__main__":
+    main()
