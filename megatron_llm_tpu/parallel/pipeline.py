@@ -409,6 +409,11 @@ def _1f1b_setup(cfg, batch, num_micro, dropout_key, embed_fn, head_loss_fn,
         and head_loss_fn is None
         and pp_ > 1
         and lm.padded_vocab_size(cfg.model.vocab_size, cfg) % pp_ == 0
+        # an explicit ce_vocab_chunks bound wins: the pp head materializes
+        # an unchunked [mb, s, V/pp] logits block, which can exceed the
+        # memory budget chunking was configured to enforce — keep the
+        # replicated chunked head (which _default_gpt_fns honors) instead
+        and not cfg.model.ce_vocab_chunks
     )
     if s["pp_head"]:
         from megatron_llm_tpu.ops.cross_entropy import (

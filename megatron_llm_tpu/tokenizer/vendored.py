@@ -129,11 +129,16 @@ class GPT2BPETokenizer(AbstractTokenizer):
         return len(self.encoder)
 
     def tokenize(self, text: str) -> List[int]:
+        # unknown pieces (possible with trimmed/custom vocab.json files)
+        # map to eod rather than raising mid-corpus (the reference
+        # gpt2_tokenization falls back to its unk id via .get)
+        unk = self.eod
         ids: List[int] = []
         for token in self.pat.findall(text):
             mapped = "".join(self.byte_encoder[b]
                              for b in token.encode("utf-8"))
-            ids.extend(self.encoder[t] for t in self.bpe(mapped).split(" "))
+            ids.extend(self.encoder.get(t, unk)
+                       for t in self.bpe(mapped).split(" "))
         return ids
 
     def detokenize(self, token_ids: List[int]) -> str:
@@ -189,12 +194,15 @@ class WordPieceTokenizer(AbstractTokenizer):
     def __init__(self, vocab_file: str, lower_case: bool = True,
                  max_chars_per_word: int = 200):
         super().__init__("BERT WordPiece (vendored)")
+        # dense sequential ids over non-blank lines (the reference
+        # bert_tokenization loader's behavior): a stray blank line must
+        # not leave an id gap that indexes past the embedding table
         self.vocab: Dict[str, int] = {}
         with open(vocab_file, encoding="utf-8") as f:
-            for i, line in enumerate(f):
+            for line in f:
                 tok = line.rstrip("\n")
                 if tok:
-                    self.vocab[tok] = i
+                    self.vocab[tok] = len(self.vocab)
         self.inv_vocab = {v: k for k, v in self.vocab.items()}
         self.lower_case = lower_case
         self.max_chars = max_chars_per_word

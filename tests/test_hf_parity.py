@@ -206,3 +206,76 @@ def test_mixtral_round_trip():
 
 def test_falcon_round_trip():
     _round_trip(tiny_hf_falcon(), "falcon", "to_hf_falcon_state")
+
+
+# ---------------------------------------------------------------------------
+# dtype matrix + realistic scale (round-3 VERDICT item 4)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family,builder", [
+    ("llama2", tiny_hf_llama),
+    ("mistral", tiny_hf_mistral),
+    ("falcon", tiny_hf_falcon),
+    ("mixtral", tiny_hf_mixtral),
+])
+def test_fp16_logit_parity(family, builder):
+    """float16 params_dtype across the families (fp16 was untested in any
+    parity suite before round 3). fp16 keeps 10 mantissa bits (vs bf16's
+    7), so the gate is tighter than the bf16 one."""
+    hf = builder()
+    cfg = config_from_hf(hf.config, family)
+    cfg.training.params_dtype = "float16"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=1, seq=48, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 0.05, f"fp16 avg max logit err {avg_max}"
+
+
+def test_mixtral_bf16_logit_parity():
+    hf = tiny_hf_mixtral()
+    cfg = config_from_hf(hf.config, "mixtral")
+    cfg.training.params_dtype = "bfloat16"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=1, seq=48, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 0.1, f"bf16 avg max logit err {avg_max}"
+
+
+def _hf_llama_1b():
+    """~1.05B-param Llama/CodeLlama-shaped model: h2048 x L24, 32 heads,
+    GQA 8:1, SwiGLU ffn 5504, rope theta 1e6 + linear scaling x2 — the
+    realistic-scale synthetic stand-in for the reference's flagship
+    real-Llama-2-7B gate (test_llama_weights.py:91-118; real weights are
+    impossible with zero egress)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hc = LlamaConfig(
+        vocab_size=8192, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=32, num_key_value_heads=4,
+        max_position_embeddings=1024, rms_norm_eps=1e-5, rope_theta=1e6,
+        rope_scaling={"type": "linear", "factor": 2.0},
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    return LlamaForCausalLM(hc)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype,gate", [
+    ("float32", 1e-3),   # reference gate (test_llama_weights.py:117)
+    ("bfloat16", 0.5),   # 24 layers of bf16 rounding at realistic width
+    ("float16", 0.25),
+])
+def test_llama_1b_realistic_parity(dtype, gate):
+    hf = _hf_llama_1b()
+    n_params = sum(p.numel() for p in hf.parameters())
+    assert n_params > 1.0e9, n_params
+    cfg = config_from_hf(hf.config, "codellama")
+    assert cfg.model.rope_theta == 1e6
+    assert cfg.model.rope_scaling_factor == 2.0
+    cfg.training.params_dtype = dtype
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=1, seq=256, iters=1)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= gate, f"{dtype} avg max logit err {avg_max}"

@@ -132,3 +132,22 @@ def test_vendored_path_needs_no_hf(gpt2_files, wp_vocab, monkeypatch):
     tok2 = build_tokenizer(cfg2)
     assert tok2.vocab_size == len(wv)
     assert tok2.tokenize("the fox") == [wv["the"], wv["fox"]]
+
+def test_wordpiece_blank_line_gives_dense_ids(tmp_path):
+    vf = tmp_path / "v.txt"
+    vf.write_text("[PAD]\n[UNK]\n\nthe\nfox\n")  # interior blank line
+    from megatron_llm_tpu.tokenizer.vendored import WordPieceTokenizer
+
+    tok = WordPieceTokenizer(str(vf))
+    assert tok.vocab_size == 4
+    ids = tok.tokenize("the fox")
+    assert ids == [2, 3] and max(ids) < tok.vocab_size
+
+
+def test_gpt2_unknown_piece_falls_back_to_eod(gpt2_files):
+    from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
+
+    vf, mf, vocab, _u = gpt2_files
+    tok = GPT2BPETokenizer(vf, mf)
+    ids = tok.tokenize("q")  # byte char absent from the tiny vocab
+    assert ids == [tok.eod]
