@@ -88,6 +88,19 @@ CONFIGS = {
         # microbatch): the head-fused vocab-chunked CE bounds them
         extra=dict(ce_vocab_chunks=8),
     ),
+    # Beyond the reference (it has no MoE): Mixtral-8x7B — 8 experts
+    # top-2, ~46.7B total params — tp8 x (dp4 with ep4 carved inside),
+    # expert weights sharded over (ep, tp), ZeRO-1 over dp
+    "mixtral_8x7b_tp8_ep4_v5p32": dict(
+        topology="v5p:2x4x4", family="mixtral",
+        model=dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   num_attention_heads_kv=8, ffn_hidden_size=14336,
+                   vocab_size=32000, seq_length=4096,
+                   max_position_embeddings=4096,
+                   num_experts=8, moe_router_topk=2),
+        tp=8, pp=1, cp=1, dp=4, ep=4, num_micro=4, mbs=1,
+        schedule=None, vpp=None, recompute="full",
+    ),
     # BASELINE.json config 5 / north star: "Llama-2-70B TP=8 PP=8 DP=4 on
     # v5p-256 (GQA, distributed optimizer, sequence-parallel)"
     "llama2_70b_tp8_pp8_dp4_v5p256": dict(
@@ -118,11 +131,13 @@ def check_one(name: str, spec: dict) -> dict:
     kind = devices[0].device_kind
     hbm_gib = HBM_GIB[kind]
     tp, pp, cp, dp = spec["tp"], spec["pp"], spec["cp"], spec["dp"]
+    ep = spec.get("ep", 1)  # carved INSIDE dp (core/parallel_state)
     assert tp * pp * cp * dp == len(devices), (name, len(devices))
 
     mesh = build_mesh(
         tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp,
-        context_parallel_size=cp, data_parallel_size=dp, devices=devices,
+        context_parallel_size=cp, data_parallel_size=dp,
+        expert_parallel_size=ep, devices=devices,
     )
     gbs = spec["mbs"] * spec["num_micro"] * dp
     cfg = make_config(
@@ -135,6 +150,7 @@ def check_one(name: str, spec: dict) -> dict:
         train_iters=100, lr=1e-4,
     )
     cfg.parallel.data_parallel_size = dp
+    cfg.parallel.expert_parallel_size = ep
     cfg.parallel.num_micro_batches = spec["num_micro"]
     cfg.parallel.recompute_granularity = spec["recompute"]
     if spec["schedule"]:
@@ -182,7 +198,7 @@ def check_one(name: str, spec: dict) -> dict:
         "topology": spec["topology"],
         "device_kind": kind,
         "n_devices": len(devices),
-        "mesh": {"tp": tp, "pp": pp, "cp": cp, "dp": dp},
+        "mesh": {"tp": tp, "pp": pp, "cp": cp, "dp": dp, "ep": ep},
         "schedule": spec["schedule"] or "none",
         "vpp": spec["vpp"] or 1,
         "n_params": n_params,
