@@ -39,7 +39,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-HBM_GIB = {"TPU v5 lite": 16.0, "TPU v5": 95.0, "TPU v4": 32.0}
+HBM_GIB = {"TPU v5 lite": 16.0, "TPU v5": 95.0, "TPU v4": 32.0,
+           "TPU v6 lite": 32.0}
 
 # Canonical public dims. Reference anchors: Llama-2 7B/70B + CodeLlama-34B
 # bundles (reference weights_conversion/hf_to_megatron.py + examples/
@@ -60,6 +61,18 @@ CONFIGS = {
         # memory-bounded recipe: scanned per-layer Adam update (default) +
         # bf16 grad accumulation + full remat + mbs 1.
         extra=dict(accumulate_allreduce_grads_in_fp32=False),
+    ),
+    # Next-gen readiness: 7B on Trillium (v6e, 32 GiB, 918 TF/s bf16) —
+    # roomy where v5e is tight, so the DEFAULTS suffice (fp32 grad
+    # accumulation, no special recipe) and mbs doubles to 2
+    "llama2_7b_tp8_v6e8": dict(
+        topology="v6e:2x4", family="llama2",
+        model=dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   num_attention_heads_kv=32, ffn_hidden_size=11008,
+                   vocab_size=32000, seq_length=4096,
+                   max_position_embeddings=4096),
+        tp=8, pp=1, cp=1, dp=1, num_micro=4, mbs=2,
+        schedule=None, vpp=None, recompute="full",
     ),
     # BASELINE.json config 3: "Falcon-40B TP=8 PP=4 (multi-query attn +
     # parallel-attn, interleaved 1F1B schedule)"
