@@ -6,8 +6,10 @@ clip_grads.py, grad_scaler.py). The TPU design collapses most of that code:
 * fp32 master weights + bf16 compute — params live in fp32; the forward casts
   to the compute dtype (Float16Module semantics, model/module.py:160) so
   grads arrive fp32 ("main_grad" accumulation is just autodiff in fp32).
-* grad clipping by global norm = ``optax.global_norm`` (all parameters are
-  already global objects — no multi-tensor apex kernels or psums needed;
+* grad clipping by global norm = :func:`global_grad_norm` (fp32-accumulated
+  square-sums — ``optax.global_norm`` squares in the storage dtype, too
+  noisy for bf16 grad accumulators; all parameters are already global
+  objects so no multi-tensor apex kernels or psums are needed;
   clip_grads.py:16 semantics).
 * **distributed optimizer (ZeRO-1, distrib_optimizer.py)** = sharding the
   Adam m/v state over the ``dp`` mesh axis. XLA then emits the
@@ -144,7 +146,7 @@ def scanned_adam(cfg, params: Any) -> optax.GradientTransformation:
         lr = lr_fn(state.count)
         wd = wd_const if wd_const is not None else wd_fn(state.count)
         if clip is not None:
-            gnorm = optax.global_norm(grads) * prescale
+            gnorm = global_grad_norm(grads) * prescale
             clip_scale = jnp.minimum(1.0, clip / (gnorm + 1e-6)) * prescale
         else:
             clip_scale = jnp.float32(1.0) * prescale
@@ -200,6 +202,22 @@ def scanned_adam(cfg, params: Any) -> optax.GradientTransformation:
     return FusedGradientTransformation(init_fn, update_fn, fused_apply)
 
 
+def _clip_by_global_norm_f32(max_norm: float) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` with the norm accumulated in fp32
+    (see :func:`global_grad_norm`); clip factor min(1, c/(norm+1e-6)),
+    matching the fused ``scanned_adam`` path."""
+
+    def update_fn(updates, state, params=None):
+        del params
+        norm = global_grad_norm(updates)
+        scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree.map(
+            lambda u: (u.astype(jnp.float32) * scale).astype(u.dtype),
+            updates), state
+
+    return optax.GradientTransformation(lambda _: optax.EmptyState(), update_fn)
+
+
 def get_optimizer(cfg, params: Any) -> optax.GradientTransformation:
     """get_megatron_optimizer analog (optimizer/__init__.py:63-144)."""
     o = cfg.optimizer
@@ -211,7 +229,7 @@ def get_optimizer(cfg, params: Any) -> optax.GradientTransformation:
     wd_fn = wd_schedule(cfg)
     chain = []
     if o.clip_grad and o.clip_grad > 0:
-        chain.append(optax.clip_by_global_norm(o.clip_grad))
+        chain.append(_clip_by_global_norm_f32(o.clip_grad))
     if o.optimizer == "adam":
         chain.append(optax.scale_by_adam(b1=o.adam_beta1, b2=o.adam_beta2,
                                          eps=o.adam_eps))
@@ -357,8 +375,17 @@ def zero1_sharded_fraction(cfg, params: Any, opt_state: Any,
 
 
 def global_grad_norm(grads: Any) -> jax.Array:
-    """calc l2 norm of all grads (clip_grads.py:16 / utils.py:38 analog)."""
-    return optax.global_norm(grads)
+    """l2 norm of all grads (clip_grads.py:16 / utils.py:38 analog).
+
+    Unlike ``optax.global_norm``, each leaf's square-sum is accumulated in
+    fp32: with bf16 grad accumulators (accumulate_allreduce_grads_in_fp32
+    = False) squaring in the storage dtype keeps ~3 significant digits,
+    which makes clip decisions near the threshold noisy. The cast fuses
+    into the square-reduce — no full-size fp32 temps.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
 
 
 def count_zeros(grads: Any) -> jax.Array:

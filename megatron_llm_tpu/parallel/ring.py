@@ -46,6 +46,41 @@ from megatron_llm_tpu.ops.attention import NEG_INF
 # local seqs above the threshold process Q rows in blocks of this size.
 _Q_BLOCK_THRESHOLD = 4096
 _Q_BLOCK_ROWS = 2048
+_Q_BLOCK_MIN = 256        # floor: below this the scan is latency-bound
+_Q_BLOCK_OVER = 4 * _Q_BLOCK_ROWS  # ceiling for the fall-UP path
+
+
+def _choose_q_block(sq: int) -> int:
+    """Pick the Q-row block size for the ring online-softmax scan.
+
+    Blocks must divide sq exactly (the scan reshapes [sq] -> [nb, blk]).
+    The largest divisor in [_Q_BLOCK_MIN, _Q_BLOCK_ROWS] wins; for
+    non-smooth sq (e.g. prime, or 2*p) whose only small divisors are tiny,
+    falling DOWN toward blk=1 would turn one ring step into up to sq
+    sequential checkpointed iterations — a severe compile/runtime cliff —
+    so we instead fall UP to the smallest divisor above the budget (score
+    temps grow proportionally but stay bounded by _Q_BLOCK_OVER). If even
+    that would exceed 4x the budget, the config is pathological and we
+    refuse with guidance rather than silently compile something terrible.
+    """
+    if sq <= _Q_BLOCK_THRESHOLD:
+        return sq
+    divs = [d for d in range(_Q_BLOCK_MIN, _Q_BLOCK_ROWS + 1) if sq % d == 0]
+    if divs:
+        return max(divs)
+    over = min(
+        (d for d in range(_Q_BLOCK_ROWS + 1, _Q_BLOCK_OVER + 1)
+         if sq % d == 0),
+        default=None,
+    )
+    if over is not None:
+        return over
+    raise ValueError(
+        f"ring attention: local seq length {sq} has no divisor in "
+        f"[{_Q_BLOCK_MIN}, {_Q_BLOCK_OVER}] to use as a Q-row block; "
+        f"choose seq_len / (2*cp) with a power-of-two (or otherwise "
+        f"smooth) factor so the online softmax can be row-blocked."
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -127,13 +162,7 @@ def _ring_attention_local(
     # this). Q rows are independent in online softmax, so scanning blocks
     # of rows inside each ring step bounds the live score temps to
     # [.., blk, skv] with bitwise-identical results.
-    if sq <= _Q_BLOCK_THRESHOLD:
-        blk = sq
-    else:
-        # largest divisor of sq within the block budget — NOT a fall back
-        # to one full-seq block, which would silently reintroduce the OOM
-        # for seqs that don't divide evenly (e.g. local seq 5120)
-        blk = max(d for d in range(1, _Q_BLOCK_ROWS + 1) if sq % d == 0)
+    blk = _choose_q_block(sq)
     nb = sq // blk
 
     # send chunk i -> i+1 each step; after t steps a device holds the K/V

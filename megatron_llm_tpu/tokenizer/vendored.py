@@ -130,9 +130,11 @@ class GPT2BPETokenizer(AbstractTokenizer):
 
     def tokenize(self, text: str) -> List[int]:
         # unknown pieces (possible with trimmed/custom vocab.json files)
-        # map to eod rather than raising mid-corpus (the reference
-        # gpt2_tokenization falls back to its unk id via .get)
-        unk = self.eod
+        # map to a dedicated unk id — NEVER eod: OOV text masquerading as
+        # document separators would silently corrupt corpus boundaries
+        # (the reference gpt2_tokenization likewise falls back to a
+        # distinct unk id via .get)
+        unk = self.unk
         ids: List[int] = []
         for token in self.pat.findall(text):
             mapped = "".join(self.byte_encoder[b]
@@ -149,7 +151,30 @@ class GPT2BPETokenizer(AbstractTokenizer):
 
     @property
     def eod(self) -> int:
-        return self.encoder.get("<|endoftext|>", len(self.encoder) - 1)
+        try:
+            return self.encoder["<|endoftext|>"]
+        except KeyError:
+            raise ValueError(
+                "vocab.json has no '<|endoftext|>' entry; a GPT-2 BPE "
+                "vocab without an end-of-document token cannot delimit "
+                "documents — add the token or use a different tokenizer"
+            ) from None
+
+    @property
+    def unk(self) -> int:
+        # explicit unk entries first (trimmed/custom vocabs often carry
+        # one); the full released GPT-2 vocab covers all 256 bytes so BPE
+        # pieces are never OOV there and this id is never emitted for it.
+        for tok in ("<unk>", "<|unk|>", "[UNK]"):
+            if tok in self.encoder:
+                return self.encoder[tok]
+        # no explicit unk entry: fall back to the lowest id that is not
+        # eod — aliasing some real token is the honest cost of a trimmed
+        # vocab, but aliasing the DOCUMENT BOUNDARY is never acceptable
+        fallback = 0
+        if self.encoder.get("<|endoftext|>") == fallback:
+            fallback = 1
+        return fallback
 
 
 # ---------------------------------------------------------------------------

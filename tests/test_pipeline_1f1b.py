@@ -126,3 +126,49 @@ def test_1f1b_with_cp_and_tp(eight_devices):
             p, o, m = step(p, o, b, jnp.zeros((), jnp.int32))
             results[name] = float(m["lm loss"])
     assert abs(results["single"] - results["pp2cp2tp2"]) < 2e-4, results
+
+
+def test_1f1b_pp_vocab_head_flag_parity(eight_devices):
+    """pp_vocab_parallel_head True vs False: same loss and grads.
+
+    The flag defaults to True (pipeline.py:399-460 shards the head's
+    vocab dim over the pp axis and runs vocab-parallel CE across stages),
+    silently changing the numerics/memory profile of every 1F1B GPT run —
+    so both paths are pinned EXPLICITLY here, against each other and
+    against the unsharded reference (round-3 advisor finding)."""
+    pp, num_micro = 2, 4
+    batch = _batch()
+    base = _cfg(pp=1, num_micro=1)
+    params = init_model_params(base, jax.random.PRNGKey(0))
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: loss_from_batch(base, p, batch)[0]
+    )(params)
+
+    out = {}
+    for flag in (True, False):
+        cfg = _cfg(pp=pp, num_micro=num_micro)
+        cfg.parallel.pp_vocab_parallel_head = flag
+        mesh = build_mesh(pipeline_model_parallel_size=pp,
+                          data_parallel_size=1, devices=eight_devices[:pp])
+        with global_mesh(mesh):
+            sharded = jax.device_put(params, param_shardings(mesh, params))
+            out[flag] = jax.jit(
+                lambda p, b, cfg=cfg, mesh=mesh:
+                pipeline_1f1b_loss_and_grads(cfg, mesh, p, b)
+            )(sharded, batch)
+
+    for flag, (loss, grads) in out.items():
+        assert abs(float(ref_loss) - float(loss)) < 1e-5, (flag, ref_loss, loss)
+        for a, b in zip(jax.tree_util.tree_leaves(ref_grads),
+                        jax.tree_util.tree_leaves(grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4,
+                                       err_msg=f"pp_vocab_parallel_head={flag}")
+    # and directly against each other, tighter than via the reference
+    la, ga = out[True]
+    lb, gb = out[False]
+    assert abs(float(la) - float(lb)) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)

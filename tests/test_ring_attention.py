@@ -331,3 +331,35 @@ def test_ring_q_row_blocking_parity(eight_devices, monkeypatch):
     np.testing.assert_allclose(np.asarray(ref_seg),
                                np.asarray(outp)[:, inv],
                                atol=1e-5, rtol=1e-5)
+
+
+def test_choose_q_block_never_degenerates():
+    """Q-row block selection (round-3 advisor finding): non-smooth local
+    seq lengths must never fall toward blk=1 (up to sq sequential scan
+    iterations per ring step); they fall UP to a bounded over-budget
+    divisor or raise with guidance."""
+    from megatron_llm_tpu.parallel.ring import (
+        _Q_BLOCK_MIN, _Q_BLOCK_OVER, _Q_BLOCK_ROWS, _Q_BLOCK_THRESHOLD,
+        _choose_q_block,
+    )
+
+    # short seqs: one full block
+    assert _choose_q_block(4096) == 4096
+    assert _choose_q_block(17) == 17
+    # smooth seqs: largest divisor within budget
+    assert _choose_q_block(16384) == 2048
+    assert _choose_q_block(5120) == 1280
+    # 2 * prime: in-budget divisors are only {1, 2} -> falls UP to p=4801
+    # (within the 4x-budget ceiling)
+    assert _choose_q_block(2 * 4801) == 4801
+    # prime <= 4x budget: the seq itself is the only usable divisor
+    assert _choose_q_block(8191) == 8191
+    # prime with no divisor at all in [min, 4x budget] -> clear error
+    with pytest.raises(ValueError, match="row-blocked"):
+        _choose_q_block(16411)
+    # every accepted block divides exactly and respects the bounds
+    for sq in (8192, 12288, 5120, 6144, 9602, 32768):
+        blk = _choose_q_block(sq)
+        assert sq % blk == 0
+        if sq > _Q_BLOCK_THRESHOLD:
+            assert _Q_BLOCK_MIN <= blk <= _Q_BLOCK_OVER

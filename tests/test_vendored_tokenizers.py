@@ -60,6 +60,41 @@ def test_gpt2_bpe_merges_and_roundtrip(gpt2_files):
     assert tok.eod == vocab["<|endoftext|>"]
 
 
+def test_gpt2_bpe_oov_never_emits_eod(gpt2_files, tmp_path):
+    """OOV pieces map to a dedicated unk id, NEVER eod — eod-as-unk would
+    inject spurious document boundaries (round-3 advisor finding). And a
+    vocab with no '<|endoftext|>' raises rather than silently repurposing
+    the last vocab id as eod."""
+    import json as _json
+
+    from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
+
+    vf, mf, vocab, u = gpt2_files
+    tok = GPT2BPETokenizer(vf, mf)
+    # 'z' is not in the tiny vocab -> every emitted id must be unk, not eod
+    ids = tok.tokenize("z")
+    assert ids and all(i == tok.unk for i in ids)
+    assert tok.unk != tok.eod
+    assert tok.eod not in ids
+
+    # explicit unk entry wins when present
+    vocab2 = dict(vocab)
+    vocab2["<unk>"] = len(vocab2)
+    vf2 = tmp_path / "vocab_unk.json"
+    vf2.write_text(_json.dumps(vocab2))
+    tok2 = GPT2BPETokenizer(str(vf2), mf)
+    assert tok2.unk == vocab2["<unk>"]
+    assert tok2.tokenize("z") == [vocab2["<unk>"]]
+
+    # missing <|endoftext|> is an error, not a silent fallback
+    vocab3 = {k: v for k, v in vocab.items() if k != "<|endoftext|>"}
+    vf3 = tmp_path / "vocab_noeod.json"
+    vf3.write_text(_json.dumps(vocab3))
+    tok3 = GPT2BPETokenizer(str(vf3), mf)
+    with pytest.raises(ValueError, match="endoftext"):
+        tok3.eod
+
+
 def test_gpt2_bpe_matches_hf_when_available(tmp_path):
     try:
         from transformers import GPT2Tokenizer
@@ -144,10 +179,11 @@ def test_wordpiece_blank_line_gives_dense_ids(tmp_path):
     assert ids == [2, 3] and max(ids) < tok.vocab_size
 
 
-def test_gpt2_unknown_piece_falls_back_to_eod(gpt2_files):
+def test_gpt2_unknown_piece_falls_back_to_unk(gpt2_files):
     from megatron_llm_tpu.tokenizer.vendored import GPT2BPETokenizer
 
     vf, mf, vocab, _u = gpt2_files
     tok = GPT2BPETokenizer(vf, mf)
     ids = tok.tokenize("q")  # byte char absent from the tiny vocab
-    assert ids == [tok.eod]
+    assert ids == [tok.unk]
+    assert tok.unk != tok.eod  # OOV must never look like a doc boundary
