@@ -519,10 +519,24 @@ def _pp_head_tick(st, pp, outer_p, y, labels, loss_mask, aux_at,
     return loss_acc, acc_outer, jax.lax.psum(dy_p, PP_AXIS)
 
 
+def _1f1b_metrics(st, loss_ce, aux_tot):
+    """Reporting dict for the 1F1B engines (``with_metrics=True``): bare CE
+    as "lm loss" — matching loss_from_batch / pipeline_loss_fn, so the
+    metric means the same thing under every schedule — plus the combined
+    coeff-weighted router aux for MoE. Values are UNSCALED (the engine's
+    accumulators carry the fp16 loss scale; the train step's convention is
+    raw metrics, training_step.py:136)."""
+    inv = 1.0 / st["scale"]
+    mets = {"lm loss": loss_ce * inv}
+    if st["has_moe"]:
+        mets["moe aux total"] = aux_tot * inv
+    return mets
+
+
 def pipeline_1f1b_loss_and_grads(
     cfg, mesh, params, batch: Dict[str, jax.Array], *,
     rope=None, loss_scale=None, num_micro=None, dropout_key=None,
-    embed_fn=None, head_loss_fn=None,
+    embed_fn=None, head_loss_fn=None, with_metrics=False,
 ):
     """One-forward-one-backward pipeline schedule (schedules.py:606-722).
 
@@ -601,7 +615,7 @@ def pipeline_1f1b_loss_and_grads(
             return jax.tree.map(lambda a: a[i], aux_mb)
 
         def tick(carry, t):
-            x_recv, g_recv, saved, acc_L, acc_outer, loss_acc = carry
+            x_recv, g_recv, saved, acc_L, acc_outer, loss_acc, aux_acc = carry
             f_mb = t - stage
             b_mb = t - 2 * (pp - 1) + stage
             do_f = jnp.logical_and(f_mb >= 0, f_mb < M)
@@ -622,8 +636,10 @@ def pipeline_1f1b_loss_and_grads(
             y, aux_f = stage_fwd(layers_local, x_in, aux_at(f_idx),
                                  layer_keys[f_idx])
             # every stage adds its own (already /M) router aux once per
-            # valid microbatch; loss_acc psums over pp below
-            loss_acc = loss_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
+            # valid microbatch — into the SEPARATE aux accumulator so the
+            # reported "lm loss" is bare CE like every other path's
+            # (aux_acc psums over pp below and rejoins the total loss)
+            aux_acc = aux_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
 
             # ---- head + loss on the last stage's fresh output ----
             use_head = jnp.logical_and(stage == last, do_f)
@@ -687,7 +703,8 @@ def pipeline_1f1b_loss_and_grads(
 
             x_next = jax.lax.ppermute(y.astype(dtype), PP_AXIS, perm_fwd)
             g_next = jax.lax.ppermute(dx, PP_AXIS, perm_bwd)
-            return (x_next, g_next, saved, acc_L, acc_outer, loss_acc), None
+            return (x_next, g_next, saved, acc_L, acc_outer, loss_acc,
+                    aux_acc), None
 
         zero_x = jnp.zeros((mb, s_local, h), dtype)
         init = (
@@ -698,8 +715,9 @@ def pipeline_1f1b_loss_and_grads(
                          layers_local),
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), outer_p),
             jnp.float32(0.0),
+            jnp.float32(0.0),
         )
-        (_, _, _, acc_L, acc_outer, loss_acc), _ = jax.lax.scan(
+        (_, _, _, acc_L, acc_outer, loss_acc, aux_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(M + 2 * (pp - 1))
         )
         # cp shards contribute partial sums over their seq chunks; pp stages
@@ -709,7 +727,8 @@ def pipeline_1f1b_loss_and_grads(
             jax.lax.psum(acc_outer, PP_AXIS), CP_AXIS
         )
         loss_acc = jax.lax.psum(jax.lax.psum(loss_acc, PP_AXIS), CP_AXIS)
-        return acc_L, acc_outer, loss_acc
+        aux_acc = jax.lax.psum(jax.lax.psum(aux_acc, PP_AXIS), CP_AXIS)
+        return acc_L, acc_outer, loss_acc, aux_acc
 
     P = jax.sharding.PartitionSpec
     data_spec = P(None, None, CP_AXIS)
@@ -727,24 +746,27 @@ def pipeline_1f1b_loss_and_grads(
         out_specs=(
             jax.tree.map(lambda _: P(PP_AXIS), layers),
             jax.tree.map(lambda _: P(), outer),
-            P(),
+            P(), P(),
         ),
         axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
-    grads_L, grads_outer, loss = fn(
+    grads_L, grads_outer, loss_ce, aux_tot = fn(
         layers, outer, tokens, labels, loss_mask, aux_mb, st["token_idx_arr"],
         embed_keys, layer_keys,
     )
     grads = dict(grads_outer)
     grads["layers"] = grads_L
+    loss = loss_ce + aux_tot
+    if with_metrics:
+        return loss, grads, _1f1b_metrics(st, loss_ce, aux_tot)
     return loss, grads
 
 
 def pipeline_1f1b_interleaved_loss_and_grads(
     cfg, mesh, params, batch: Dict[str, jax.Array], *,
     rope=None, loss_scale=None, num_micro=None, dropout_key=None,
-    embed_fn=None, head_loss_fn=None,
+    embed_fn=None, head_loss_fn=None, with_metrics=False,
 ):
     """Interleaved (virtual-pipeline) 1F1B: grads inside the tick loop with
     v layer chunks per stage (reference schedules.py:253-502 +
@@ -836,7 +858,8 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             return jax.tree.map(upd, acc, g)
 
         def tick(carry, t):
-            (x_recv, g_recv, saved, dybuf, acc_L, acc_outer, loss_acc) = carry
+            (x_recv, g_recv, saved, dybuf, acc_L, acc_outer, loss_acc,
+             aux_acc) = carry
 
             # ---- forward mapping (shared with the gpipe interleaved path) --
             u = t - stage
@@ -860,8 +883,10 @@ def pipeline_1f1b_interleaved_loss_and_grads(
                                  layer_keys[f_idx],
                                  (c_f * pp + stage) * chunk_layers)
             # each (stage, chunk) hop adds its own (already /M) router aux
-            # once per valid microbatch; psum over pp totals the layers
-            loss_acc = loss_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
+            # once per valid microbatch into the SEPARATE aux accumulator
+            # (bare-CE reporting, see _1f1b_metrics); psum over pp totals
+            # the layers
+            aux_acc = aux_acc + jnp.where(do_f, aux_f * st["scale"], 0.0)
 
             # ---- head vjp at the final forward hop; dy parked one tick ----
             use_head = jnp.logical_and(last_hop, do_f)
@@ -945,7 +970,7 @@ def pipeline_1f1b_interleaved_loss_and_grads(
             x_next = jax.lax.ppermute(y.astype(dtype), PP_AXIS, perm_fwd)
             g_next = jax.lax.ppermute(dx.astype(dtype), PP_AXIS, perm_bwd)
             return (x_next, g_next, saved, dybuf, acc_L, acc_outer,
-                    loss_acc), None
+                    loss_acc, aux_acc), None
 
         zero_x = jnp.zeros((mb, s_local, h), dtype)
         init = (
@@ -957,14 +982,16 @@ def pipeline_1f1b_interleaved_loss_and_grads(
                          layers_local),
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), outer_p),
             jnp.float32(0.0),
+            jnp.float32(0.0),
         )
-        (_, _, _, _, acc_L, acc_outer, loss_acc), _ = jax.lax.scan(
+        (_, _, _, _, acc_L, acc_outer, loss_acc, aux_acc), _ = jax.lax.scan(
             tick, init, jnp.arange(T)
         )
         acc_L = jax.lax.psum(acc_L, CP_AXIS)
         acc_outer = jax.lax.psum(jax.lax.psum(acc_outer, PP_AXIS), CP_AXIS)
         loss_acc = jax.lax.psum(jax.lax.psum(loss_acc, PP_AXIS), CP_AXIS)
-        return acc_L, acc_outer, loss_acc
+        aux_acc = jax.lax.psum(jax.lax.psum(aux_acc, PP_AXIS), CP_AXIS)
+        return acc_L, acc_outer, loss_acc, aux_acc
 
     P = jax.sharding.PartitionSpec
     data_spec = P(None, None, CP_AXIS)
@@ -982,12 +1009,12 @@ def pipeline_1f1b_interleaved_loss_and_grads(
         out_specs=(
             jax.tree.map(lambda _: P(None, PP_AXIS), layers_chunked),
             jax.tree.map(lambda _: P(), outer),
-            P(),
+            P(), P(),
         ),
         axis_names={PP_AXIS, CP_AXIS},
         check_vma=False,
     )
-    grads_Lc, grads_outer, loss = fn(
+    grads_Lc, grads_outer, loss_ce, aux_tot = fn(
         layers_chunked, outer, tokens, labels, loss_mask, aux_mb,
         st["token_idx_arr"], embed_keys, layer_keys,
     )
@@ -999,6 +1026,9 @@ def pipeline_1f1b_interleaved_loss_and_grads(
     )
     grads = dict(grads_outer)
     grads["layers"] = grads_L
+    loss = loss_ce + aux_tot
+    if with_metrics:
+        return loss, grads, _1f1b_metrics(st, loss_ce, aux_tot)
     return loss, grads
 
 

@@ -184,12 +184,13 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     pipeline_1f1b_interleaved_loss_and_grads,
                 )
 
-                loss, grads = pipeline_1f1b_interleaved_loss_and_grads(
+                loss, grads, loss_mets = pipeline_1f1b_interleaved_loss_and_grads(
                     cfg, mesh, params, pipe_batch, rope=rope,
                     loss_scale=jax.lax.stop_gradient(scale),
                     num_micro=num_micro,
                     dropout_key=None if deterministic else base_key,
                     embed_fn=embed_fn, head_loss_fn=head_loss_fn,
+                    with_metrics=True,
                 )
             elif cfg.parallel.pipeline_schedule == "1f1b":
                 # true 1F1B: grads computed inside the tick loop, O(pp)
@@ -198,12 +199,13 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
                     pipeline_1f1b_loss_and_grads,
                 )
 
-                loss, grads = pipeline_1f1b_loss_and_grads(
+                loss, grads, loss_mets = pipeline_1f1b_loss_and_grads(
                     cfg, mesh, params, pipe_batch, rope=rope,
                     loss_scale=jax.lax.stop_gradient(scale),
                     num_micro=num_micro,
                     dropout_key=None if deterministic else base_key,
                     embed_fn=embed_fn, head_loss_fn=head_loss_fn,
+                    with_metrics=True,
                 )
             else:
                 # GPipe-style: autodiff through the tick scan; metrics
