@@ -93,3 +93,32 @@ def test_watch_predicates():
                                          "error": "watchdog"}))
     assert _kernel_check_on_tpu("backend: tpu (TPU v5 lite)\nPASS x\n" + "y" * 3000)
     assert not _kernel_check_on_tpu("backend: cpu (cpu)\nnot on TPU")
+
+
+def test_decode_bench_in_watch_jobs():
+    """VERDICT round-3 item 5: the decode bench is part of the tunnel-up
+    capture list, with the bench-style (no subprocess timeout — it carries
+    its own watchdog) + TPU-evidence-predicate contract."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "decode_bench" in by_name
+    cmd, bounded, pred = by_name["decode_bench"]
+    assert cmd[-1].endswith("decode_bench.py")
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_decode_bench_cpu_contract(evidence_dir):
+    """The decode tool reuses bench.py's off-TPU contract: headline 0,
+    run rides under cpu_sanity, tagged evidence file when on TPU."""
+    line = bench.cpu_contract_line({
+        "metric": "decode_tok_s_llama470m_b8_p128_g128_1chip",
+        "value": 1234.5, "unit": "tok/s", "backend": "cpu",
+        "rows": [{"batch": 8, "decode_tok_s": 1234.5}]}, tag="decode")
+    assert line["value"] == 0.0 and line["unit"] == "tok/s"
+    assert line["cpu_sanity"]["rows"][0]["decode_tok_s"] == 1234.5
+    # tagged TPU persistence routes to its own evidence file
+    bench.persist_tpu_result({"metric": "decode", "value": 999.0,
+                              "backend": "tpu"}, {}, tag="decode")
+    assert bench.load_last_tpu(tag="decode")["value"] == 999.0
+    assert bench.load_last_tpu() is None  # headline untouched

@@ -69,6 +69,10 @@ JOBS = [
     ("bench_32k", [sys.executable, "bench.py", "--seq", "32768",
                    "--rope_scaling", "8", "--mbs", "1", "--iters", "4"],
      False, _bench_on_tpu),
+    # VERDICT round-3 item 5: decode tokens/sec (KV-cached while_loop).
+    # Has its own bench.py-style watchdog, so no subprocess timeout.
+    ("decode_bench", [sys.executable, "tools/decode_bench.py"],
+     False, _bench_on_tpu),
 ]
 
 
