@@ -45,8 +45,18 @@ import sys
 import threading
 import time
 
+PEAK_BF16_FLOPS_BY_KIND = {
+    # per-chip peak dense bf16 FLOP/s, by EXACT device_kind string — the
+    # single source of truth (tools/aot_scale_check.py estimates divide by
+    # the same numbers the measured MFU divides by)
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,     # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # Trillium
+    "TPU v6e": 918e12,
+}
 PEAK_BF16_FLOPS = {
-    # per-chip peak dense bf16 FLOP/s
+    # substring fallback on normalized device_kind (live-device probing)
     "v5litepod": 197e12,
     "v5lite": 197e12,
     "v5e": 197e12,
@@ -192,7 +202,10 @@ def peak_flops() -> float:
     import jax
 
     d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu").lower().replace(" ", "")
+    raw_kind = getattr(d, "device_kind", "cpu")
+    if raw_kind in PEAK_BF16_FLOPS_BY_KIND:  # exact kind first (v5p is
+        return PEAK_BF16_FLOPS_BY_KIND[raw_kind]  # "TPU v5", no substring)
+    kind = raw_kind.lower().replace(" ", "")
     for key, val in PEAK_BF16_FLOPS.items():
         if key in kind:
             return val

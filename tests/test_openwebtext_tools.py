@@ -142,3 +142,28 @@ def test_cleanup_dataset(tmp_path):
     r = run("cleanup_dataset.py", str(corpus), str(out), "--min_words", "100")
     assert r.returncode == 0, r.stderr
     assert len(out.read_text().splitlines()) == 1
+
+
+def test_rich_corpus_prose_filter():
+    """make_e2e_corpus --rich harvests docstring PROSE only: parameter
+    tables, doctests and code-ish lines are dropped, real sentences kept
+    (round-3 VERDICT item 8 support)."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from make_e2e_corpus import _prose_paragraphs
+
+    doc = (
+        "Compute the arithmetic mean along the specified axis, returning "
+        "the average of the array elements over the given axis. The "
+        "average is taken over the flattened array by default.\n\n"
+        ">>> np.mean([1, 2, 3])\n2.0\n\n"
+        "Parameters\n----------\naxis : int\n\n"
+        "x : array_like\n    Input values.\n\n"
+        "This second paragraph is genuine prose as well, long enough to "
+        "pass the filter, and it contains multiple sentences. That is "
+        "exactly what the harvester should keep for the corpus."
+    )
+    paras = list(_prose_paragraphs(doc))
+    assert len(paras) == 2, paras
+    assert all(". " in p for p in paras)
+    assert not any(">>>" in p or "----" in p for p in paras)

@@ -7,11 +7,22 @@ train jsonl + held-out valid text, with a WordPiece vocab built from it
 pieces) for the vendored tokenizer (tokenizer/vendored.py).
 
     python tools/make_e2e_corpus.py --out /tmp/e2e
+
+``--rich`` (round-3 VERDICT item 8: make the recorded ppl reflect a model
+that can actually model language) additionally harvests DOCSTRING prose
+from the installed open-source packages (numpy/scipy/jax/torch/
+transformers/pandas/sklearn — parsed with ``ast``, module/class/function
+docstrings only, never code) into a multi-MB corpus: enough tokens that a
+few hundred training steps of a real model produce a held-out perplexity
+that means something, still fully reproducible from this image.
+
+    python tools/make_e2e_corpus.py --out /tmp/e2e_rich --rich
 """
 
 from __future__ import annotations
 
 import argparse
+import ast
 import collections
 import json
 import os
@@ -20,6 +31,62 @@ import unicodedata
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SOURCES = ["README.md", "PERF.md", "SURVEY.md"]
+RICH_PACKAGES = ("numpy", "scipy", "jax", "torch", "transformers",
+                 "pandas", "sklearn", "flax", "optax")
+
+
+def _iter_docstrings(pkg_dir: str):
+    """Yield module/class/function docstrings from every .py under pkg_dir."""
+    for dirpath, _, files in os.walk(pkg_dir):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            try:
+                src = open(os.path.join(dirpath, fname),
+                           encoding="utf-8", errors="ignore").read()
+                tree = ast.parse(src)
+            except (OSError, SyntaxError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef, ast.AsyncFunctionDef)):
+                    doc = ast.get_docstring(node)
+                    if doc:
+                        yield doc
+
+
+def _prose_paragraphs(doc: str):
+    """Keep the prose parts of a docstring; drop parameter tables,
+    doctests and code blocks (lines that look like code or markup)."""
+    for para in doc.split("\n\n"):
+        lines = [ln.strip() for ln in para.strip().splitlines()]
+        keep = [ln for ln in lines
+                if ln and not ln.startswith((">>>", "...", "--", "==", "..",
+                                             ":", "#", "|"))]
+        text = " ".join(keep)
+        # prose filter: long enough, mostly letters, contains a sentence
+        letters = sum(c.isalpha() or c.isspace() for c in text)
+        if len(text) > 120 and letters / max(len(text), 1) > 0.8 \
+                and ". " in text:
+            yield text
+
+
+def harvest_rich_paragraphs(max_bytes: int) -> list:
+    import sysconfig
+
+    site = sysconfig.get_paths()["purelib"]
+    paras, total = [], 0
+    for pkg in RICH_PACKAGES:
+        pkg_dir = os.path.join(site, pkg)
+        if not os.path.isdir(pkg_dir):
+            continue
+        for doc in _iter_docstrings(pkg_dir):
+            for p in _prose_paragraphs(doc):
+                paras.append(p)
+                total += len(p)
+                if total >= max_bytes:
+                    return paras
+    return paras
 
 
 def main() -> None:
@@ -27,6 +94,9 @@ def main() -> None:
     ap.add_argument("--out", required=True)
     ap.add_argument("--valid_fraction", type=float, default=0.1)
     ap.add_argument("--vocab_words", type=int, default=3000)
+    ap.add_argument("--rich", action="store_true",
+                    help="add installed-package docstring prose (multi-MB)")
+    ap.add_argument("--rich_max_mb", type=float, default=8.0)
     args = ap.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
@@ -43,6 +113,15 @@ def main() -> None:
     raw = "\n\n".join(texts)
 
     paras = [p.strip() for p in raw.split("\n\n") if len(p.strip()) > 80]
+    if args.rich:
+        rich = harvest_rich_paragraphs(int(args.rich_max_mb * 1e6))
+        # deterministic interleave-free shuffle so valid is a fair holdout
+        import random
+
+        rng = random.Random(0)
+        paras = paras + rich
+        rng.shuffle(paras)
+        args.valid_fraction = min(args.valid_fraction, 0.02)
     split = int(len(paras) * (1.0 - args.valid_fraction))
     train, valid = paras[:split], paras[split:]
     with open(os.path.join(args.out, "train.jsonl"), "w") as f:

@@ -1,0 +1,208 @@
+"""Single-chip MFU push sweep (round-4: drive 40.0% -> >=45%).
+
+Resumes the round-2 sweep that the tunnel outage cut off (PERF.md: the
+mbs 24/32 full-remat points and the policy sweep never ran) and adds the
+round-3 VERDICT item-2 candidates: chunked head-fused CE, the XLA
+latency-hiding scheduler, and a Pallas-vs-XLA RMSNorm micro-comparison at
+the bench model's width (the kernel is numerics-validated but NOT wired
+into the model path — this measurement decides whether it should be).
+
+Each candidate is one ``bench.py`` subprocess (inheriting its tunnel
+hardening, watchdog and per-config evidence persistence); rows are
+written to ``MFU_SWEEP.json`` in candidate order, with the winner named
+under the ``best`` key. Stops early if a row comes back on CPU (tunnel
+dropped mid-sweep; a candidate-specific failure like an OOM does NOT
+stop the sweep). The whole run carries a bench.py-style clean-exit
+watchdog — tpu_watch gives it no subprocess timeout.
+
+Usage:  python tools/mfu_sweep.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import probe_backend  # noqa: E402
+
+OUT_PATH = os.path.join(REPO, "MFU_SWEEP.json")
+
+# (name, bench.py args, extra env) — priority order: the interrupted
+# round-2 points first, then the CE/scheduler candidates, then combos.
+CANDIDATES = [
+    ("mbs24_full", ["--mbs", "24"], {}),
+    ("mbs32_full", ["--mbs", "32"], {}),
+    ("mbs16_full_ce8", ["--ce_chunks", "8"], {}),
+    ("mbs24_full_ce8", ["--mbs", "24", "--ce_chunks", "8"], {}),
+    ("mbs16_full_lhs",
+     [], {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),
+    ("mbs8_sel_attn",
+     ["--mbs", "8", "--recompute", "selective",
+      "--policy", "save_dots_and_attn"], {}),
+    ("mbs16_full_ce4", ["--ce_chunks", "4"], {}),
+]
+
+
+def run_candidate(name: str, args: list, env_extra: dict) -> dict:
+    env = dict(os.environ)
+    for k, v in env_extra.items():
+        if k == "XLA_FLAGS":
+            # APPEND, never clobber (platform.py convention: later flag
+            # wins within XLA_FLAGS) — a clobber would make this row
+            # differ from the others by more than the candidate flag
+            env[k] = (env.get(k, "") + " " + v).strip()
+        else:
+            env[k] = v
+    t0 = time.time()
+    # NO subprocess timeout: killing a tunnel client mid-step wedges the
+    # tunnel (round-2 lesson); bench.py exits cleanly via its own watchdog
+    r = subprocess.run([sys.executable, "bench.py", *args], cwd=REPO,
+                       capture_output=True, text=True, env=env)
+    row = {"name": name, "args": args, "env": env_extra,
+           "seconds": round(time.time() - t0, 1)}
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            row.update(json.loads(line))
+            break
+        except ValueError:
+            continue
+    if r.returncode != 0:
+        row["rc"] = r.returncode
+        row["stderr_tail"] = (r.stderr or "")[-300:]
+    return row
+
+
+def rmsnorm_micro(shape=(16, 1024, 1024), iters=50) -> dict:
+    """Pallas fused_rms_norm vs the XLA-fused rms_norm at the bench
+    model's hot shape ([mbs, seq, h1024] bf16), fwd+bwd, one jitted scan
+    per variant (same single-dispatch discipline as bench.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.ops.norms import rms_norm
+    from megatron_llm_tpu.ops.pallas.rmsnorm import fused_rms_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.bfloat16)
+    w = jnp.ones((shape[-1],), jnp.bfloat16)
+
+    def timed(fn):
+        def loss(x, w):
+            return fn(x, w).astype(jnp.float32).sum()
+
+        g = jax.grad(loss, argnums=(0, 1))
+
+        def multi(x, w):
+            def body(c, _):
+                dx, dw = g(c, w)
+                return c + dx.astype(c.dtype) * 0, dw.sum()
+
+            return jax.lax.scan(body, x, jnp.arange(iters))[1]
+
+        m = jax.jit(multi)
+        out = m(x, w)
+        jax.block_until_ready(out)  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(m(x, w))
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    t_xla = timed(lambda x, w: rms_norm(x, w))
+    try:
+        t_pallas = timed(lambda x, w: fused_rms_norm(x, w))
+    except Exception as e:
+        return {"rmsnorm_xla_us": round(t_xla * 1e6, 1),
+                "rmsnorm_pallas_error": f"{type(e).__name__}: {e}"[:200]}
+    return {
+        "shape": list(shape),
+        "rmsnorm_xla_us": round(t_xla * 1e6, 1),
+        "rmsnorm_pallas_us": round(t_pallas * 1e6, 1),
+        "pallas_speedup": round(t_xla / t_pallas, 3),
+        "verdict": ("wire pallas rmsnorm into the model path"
+                    if t_pallas < 0.95 * t_xla else
+                    "XLA fusion wins or ties - keep the XLA path"),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="first three candidates + the rmsnorm micro only")
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--watchdog", type=float, default=10800.0,
+                    help="clean-exit guard for the WHOLE sweep (tpu_watch "
+                         "gives this job no subprocess timeout; without "
+                         "this a tunnel wedge inside the in-process "
+                         "rmsnorm micro would hang the watcher)")
+    args = ap.parse_args()
+
+    import threading
+
+    def on_timeout():
+        print(json.dumps({"sweep_done": False,
+                          "error": f"watchdog: exceeded {args.watchdog}s"}),
+              flush=True)
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    backend = probe_backend(args.probe_timeout)
+    summary = {"timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+               "backend": backend, "rows": []}
+    if backend != "tpu":
+        summary["note"] = ("tunnel down: sweep not run (off-TPU sweep "
+                           "numbers are meaningless; see bench.py contract)")
+        print(json.dumps(summary), flush=True)
+        return
+
+    cands = CANDIDATES[:3] if args.quick else CANDIDATES
+    for name, cargs, cenv in cands:
+        row = run_candidate(name, cargs, cenv)
+        summary["rows"].append(row)
+        print(json.dumps(row), flush=True)
+        if row.get("backend") == "cpu":
+            # explicit CPU fallback = tunnel down; a backend-less error
+            # row (e.g. an OOM at mbs32) does NOT stop the sweep
+            summary["note"] = "tunnel dropped mid-sweep; rows above are valid"
+            break
+
+    # re-probe before the in-process micro: its timings are only a
+    # wire-it-in verdict when they come from the TPU, and a dropped
+    # tunnel must not hang this process (the probe is subprocess-bounded)
+    if probe_backend(args.probe_timeout) == "tpu":
+        try:
+            summary["rmsnorm_micro"] = dict(rmsnorm_micro(), backend="tpu")
+            print(json.dumps({"rmsnorm_micro": summary["rmsnorm_micro"]}),
+                  flush=True)
+        except Exception as e:
+            summary["rmsnorm_micro"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+    else:
+        summary["rmsnorm_micro"] = {"skipped": "tunnel down at micro time"}
+
+    tpu_rows = [r for r in summary["rows"]
+                if r.get("backend") not in (None, "cpu") and r.get("value")]
+    if tpu_rows:
+        best = max(tpu_rows, key=lambda r: r["value"])
+        summary["best"] = {"name": best["name"], "value": best["value"],
+                           "args": best["args"], "env": best["env"]}
+    with open(OUT_PATH, "w") as f:
+        json.dump(summary, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"sweep_done": True,
+                      "best": summary.get("best")}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

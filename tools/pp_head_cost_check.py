@@ -1,0 +1,144 @@
+"""pp-vocab-parallel head at realistic width (round-3 VERDICT item 9).
+
+The round-3 evidence for the pp-sharded 1F1B head (parallel/pipeline.py:
+399-460: vocab sharded over the pp axis, vocab-parallel CE across stages —
+every stage does 1/pp of the head as USEFUL work instead of a masked-out
+full head) was a 1.68x speedup on a vocab-dominated toy (V=32k, h=256).
+This tool measures the claim at REALISTIC width: h=4096 (Llama-7B width),
+V=32000, pp=4, ffn 11008 — where the head is a few percent of a tick, not
+the majority — by timing one full 1F1B step (loss+grads) with the flag on
+vs off on the 8-device virtual CPU mesh.
+
+Why wall-time on a CPU mesh and not XLA cost analysis: the compiled
+``cost_analysis()`` counts scan/while bodies ONCE (trip counts ignored —
+see tools/aot_scale_check.py), and the 1F1B tick loop is a scan, so its
+FLOP numbers cannot see the per-tick head at all. Wall-time of the real
+program at the real dims measures the actual ratio; the head:layer compute
+ratio is set by (h, V, ffn, L), not by the backend, so the CPU-mesh
+speedup is the honest stand-in until a 4-chip TPU run is possible.
+Sequence length is kept short (the head and FFN FLOPs both scale linearly
+in tokens, so seq doesn't change the ratio; attention's s^2 term at seq
+256 is negligible at h4096).
+
+Usage: python tools/pp_head_cost_check.py [--hidden 4096 --vocab 32000]
+Writes PP_HEAD_COST.json and prints one JSON line per variant + summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT_PATH = os.path.join(REPO, "PP_HEAD_COST.json")
+
+
+def run_variant(flag: bool, *, hidden, vocab, pp, layers, seq, num_micro,
+                iters) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.models import init_model_params, make_config
+    from megatron_llm_tpu.parallel.pipeline import pipeline_1f1b_loss_and_grads
+    from megatron_llm_tpu.parallel.tp import param_shardings
+
+    cfg = make_config(
+        "llama2", num_layers=layers, hidden_size=hidden,
+        num_attention_heads=hidden // 128, num_attention_heads_kv=8,
+        ffn_hidden_size=11008, vocab_size=vocab, seq_length=seq,
+        max_position_embeddings=2 * seq, params_dtype="float32",
+        pipeline_model_parallel_size=pp, pipeline_schedule="1f1b",
+        micro_batch_size=1, global_batch_size=num_micro,
+        train_iters=10, use_flash_attn=False,
+    )
+    cfg.parallel.num_micro_batches = num_micro
+    cfg.parallel.pp_vocab_parallel_head = flag
+    cfg.finalize()
+
+    mesh = build_mesh(pipeline_model_parallel_size=pp,
+                      devices=jax.devices()[:pp])
+    tok = jax.random.randint(jax.random.PRNGKey(1), (num_micro, seq + 1),
+                             0, vocab)
+    batch = {
+        "tokens": tok[:, :-1], "labels": tok[:, 1:],
+        "loss_mask": jnp.ones((num_micro, seq), jnp.float32),
+    }
+    with global_mesh(mesh):
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        params = jax.device_put(params, param_shardings(mesh, params))
+        f = jax.jit(lambda p, b: pipeline_1f1b_loss_and_grads(cfg, mesh, p, b))
+        t0 = time.perf_counter()
+        loss, grads = f(params, batch)
+        jax.block_until_ready(loss)
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = f(params, batch)
+            jax.block_until_ready(out[0])
+            best = min(best, time.perf_counter() - t0)
+    return {"pp_vocab_parallel_head": flag, "step_s": round(best, 3),
+            "compile_s": round(compile_s, 1), "loss": round(float(loss), 5)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=4096)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--num_micro", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+    pin_cpu_platform(max(args.pp, 8))
+
+    rows = []
+    for flag in (False, True):
+        row = run_variant(flag, hidden=args.hidden, vocab=args.vocab,
+                          pp=args.pp, layers=args.layers, seq=args.seq,
+                          num_micro=args.num_micro, iters=args.iters)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    assert abs(rows[0]["loss"] - rows[1]["loss"]) < 1e-4, rows  # same math
+
+    t_off, t_on = rows[0]["step_s"], rows[1]["step_s"]
+    # analytic head tax for context: per tick every stage runs the head on
+    # one microbatch; off-path that is pp*head_flops of which (pp-1) are
+    # masked waste, on-path each stage does head/pp of useful work
+    h, V, L, f = args.hidden, args.vocab, args.layers, 11008
+    head = 2 * h * V
+    layer_tick = (12 * h * h + 6 * h * f) * (L // args.pp)
+    summary = {
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dims": {"hidden": h, "vocab": V, "pp": args.pp, "layers": L,
+                 "seq": args.seq, "num_micro": args.num_micro},
+        "backend": "cpu-mesh",
+        "step_s_off": t_off, "step_s_on": t_on,
+        "speedup": round(t_off / t_on, 3),
+        "head_flops_fraction_per_stage_fwd": round(
+            head / (head + layer_tick), 4),
+        "note": ("wall-time of the full 1F1B step at realistic width; "
+                 "head:layer ratio is dims-driven so the CPU-mesh speedup "
+                 "stands in for the 4-chip TPU run (module docstring)"),
+        "rows": rows,
+    }
+    with open(OUT_PATH, "w") as fp:
+        json.dump(summary, fp, indent=1)
+        fp.write("\n")
+    print(json.dumps({k: summary[k] for k in
+                      ("speedup", "step_s_off", "step_s_on",
+                       "head_flops_fraction_per_stage_fwd")}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

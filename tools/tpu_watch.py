@@ -61,6 +61,19 @@ def _kernel_check_on_tpu(tail: str) -> bool:
     return "backend: tpu" in tail or "backend: TPU" in tail
 
 
+def _any_line_on_tpu(out: str) -> bool:
+    """Multi-line JSON emitters (mfu_sweep): captured iff ANY row ran on
+    TPU — a mid-sweep tunnel drop still leaves valid rows."""
+    for line in out.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("backend") not in (None, "cpu"):
+            return True
+    return False
+
+
 JOBS = [
     # (name, cmd, needs_timeout, tpu_evidence_predicate)
     ("bench_stock", [sys.executable, "bench.py"], False, _bench_on_tpu),
@@ -73,6 +86,11 @@ JOBS = [
     # Has its own bench.py-style watchdog, so no subprocess timeout.
     ("decode_bench", [sys.executable, "tools/decode_bench.py"],
      False, _bench_on_tpu),
+    # VERDICT round-3 item 2: the MFU push sweep (mbs 24/32, chunked CE,
+    # latency-hiding scheduler, rmsnorm micro). Runs LAST: the stock
+    # evidence above is the priority if the window is short.
+    ("mfu_sweep", [sys.executable, "tools/mfu_sweep.py"],
+     False, _any_line_on_tpu),
 ]
 
 
