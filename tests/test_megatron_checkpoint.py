@@ -181,3 +181,52 @@ def test_reference_checkpoint_round_trip(tmp_path, tp, pp):
     ours = np.asarray(ours, np.float32)[..., :128]
     err = np.abs(ours - hf_logits).max(axis=-1).mean()
     assert err <= 1e-3, f"avg max logit err {err}"
+
+
+def test_pt_reader_ancient_fp16_loss_scaler(tmp_path):
+    """Ancient reference checkpoints pickle their loss scaler from the
+    pre-refactor top-level ``fp16.loss_scaler`` module (the case reference
+    checkpointing.py:487-499 handles with a sys.modules alias). The
+    torch-free reader must load them — scaler stubbed, never executed —
+    and ``extract_loss_scale`` recovers cur_scale (closes the round-3
+    fp16_deprecated descope)."""
+    import sys
+    import types
+
+    fp16_mod = types.ModuleType("fp16")
+    ls_mod = types.ModuleType("fp16.loss_scaler")
+
+    class DynamicLossScaler:
+        def __init__(self):
+            self.cur_scale = 4096.0
+            self.cur_iter = 17
+            self.scale_factor = 2.0
+
+    # pickle resolves classes by (module, qualname): make it look exactly
+    # like the ancient top-level class
+    DynamicLossScaler.__module__ = "fp16.loss_scaler"
+    DynamicLossScaler.__qualname__ = "DynamicLossScaler"
+    ls_mod.DynamicLossScaler = DynamicLossScaler
+    fp16_mod.loss_scaler = ls_mod
+    sys.modules["fp16"] = fp16_mod
+    sys.modules["fp16.loss_scaler"] = ls_mod
+    try:
+        obj = {
+            "model": {"word_embeddings.weight": torch.arange(6.0).reshape(2, 3)},
+            "optimizer": {"loss_scaler": DynamicLossScaler(), "step": 17},
+            "iteration": 80000,
+        }
+        p = tmp_path / "ancient.pt"
+        torch.save(obj, str(p))
+    finally:
+        del sys.modules["fp16"], sys.modules["fp16.loss_scaler"]
+
+    from weights_conversion.pt_reader import extract_loss_scale, load_pt
+
+    state = load_pt(str(p))
+    np.testing.assert_allclose(state["model"]["word_embeddings.weight"],
+                               [[0, 1, 2], [3, 4, 5]])
+    assert state["iteration"] == 80000
+    assert extract_loss_scale(state) == 4096.0
+    # a scaler-free checkpoint reports None, not a fabricated scale
+    assert extract_loss_scale({"model": {}, "optimizer": {"step": 1}}) is None

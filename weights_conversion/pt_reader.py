@@ -100,8 +100,16 @@ class _Unpickler(pickle.Unpickler):
         if (module, name) == ("argparse", "Namespace"):
             return SimpleNamespace
         if module.startswith(("torch", "megatron", "numpy", "argparse",
-                              "deepspeed", "apex")):
-            return _Stub  # never import framework code from a checkpoint
+                              "deepspeed", "apex", "fp16.")):
+            # never import framework code from a checkpoint. "fp16." covers
+            # ANCIENT reference checkpoints whose loss scaler was pickled
+            # from the pre-refactor top-level module (the case the
+            # reference handles by aliasing sys.modules['fp16.loss_scaler']
+            # to megatron.fp16_deprecated.loss_scaler,
+            # checkpointing.py:487-499); the stub keeps the scaler's state
+            # (cur_scale etc.) for extract_loss_scale below — safer than
+            # the reference's import-and-execute, same information out.
+            return _Stub
         raise pickle.UnpicklingError(
             f"refusing to unpickle {module}.{name} from a checkpoint"
         )
@@ -135,3 +143,32 @@ def load_pt(path: str) -> Dict[str, Any]:
 
     with zf.open(pkl_name) as f:
         return _Unpickler(f, read_storage).load()
+
+
+def extract_loss_scale(state: Any) -> float | None:
+    """Recover ``cur_scale`` from a (possibly ancient) reference
+    checkpoint's pickled loss scaler (fp16_deprecated/loss_scaler.py:
+    LossScaler.cur_scale / DynamicLossScaler.cur_scale). The scaler
+    deserializes as a :class:`_Stub` holding the instance ``__dict__``;
+    this walks the loaded tree for the first stub that carries one.
+    Returns None when the checkpoint has no fp16 scaler state."""
+    seen = set()
+
+    def walk(node):
+        if id(node) in seen:
+            return None
+        seen.add(id(node))
+        if isinstance(node, _Stub):
+            st = node._state if isinstance(node._state, dict) else {}
+            if "cur_scale" in st:
+                return float(st["cur_scale"])
+            return None
+        vals = (node.values() if isinstance(node, dict)
+                else node if isinstance(node, (list, tuple)) else ())
+        for v in vals:
+            found = walk(v)
+            if found is not None:
+                return found
+        return None
+
+    return walk(state)
