@@ -1,0 +1,117 @@
+"""Vision data (data/vision.py): AutoAugment ImageNet policy + class-folder
+dataset — the rebuild of the reference's last descoped modules
+(megatron/data/autoaugment.py, image_folder.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from megatron_llm_tpu.data.vision import (  # noqa: E402
+    IMAGENET_POLICY,
+    ImageFolder,
+    ImageNetPolicy,
+    _RANGES,
+    _apply_op,
+    find_classes,
+    is_image_file,
+)
+
+
+def _img(seed=0, size=(32, 32)):
+    rng = np.random.default_rng(seed)
+    return Image.fromarray(
+        rng.integers(0, 256, (*size, 3), dtype=np.uint8), "RGB")
+
+
+def test_policy_table_is_the_published_one():
+    assert len(IMAGENET_POLICY) == 25
+    ops = {op for p in IMAGENET_POLICY for op in (p[0], p[3])}
+    assert ops <= set(_RANGES)
+    # spot-check published entries (paper Table 9 / reference :76-101)
+    assert IMAGENET_POLICY[0] == ("posterize", 0.4, 8, "rotate", 0.6, 9)
+    assert IMAGENET_POLICY[18] == ("shearX", 0.6, 5, "equalize", 1.0, 9)
+
+
+def test_all_14_ops_apply():
+    img = _img()
+    for op, rng in _RANGES.items():
+        out = _apply_op(img, op, rng[5], 1, (128, 128, 128))
+        assert out.size == img.size and out.mode == "RGB", op
+
+
+def test_policy_deterministic_under_seeded_rng():
+    img = _img(1)
+    a = ImageNetPolicy(rng=np.random.default_rng(7))(img)
+    b = ImageNetPolicy(rng=np.random.default_rng(7))(img)
+    c = ImageNetPolicy(rng=np.random.default_rng(8))(img)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    # different stream: overwhelmingly likely to differ on a random image
+    assert a.size == c.size
+
+
+def test_policy_changes_images():
+    """Across many draws the policy must actually augment (non-identity)."""
+    img = _img(2)
+    pol = ImageNetPolicy(rng=np.random.default_rng(3))
+    changed = sum(
+        not np.array_equal(np.asarray(pol(img)), np.asarray(img))
+        for _ in range(20))
+    assert changed >= 10, changed
+
+
+@pytest.fixture()
+def image_tree(tmp_path):
+    for ci, cls in enumerate(["ants", "bees", "cats", "dogs"]):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(4):
+            _img(ci * 10 + i, (8, 8)).save(d / f"{i}.png")
+        (d / "notes.txt").write_text("not an image")
+    return tmp_path
+
+
+def test_image_folder_discovery(image_tree):
+    ds = ImageFolder(str(image_tree))
+    assert ds.classes == ["ants", "bees", "cats", "dogs"]
+    assert len(ds) == 16
+    sample, target = ds[0]
+    assert sample.shape == (8, 8, 3) and sample.dtype == np.uint8
+    assert target == 0
+    assert is_image_file("x.JPG") and not is_image_file("x.txt")
+
+
+def test_image_folder_fractions(image_tree):
+    """The reference's classes_fraction / data_per_class_fraction knobs
+    (image_folder.py:33,67,109)."""
+    ds = ImageFolder(str(image_tree), classes_fraction=0.5,
+                     data_per_class_fraction=0.5)
+    assert ds.classes == ["ants", "bees"]
+    assert len(ds) == 4  # 2 classes x 2 of 4 images
+    assert set(ds.targets) == {0, 1}
+
+
+def test_image_folder_transform_pipeline(image_tree):
+    """transform hook: AutoAugment -> numpy, the training-pipeline shape."""
+    pol = ImageNetPolicy(rng=np.random.default_rng(0))
+    ds = ImageFolder(str(image_tree),
+                     transform=lambda im: np.asarray(pol(im), np.float32) / 255.0,
+                     target_transform=lambda t: t + 100)
+    sample, target = ds[5]
+    assert sample.dtype == np.float32 and sample.max() <= 1.0
+    assert target >= 100
+
+
+def test_image_folder_empty_raises(tmp_path):
+    (tmp_path / "empty_class").mkdir()
+    with pytest.raises(FileNotFoundError):
+        ImageFolder(str(tmp_path))
+
+
+def test_find_classes_fraction_floor(image_tree):
+    classes, mapping = find_classes(str(image_tree), classes_fraction=0.1)
+    assert classes == ["ants"]  # never fewer than one class
+    assert mapping == {"ants": 0}
