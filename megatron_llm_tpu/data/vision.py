@@ -92,6 +92,12 @@ IMAGENET_POLICY: List[Tuple[str, float, int, str, float, int]] = [
     ("equalize", 0.8, 8, "equalize", 0.6, 3),
 ]
 
+for _op1, _p1, _i1, _op2, _p2, _i2 in IMAGENET_POLICY:  # validate once
+    assert _op1 in _RANGES and _op2 in _RANGES
+    assert 0.0 <= _p1 <= 1.0 and 0.0 <= _p2 <= 1.0
+    assert 0 <= _i1 < _LEVELS and 0 <= _i2 < _LEVELS
+del _op1, _p1, _i1, _op2, _p2, _i2
+
 
 def _rotate_with_fill(img, deg: float, fillcolor):
     """Rotate, compositing the exposed corners with fillcolor (the
@@ -123,7 +129,9 @@ def _apply_op(img, op: str, magnitude, sign: int, fillcolor):
                               sign * magnitude * img.size[1]),
                              fillcolor=fillcolor)
     if op == "rotate":
-        return _rotate_with_fill(img, sign * magnitude, fillcolor)
+        # unsigned: the reference never sign-randomizes rotate
+        # (autoaugment.py:274)
+        return _rotate_with_fill(img, magnitude, fillcolor)
     if op == "color":
         return ImageEnhance.Color(img).enhance(1 + sign * magnitude)
     if op == "posterize":
@@ -160,10 +168,6 @@ class ImageNetPolicy:
             raise ImportError("AutoAugment needs Pillow")
         self.fillcolor = tuple(fillcolor)
         self.rng = rng or np.random.default_rng()
-        for op1, p1, i1, op2, p2, i2 in IMAGENET_POLICY:  # validate table
-            assert op1 in _RANGES and op2 in _RANGES
-            assert 0.0 <= p1 <= 1.0 and 0.0 <= p2 <= 1.0
-            assert 0 <= i1 < _LEVELS and 0 <= i2 < _LEVELS
 
     def __call__(self, img):
         op1, p1, i1, op2, p2, i2 = IMAGENET_POLICY[
@@ -211,9 +215,10 @@ def make_dataset(root: str, class_to_idx: Dict[str, int],
         if not os.path.isdir(cdir):
             continue
         local = []
+        exts = tuple(extensions)
         for dirpath, _, files in sorted(os.walk(cdir, followlinks=True)):
             for fname in sorted(files):
-                if fname.lower().endswith(tuple(extensions)):
+                if fname.lower().endswith(exts):
                     local.append((os.path.join(dirpath, fname),
                                   class_to_idx[cls]))
         samples.extend(local[: int(len(local) * data_per_class_fraction)])
@@ -258,8 +263,20 @@ class ImageFolder:
         return len(self.samples)
 
     def __getitem__(self, index: int):
-        path, target = self.samples[index]
-        sample = self.loader(path)
+        # corrupt-sample recovery (image_folder.py:215-221): a file that
+        # fails to load substitutes a random sample instead of killing the
+        # epoch; unlike the reference, exhausting the budget raises rather
+        # than hitting an unbound-local error
+        for _ in range(len(self.samples)):
+            path, target = self.samples[index]
+            try:
+                sample = self.loader(path)
+                break
+            except Exception:
+                index = int(np.random.randint(0, len(self.samples)))
+        else:
+            raise RuntimeError(
+                f"every loader attempt failed (last: {path!r})")
         sample = self.transform(sample) if self.transform \
             else np.asarray(sample, dtype=np.uint8)
         if self.target_transform is not None:

@@ -27,6 +27,7 @@ input dtype.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -455,6 +456,20 @@ def _flash_bwd(scale, causal, sliding_window, block_q, block_kv, interpret,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(var: str, seq: int) -> Optional[int]:
+    """Sweep-only block-size override (tools/mfu_sweep.py retune rows).
+
+    Ignored unless it evenly divides ``seq`` — an override tuned for the
+    bench shape must not break other call sites (e.g. a decode step with a
+    different KV length) in the same process.
+    """
+    v = os.environ.get(var)
+    if not v:
+        return None
+    blk = int(v)
+    return blk if 0 < blk <= seq and seq % blk == 0 else None
+
+
 def _auto_block(seq: int, cap: int = 1024) -> int:
     """Largest power-of-two block <= cap dividing seq.
 
@@ -490,13 +505,14 @@ def flash_attention(
     b, sq, n, d = q.shape
     cap = 1024 if d <= 128 else 512  # VMEM, see _auto_block
     if block_q is None:
-        block_q = _auto_block(sq, cap)
+        block_q = _env_block("MLT_FLASH_BLOCK_Q", sq) or _auto_block(sq, cap)
     if block_kv is None:
         # measured (v5e, seq 8192, window 256): large KV blocks win even for
         # small sliding windows — grid-iteration overhead outweighs the
         # masked compute whole-tile pruning would save (1024x1024 98 ms vs
         # 512x512 109 ms vs 512x256 134 ms) — so no window-based cap
-        block_kv = _auto_block(k.shape[1], cap)
+        block_kv = (_env_block("MLT_FLASH_BLOCK_KV", k.shape[1])
+                    or _auto_block(k.shape[1], cap))
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = target_platform() == "cpu"

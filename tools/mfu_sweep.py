@@ -47,6 +47,17 @@ CANDIDATES = [
      ["--mbs", "8", "--recompute", "selective",
       "--policy", "save_dots_and_attn"], {}),
     ("mbs16_full_ce4", ["--ce_chunks", "4"], {}),
+    # flash block-size retune at the bench shape (VERDICT r3 item 2): the
+    # auto choice is 1024x1024 at seq 1024; smaller Q blocks trade grid
+    # iterations for VMEM pressure / pipelining overlap
+    ("mbs16_full_bq512", [], {"MLT_FLASH_BLOCK_Q": "512"}),
+    ("mbs16_full_bq512_bkv512",
+     [], {"MLT_FLASH_BLOCK_Q": "512", "MLT_FLASH_BLOCK_KV": "512"}),
+    ("mbs16_full_bq256", [], {"MLT_FLASH_BLOCK_Q": "256"}),
+    # everything-on combo: if the single-knob rows each help, their sum is
+    # the 45% candidate
+    ("mbs24_full_ce8_lhs", ["--mbs", "24", "--ce_chunks", "8"],
+     {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),
 ]
 
 
