@@ -240,7 +240,8 @@ class ImageFolder:
                  target_transform: Optional[Callable] = None,
                  classes_fraction: float = 1.0,
                  data_per_class_fraction: float = 1.0,
-                 loader: Optional[Callable] = None):
+                 loader: Optional[Callable] = None,
+                 rng: Optional[np.random.Generator] = None):
         self.root = root
         self.classes, self.class_to_idx = find_classes(
             root, classes_fraction)
@@ -253,6 +254,7 @@ class ImageFolder:
         self.transform = transform
         self.target_transform = target_transform
         self.loader = loader or self._pil_loader
+        self.rng = rng or np.random.default_rng()  # corrupt-sample substitution
 
     @staticmethod
     def _pil_loader(path: str):
@@ -265,18 +267,28 @@ class ImageFolder:
     def __getitem__(self, index: int):
         # corrupt-sample recovery (image_folder.py:215-221): a file that
         # fails to load substitutes a random sample instead of killing the
-        # epoch; unlike the reference, exhausting the budget raises rather
-        # than hitting an unbound-local error
-        for _ in range(len(self.samples)):
+        # epoch. Unlike the reference: draws come from the instance rng
+        # (module invariant: no global random state), and after a bounded
+        # random phase the fallback is a deterministic scan, so the
+        # RuntimeError fires only when NO sample is loadable
+        sample = None
+        for _ in range(min(len(self.samples), 8)):
             path, target = self.samples[index]
             try:
                 sample = self.loader(path)
                 break
             except Exception:
-                index = int(np.random.randint(0, len(self.samples)))
-        else:
-            raise RuntimeError(
-                f"every loader attempt failed (last: {path!r})")
+                index = int(self.rng.integers(len(self.samples)))
+        if sample is None:
+            for path, target in self.samples:
+                try:
+                    sample = self.loader(path)
+                    break
+                except Exception:
+                    continue
+            else:
+                raise RuntimeError("every sample in the dataset failed to "
+                                   f"load (last tried: {path!r})")
         sample = self.transform(sample) if self.transform \
             else np.asarray(sample, dtype=np.uint8)
         if self.target_transform is not None:

@@ -125,7 +125,7 @@ def test_image_folder_corrupt_sample_recovery(image_tree):
     assert sample.shape == (8, 8, 3)
 
     ds.loader = lambda path: (_ for _ in ()).throw(OSError("always fails"))
-    with pytest.raises(RuntimeError, match="every loader attempt"):
+    with pytest.raises(RuntimeError, match="every sample"):
         ds[0]
 
 

@@ -40,6 +40,17 @@ CANDIDATES = [
     ("mbs24_full", ["--mbs", "24"], {}),
     ("mbs32_full", ["--mbs", "32"], {}),
     ("mbs16_full_ce8", ["--ce_chunks", "8"], {}),
+    # the roofline argument for >=45%: full remat caps useful/executed
+    # FLOPs at 3/4 = 75%, so measured 40% implies ~53% hw efficiency;
+    # selective remat raises the cap to ~95%, and chunked CE frees the
+    # ~2 GiB fp32 logit buffer that made selective OOM at mbs 16 —
+    # 0.53 x 0.95 ~= 50% MFU if it fits
+    ("mbs16_sel_attn_ce8",
+     ["--mbs", "16", "--recompute", "selective",
+      "--policy", "save_dots_and_attn", "--ce_chunks", "8"], {}),
+    ("mbs12_sel_attn_ce8",
+     ["--mbs", "12", "--recompute", "selective",
+      "--policy", "save_dots_and_attn", "--ce_chunks", "8"], {}),
     ("mbs24_full_ce8", ["--mbs", "24", "--ce_chunks", "8"], {}),
     ("mbs16_full_lhs",
      [], {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),
@@ -58,6 +69,9 @@ CANDIDATES = [
     # the 45% candidate
     ("mbs24_full_ce8_lhs", ["--mbs", "24", "--ce_chunks", "8"],
      {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),
+    # at mbs 32 the fp32 logit buffer alone is ~4.2 GiB — chunked CE is
+    # what makes the point fit, so sweep them together too
+    ("mbs32_full_ce8", ["--mbs", "32", "--ce_chunks", "8"], {}),
 ]
 
 
