@@ -1,0 +1,175 @@
+"""End-to-end language-quality run of the 470M bench model (VERDICT r3 item 8).
+
+One command: corpus -> preprocess -> train the bench.py model shape
+(24 x h1024 x ffn4096, the "470M" config, vocab from the corpus) ->
+WIKITEXT-adjusted perplexity on held-out paragraphs through tasks/main.py.
+Prints ONE bench.py-style JSON line and persists E2E_470M.json, so
+tools/tpu_watch.py can treat it as a capture job (captured iff
+``backend`` is a TPU).
+
+The corpus is tools/make_e2e_corpus.py --rich (~2M tokens of genuine
+English prose from installed-package docs, zero egress, reproducible).
+At 300 iters x gbs 16 x seq 256 the model sees ~1.2M tokens (<1 epoch),
+so the valid ppl is a real language-modeling number, not memorization —
+upgrading docs/guide/e2e_smoke.md's 0.6M-param plumbing check to a model
+that can actually model language.
+
+Backend handling mirrors bench.py: probe in a subprocess; on TPU train
+bf16 (the bench dtype), on CPU shrink to the documented plan-B recipe
+(fp32, gbs 4, fewer iters — a day of single-core time otherwise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import probe_backend  # noqa: E402
+
+OUT_PATH = os.path.join(REPO, "E2E_470M.json")
+METRIC = "e2e_470m_wikitext_adjusted_ppl"
+
+
+def run(cmd, env=None, tail=4000):
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"{os.path.basename(cmd[1] if len(cmd) > 1 else cmd[0])} "
+            f"rc={r.returncode}: {(r.stderr or r.stdout)[-tail:]}")
+    return r.stdout or ""
+
+
+def model_flags(seq, dtype, mbs, gbs, iters, vocab_file, flash):
+    f = ["--model_name", "gpt",
+         "--num_layers", "24", "--hidden_size", "1024",
+         "--num_attention_heads", "16", "--ffn_hidden_size", "4096",
+         "--seq_length", str(seq), "--max_position_embeddings", str(seq),
+         "--params_dtype", dtype,
+         "--micro_batch_size", str(mbs), "--global_batch_size", str(gbs),
+         "--train_iters", str(iters),
+         "--tokenizer_type", "BertWordPieceLowerCase",
+         "--vocab_file", vocab_file]
+    if not flash:
+        f.append("--no_use_flash_attn")
+    return f
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", default="/tmp/e2e470m_auto")
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--watchdog", type=float, default=7200.0,
+                    help="clean-exit guard (tpu_watch gives no timeout)")
+    ap.add_argument("--force_cpu_full", action="store_true",
+                    help="run the full recipe even on CPU (hours)")
+    args = ap.parse_args()
+
+    def on_timeout():
+        print(json.dumps({"metric": METRIC, "value": 0, "unit": "ppl",
+                          "vs_baseline": 0,
+                          "error": f"watchdog: exceeded {args.watchdog}s"}),
+              flush=True)
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    t0 = time.time()
+    backend = probe_backend(args.probe_timeout)
+    on_tpu = backend != "cpu"
+    if not on_tpu and not args.force_cpu_full:
+        print(json.dumps({
+            "metric": METRIC, "value": 0, "unit": "ppl", "vs_baseline": 0,
+            "backend": "cpu",
+            "note": "off-TPU: full run is a day of single-core time; "
+                    "use --force_cpu_full or the documented plan-B recipe "
+                    "(docs/guide/e2e_smoke.md)"}), flush=True)
+        return
+    wd = args.workdir
+    os.makedirs(wd, exist_ok=True)
+
+    cpu_env = dict(os.environ)
+    cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    # corpus + preprocess always on CPU (pure host work)
+    if not os.path.exists(os.path.join(wd, "corpus.bin")):
+        run([sys.executable, "tools/make_e2e_corpus.py", "--out", wd,
+             "--rich", "--rich_max_mb", "8", "--vocab_words", "8000"],
+            env=cpu_env)
+        run([sys.executable, "tools/preprocess_data.py",
+             "--input", os.path.join(wd, "train.jsonl"),
+             "--output_prefix", os.path.join(wd, "corpus"),
+             "--tokenizer_type", "BertWordPieceLowerCase",
+             "--vocab_file", os.path.join(wd, "vocab.txt"),
+             "--append_eod"], env=cpu_env)
+
+    if on_tpu:
+        dtype, mbs, gbs, iters, flash, env = (
+            "bfloat16", 16, 16, args.iters, True, dict(os.environ))
+    else:  # --force_cpu_full
+        dtype, mbs, gbs, iters, flash, env = (
+            "float32", 4, 4, max(args.iters // 2, 100), False, cpu_env)
+
+    vocab = os.path.join(wd, "vocab.txt")
+    ckpt = os.path.join(wd, "ckpt")
+    lr_flags = ["--lr", "3e-4", "--lr_decay_style", "cosine",
+                "--lr_warmup_iters", str(max(iters // 10, 10)),
+                "--data_path", os.path.join(wd, "corpus"),
+                "--split", "98,2,0",
+                "--save", ckpt, "--save_interval", str(iters),
+                "--log_interval", "50",
+                "--eval_interval", str(iters), "--eval_iters", "20"]
+    train_out = run(
+        [sys.executable, "-u", "finetune.py",
+         *model_flags(args.seq, dtype, mbs, gbs, iters, vocab, flash),
+         *lr_flags], env=env)
+    # last "lm loss: X" on a training-iteration line
+    train_loss = None
+    for line in train_out.splitlines():
+        if "lm loss:" in line and "iteration" in line:
+            train_loss = float(line.split("lm loss:")[1].split("|")[0])
+
+    eval_out = run(
+        [sys.executable, "tasks/main.py", "--task", "WIKITEXT103",
+         "--valid_data", os.path.join(wd, "valid.txt"), "--load", ckpt,
+         *model_flags(args.seq, dtype, mbs, gbs, iters, vocab, flash)],
+        env=env)
+    result = None
+    for line in eval_out.splitlines():
+        if "WIKITEXT103" in line:
+            result = ast.literal_eval(line.strip())["WIKITEXT103"]
+    if result is None:
+        raise RuntimeError(f"no WIKITEXT103 result in: {eval_out[-2000:]}")
+
+    rec = {
+        "metric": METRIC, "value": round(result["ppl"], 2), "unit": "ppl",
+        "vs_baseline": 0,  # no reference number for this corpus — evidence,
+                           # not a comparison
+        "backend": backend,
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "train": {"iters": iters, "gbs": gbs, "seq": args.seq,
+                  "dtype": dtype, "final_lm_loss": train_loss,
+                  "tokens_seen": iters * gbs * args.seq},
+        "eval": {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in result.items()},
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(OUT_PATH, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -91,6 +92,11 @@ JOBS = [
     # evidence above is the priority if the window is short.
     ("mfu_sweep", [sys.executable, "tools/mfu_sweep.py"],
      False, _any_line_on_tpu),
+    # VERDICT round-3 item 8: the 470M-model language-quality e2e (train +
+    # WIKITEXT ppl) — minutes on TPU, so it rides any window that survived
+    # the sweep; own watchdog, no subprocess timeout
+    ("e2e_470m", [sys.executable, "tools/e2e_470m.py"],
+     False, _bench_on_tpu),
 ]
 
 
@@ -111,6 +117,17 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
     tunnel-up time. rc is logged alongside so the log distinguishes
     pass/fail."""
     t0 = time.time()
+    # MLT_PAUSE_PIDS: comma-separated pids to SIGSTOP while a capture job
+    # runs (single-core host: a background CPU training job would inflate
+    # the bench's host-side dispatch times), SIGCONT after
+    paused = []
+    for pid_s in filter(None, os.environ.get(
+            "MLT_PAUSE_PIDS", "").split(",")):
+        try:
+            os.kill(int(pid_s), signal.SIGSTOP)
+            paused.append(int(pid_s))
+        except (ProcessLookupError, ValueError, PermissionError):
+            pass
     try:
         r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
                            timeout=timeout_s)
@@ -118,6 +135,12 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
         log({"job": name, "rc": -1, "error": f"timeout {timeout_s}s",
              "seconds": round(time.time() - t0, 1)})
         return False
+    finally:
+        for pid in paused:
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
     # predicate sees FULL stdout (the kernel check prints its "backend: tpu"
     # header first, well before the last-2000-char log tail)
     captured = on_tpu(r.stdout or "")
