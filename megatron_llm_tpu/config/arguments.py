@@ -41,6 +41,12 @@ class ModelConfig:
     # Linear position-interpolation scaling (CodeLlama 32K path):
     # positions are divided by this factor (reference positional_embeddings.py:11).
     rope_scaling_factor: float = 1.0
+    # 'linear' | 'llama3' (HF rope_type "llama3" frequency remap — Llama-3.1+;
+    # beyond-reference, see ops/rope.py:llama3_scale_freqs)
+    rope_scaling_type: str = "linear"
+    rope_llama3_low_freq_factor: float = 1.0
+    rope_llama3_high_freq_factor: float = 4.0
+    rope_llama3_original_max_position: int = 8192
     vocab_size: Optional[int] = None  # set from tokenizer
     make_vocab_size_divisible_by: int = 128
     layernorm_epsilon: float = 1e-5
@@ -509,6 +515,18 @@ ARCH_DEFAULTS = {
         layernorm_epsilon=1e-5,
         rope_theta=1_000_000.0,
     ),
+    # Llama-3 (beyond-reference): llama2 block + GQA everywhere,
+    # rope_theta 5e5, 128k vocab; 3.1+ checkpoints add the "llama3" rope
+    # frequency remap via rope_scaling_type
+    "llama3": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-5,
+        rope_theta=500_000.0,
+    ),
     # falcon_model.py:18-29: MQA/GQA + parallel attention (+ parallel layernorm for 40B)
     "falcon": dict(
         use_rms_norm=False,
@@ -573,6 +591,12 @@ MODEL_SIZES = {
     "llama2-70b": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
                        num_attention_heads_kv=8, ffn_hidden_size=28672,
                        max_position_embeddings=4096),
+    "llama3-8b": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                      num_attention_heads_kv=8, ffn_hidden_size=14336,
+                      max_position_embeddings=8192, vocab_size=128256),
+    "llama3-70b": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
+                       num_attention_heads_kv=8, ffn_hidden_size=28672,
+                       max_position_embeddings=8192, vocab_size=128256),
     "codellama-34b": dict(num_layers=48, hidden_size=8192, num_attention_heads=64,
                           num_attention_heads_kv=8, ffn_hidden_size=22016,
                           max_position_embeddings=16384),
@@ -671,8 +695,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
         description="TPU-native Megatron-LLM", allow_abbrev=False
     )
     parser.add_argument("--model_name", type=str, default=None,
-                        help="gpt|llama|llama2|codellama|falcon|mistral or a "
-                             "canonical size like llama2-7b")
+                        help="gpt|llama|llama2|codellama|llama3|falcon|"
+                             "mistral|mixtral|bert|t5 or a canonical size "
+                             "like llama2-7b / llama3-8b")
     seen = set()
     for group_name, group_cls in _GROUPS.items():
         group = parser.add_argument_group(group_name)

@@ -19,7 +19,7 @@ def _check(cond: bool, msg: str) -> None:
 def validate_family(cfg: Config) -> Config:
     m = cfg.model
     name = cfg.model_name
-    if name in ("llama", "llama2", "codellama"):
+    if name in ("llama", "llama2", "codellama", "llama3"):
         # llama_model.py:22-30
         _check(m.position_embedding_type == "rotary", "llama requires rotary embeddings")
         _check(m.glu_activation == "swiglu", "llama requires swiglu")

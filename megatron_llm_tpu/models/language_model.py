@@ -89,6 +89,12 @@ def make_rope_cache(cfg) -> Optional[Tuple[jax.Array, jax.Array]]:
         m.max_position_embeddings,
         theta=m.rope_theta,
         scaling_factor=m.rope_scaling_factor,
+        scaling_type=m.rope_scaling_type,
+        llama3_params=dict(
+            low_freq_factor=m.rope_llama3_low_freq_factor,
+            high_freq_factor=m.rope_llama3_high_freq_factor,
+            original_max_position=m.rope_llama3_original_max_position,
+        ),
     )
 
 

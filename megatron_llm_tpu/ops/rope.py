@@ -17,20 +17,61 @@ import jax
 import jax.numpy as jnp
 
 
+def llama3_scale_freqs(
+    freqs: jax.Array,
+    factor: float,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 8192,
+) -> jax.Array:
+    """Llama-3.1 frequency remap (HF ``rope_type: "llama3"``).
+
+    Published piecewise rule: frequencies whose wavelength fits well inside
+    the original context (wavelen < orig/high_freq_factor) are kept;
+    frequencies whose wavelength exceeds it (wavelen > orig/low_freq_factor)
+    are divided by ``factor`` (pure position interpolation); the band in
+    between is smoothly interpolated. Beyond-reference: the reference's
+    positional_embeddings.py:11 only implements the linear rule.
+    """
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wavelen = original_max_position / low_freq_factor
+    high_wavelen = original_max_position / high_freq_factor
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    interp = (1.0 - smooth) * freqs / factor + smooth * freqs
+    out = jnp.where(wavelen > low_wavelen, freqs / factor, interp)
+    return jnp.where(wavelen < high_wavelen, freqs, out)
+
+
 def precompute_freqs(
     dim: int,
     max_len: int,
     theta: float = 10000.0,
     scaling_factor: float = 1.0,
+    scaling_type: str = "linear",
+    llama3_params: dict | None = None,
     dtype=jnp.float32,
 ):
     """Return (cos, sin), each [max_len, dim//2], fp32.
 
     positional_embeddings.py:7-21 semantics incl. position interpolation
-    (positions divided by scaling_factor).
+    (positions divided by scaling_factor). ``scaling_type="llama3"``
+    instead remaps the frequencies per :func:`llama3_scale_freqs`
+    (positions undivided), matching HF Llama-3.1+ checkpoints.
     """
+    if scaling_type not in ("linear", "llama3"):
+        # fail-loudly posture (same as hf_to_native's rope_scaling check):
+        # an unknown type silently falling back to linear would produce
+        # wrong frequencies with no diagnostic
+        raise ValueError(f"unknown rope scaling_type {scaling_type!r}; "
+                         "expected 'linear' or 'llama3'")
     freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(max_len, dtype=jnp.float32) / scaling_factor
+    if scaling_type == "llama3" and scaling_factor != 1.0:
+        freqs = llama3_scale_freqs(freqs, scaling_factor,
+                                   **(llama3_params or {}))
+        t = jnp.arange(max_len, dtype=jnp.float32)
+    else:
+        t = jnp.arange(max_len, dtype=jnp.float32) / scaling_factor
     angles = jnp.outer(t, freqs)  # [max_len, dim//2]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 

@@ -171,3 +171,26 @@ def test_grads_bidirectional_segment_ids():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_env_block_override(monkeypatch):
+    """MLT_FLASH_BLOCK_Q/KV (tools/mfu_sweep.py retune rows): applied when
+    it divides the call's seq, silently ignored otherwise, numerics
+    unchanged either way."""
+    from megatron_llm_tpu.ops.pallas import flash_attention as fa
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), s=128, d=64)
+    base = flash_attention(q, k, v, interpret=True)
+
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("MLT_FLASH_BLOCK_KV", "32")
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 128) == 64
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=2e-5, rtol=2e-5)
+
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "100")  # does not divide 128
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 128) is None
+    out2 = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(base),
+                               atol=2e-5, rtol=2e-5)

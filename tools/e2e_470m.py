@@ -42,8 +42,9 @@ METRIC = "e2e_470m_wikitext_adjusted_ppl"
 def run(cmd, env=None, tail=4000):
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, env=env)
     if r.returncode != 0:
+        label = next((c for c in cmd if c.endswith(".py")), cmd[0])
         raise RuntimeError(
-            f"{os.path.basename(cmd[1] if len(cmd) > 1 else cmd[0])} "
+            f"{os.path.basename(label)} "
             f"rc={r.returncode}: {(r.stderr or r.stdout)[-tail:]}")
     return r.stdout or ""
 
@@ -74,6 +75,10 @@ def main():
     ap.add_argument("--force_cpu_full", action="store_true",
                     help="run the full recipe even on CPU (hours)")
     args = ap.parse_args()
+    if args.force_cpu_full:
+        # the CPU-full path is ~a day of single-core time; the default
+        # guard would discard hours of training at the 2h mark
+        args.watchdog = max(args.watchdog, 172800.0)
 
     def on_timeout():
         print(json.dumps({"metric": METRIC, "value": 0, "unit": "ppl",

@@ -153,7 +153,20 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
     return captured
 
 
+def _resume_paused(signum, frame):
+    """SIGTERM/SIGINT mid-job must not leave MLT_PAUSE_PIDS processes
+    frozen in state T — run_job's finally only covers in-process exits."""
+    for pid_s in filter(None, os.environ.get("MLT_PAUSE_PIDS", "").split(",")):
+        try:
+            os.kill(int(pid_s), signal.SIGCONT)
+        except (ProcessLookupError, ValueError, PermissionError):
+            pass
+    raise SystemExit(128 + signum)
+
+
 def main() -> None:
+    signal.signal(signal.SIGTERM, _resume_paused)
+    signal.signal(signal.SIGINT, _resume_paused)
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=900.0,
                     help="seconds between backend probes")

@@ -242,19 +242,31 @@ def config_from_hf(hf_config, model_name: str):
         vocab_size=hf_config.vocab_size,
         max_position_embeddings=getattr(hf_config, "max_position_embeddings", 2048),
     )
-    # HF linear rope scaling -> --rope_scaling_factor (the reference's
-    # position-interpolation path, positional_embeddings.py:11). Anything we
-    # cannot represent (llama3 / yarn / dynamic) must fail loudly: silently
-    # dropping it would convert to a model with wrong RoPE frequencies.
+    # HF rope scaling -> native: "linear" maps to --rope_scaling_factor
+    # (the reference's position-interpolation path,
+    # positional_embeddings.py:11); "llama3" maps to the native frequency
+    # remap (ops/rope.py:llama3_scale_freqs). Anything else (yarn /
+    # dynamic) must fail loudly: silently dropping it would convert to a
+    # model with wrong RoPE frequencies.
     scaling = getattr(hf_config, "rope_scaling", None)
     if scaling:
         stype = scaling.get("type") or scaling.get("rope_type")
-        if stype != "linear":
+        if stype == "linear":
+            kw["rope_scaling_factor"] = float(scaling["factor"])
+        elif stype == "llama3":
+            kw["rope_scaling_type"] = "llama3"
+            kw["rope_scaling_factor"] = float(scaling["factor"])
+            kw["rope_llama3_low_freq_factor"] = float(
+                scaling.get("low_freq_factor", 1.0))
+            kw["rope_llama3_high_freq_factor"] = float(
+                scaling.get("high_freq_factor", 4.0))
+            kw["rope_llama3_original_max_position"] = int(
+                scaling.get("original_max_position_embeddings", 8192))
+        else:
             raise ValueError(
                 f"unsupported rope_scaling type {stype!r}; only linear "
-                "position interpolation has a native equivalent"
+                "interpolation and the llama3 remap have native equivalents"
             )
-        kw["rope_scaling_factor"] = float(scaling["factor"])
 
     if model_name == "falcon":
         # same fail-loudly posture as rope_scaling above: a config feature we
@@ -304,8 +316,8 @@ def main():
     ap.add_argument("--model", required=True, help="HF model path or name")
     ap.add_argument("--out", required=True, help="output checkpoint dir")
     ap.add_argument("--model_name", default="llama2",
-                    choices=["llama", "llama2", "codellama", "mistral",
-                             "mixtral", "falcon"])
+                    choices=["llama", "llama2", "codellama", "llama3",
+                             "mistral", "mixtral", "falcon"])
     args = ap.parse_args()
 
     import orbax.checkpoint as ocp

@@ -123,10 +123,19 @@ def hf_config_from_native(cfg, vocab_size: int):
     from transformers import FalconConfig, LlamaConfig, MistralConfig
 
     m = cfg.model
-    rope_scaling = (
-        {"type": "linear", "factor": float(m.rope_scaling_factor)}
-        if m.rope_scaling_factor and m.rope_scaling_factor != 1.0 else None
-    )
+    if not m.rope_scaling_factor or m.rope_scaling_factor == 1.0:
+        rope_scaling = None
+    elif getattr(m, "rope_scaling_type", "linear") == "llama3":
+        rope_scaling = {
+            "rope_type": "llama3",
+            "factor": float(m.rope_scaling_factor),
+            "low_freq_factor": float(m.rope_llama3_low_freq_factor),
+            "high_freq_factor": float(m.rope_llama3_high_freq_factor),
+            "original_max_position_embeddings":
+                int(m.rope_llama3_original_max_position),
+        }
+    else:
+        rope_scaling = {"type": "linear", "factor": float(m.rope_scaling_factor)}
     if cfg.model_name == "falcon":
         return FalconConfig(
             vocab_size=vocab_size,
