@@ -461,8 +461,8 @@ class Config:
                     f"carved out of dp)"
                 )
             assert self.model_name in (
-                "gpt", "llama", "llama2", "codellama", "falcon", "mistral",
-                "mixtral",
+                "gpt", "llama", "llama2", "codellama", "llama3", "falcon",
+                "mistral", "mixtral",
             ), (
                 "MoE is supported for the GPT/Llama-family decoder models "
                 "only — the BERT/T5/biencoder loss paths do not consume the "

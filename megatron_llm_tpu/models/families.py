@@ -25,7 +25,8 @@ def validate_family(cfg: Config) -> Config:
         _check(m.glu_activation == "swiglu", "llama requires swiglu")
         _check(m.use_rms_norm, "llama requires RMSNorm")
         _check(not m.use_bias, "llama has no biases")
-        _check(not m.tie_embed_logits, "llama uses untied embeddings")
+        if name != "llama3":  # Llama-3.2 small models tie embeddings
+            _check(not m.tie_embed_logits, "llama uses untied embeddings")
     elif name == "falcon":
         # falcon_model.py:18-29
         _check(m.parallel_attn, "falcon requires parallel_attn")

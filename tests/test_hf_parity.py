@@ -290,3 +290,70 @@ def test_llama_1b_realistic_parity(dtype, gate, ref_abs_gate):
     assert avg_abs <= ref_abs_gate, (
         f"{dtype} avg abs logit err {avg_abs} exceeds the reference "
         f"contract {ref_abs_gate} (getting_started.md:154)")
+
+
+def tiny_hf_llama3(vocab=160):
+    """Llama-3.1-shaped: GQA + theta 5e5 + the "llama3" rope remap active
+    (orig_max 64 < max_pos 128, factor 4 — the remap actually changes
+    frequencies at these dims)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=500_000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 4.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    return LlamaForCausalLM(cfg)
+
+
+def test_llama3_logit_parity_rope_remap():
+    """The llama3 remap at logit level against HF's own forward — proves
+    the converted model reproduces Llama-3.1 numerics, not just configs."""
+    hf = tiny_hf_llama3()
+    cfg = config_from_hf(hf.config, "llama3")
+    assert cfg.model.rope_scaling_type == "llama3"
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=2, seq=96, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 1e-3, f"avg max logit err {avg_max}"
+
+
+def test_llama3_round_trip():
+    from weights_conversion import native_to_hf as n2h
+
+    hf = tiny_hf_llama3()
+    cfg = config_from_hf(hf.config, "llama3")
+    cfg.training.params_dtype = "float32"
+    params = convert_hf_model(hf, cfg)
+    back = n2h.hf_config_from_native(cfg, vocab_size=hf.config.vocab_size)
+    assert back.rope_scaling["rope_type"] == "llama3"
+    assert back.rope_scaling["original_max_position_embeddings"] == 64
+    assert back.rope_theta == 500_000.0
+
+
+def test_llama32_tied_embeddings_parity():
+    """Llama-3.2 small models tie embeddings; the tying must pass through
+    conversion (not silently untie) and reproduce HF logits."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg_hf = LlamaConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=500_000.0,
+        tie_word_embeddings=True, attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    hf = LlamaForCausalLM(cfg_hf)
+    cfg = config_from_hf(hf.config, "llama3")
+    assert cfg.model.tie_embed_logits
+    cfg.training.params_dtype = "float32"
+    cfg.training.use_flash_attn = False
+    stats = verify(hf, cfg, batch_size=2, seq=48, iters=2)
+    avg_max = np.mean([s[2] for s in stats])
+    assert avg_max <= 1e-3, f"avg max logit err {avg_max}"

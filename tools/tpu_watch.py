@@ -120,14 +120,7 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
     # MLT_PAUSE_PIDS: comma-separated pids to SIGSTOP while a capture job
     # runs (single-core host: a background CPU training job would inflate
     # the bench's host-side dispatch times), SIGCONT after
-    paused = []
-    for pid_s in filter(None, os.environ.get(
-            "MLT_PAUSE_PIDS", "").split(",")):
-        try:
-            os.kill(int(pid_s), signal.SIGSTOP)
-            paused.append(int(pid_s))
-        except (ProcessLookupError, ValueError, PermissionError):
-            pass
+    paused = _signal_pause_pids(signal.SIGSTOP)
     try:
         r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
                            timeout=timeout_s)
@@ -136,11 +129,7 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
              "seconds": round(time.time() - t0, 1)})
         return False
     finally:
-        for pid in paused:
-            try:
-                os.kill(pid, signal.SIGCONT)
-            except ProcessLookupError:
-                pass
+        _signal_pause_pids(signal.SIGCONT, paused)
     # predicate sees FULL stdout (the kernel check prints its "backend: tpu"
     # header first, well before the last-2000-char log tail)
     captured = on_tpu(r.stdout or "")
@@ -153,14 +142,33 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
     return captured
 
 
+def _signal_pause_pids(sig, pids=None) -> list[int]:
+    """Send ``sig`` to ``pids`` (default: every pid in MLT_PAUSE_PIDS);
+    returns the pids actually signalled. Single source for the pause
+    protocol — used by run_job (STOP/CONT around capture jobs) and the
+    signal handler (CONT on the way out)."""
+    if pids is None:
+        pids = []
+        for pid_s in filter(None, os.environ.get(
+                "MLT_PAUSE_PIDS", "").split(",")):
+            try:
+                pids.append(int(pid_s))
+            except ValueError:
+                pass
+    hit = []
+    for pid in pids:
+        try:
+            os.kill(pid, sig)
+            hit.append(pid)
+        except (ProcessLookupError, PermissionError):
+            pass
+    return hit
+
+
 def _resume_paused(signum, frame):
     """SIGTERM/SIGINT mid-job must not leave MLT_PAUSE_PIDS processes
     frozen in state T — run_job's finally only covers in-process exits."""
-    for pid_s in filter(None, os.environ.get("MLT_PAUSE_PIDS", "").split(",")):
-        try:
-            os.kill(int(pid_s), signal.SIGCONT)
-        except (ProcessLookupError, ValueError, PermissionError):
-            pass
+    _signal_pause_pids(signal.SIGCONT)
     raise SystemExit(128 + signum)
 
 

@@ -291,6 +291,11 @@ def config_from_hf(hf_config, model_name: str):
         kw["ffn_hidden_size"] = hf_config.intermediate_size
         kw["layernorm_epsilon"] = hf_config.rms_norm_eps
         kw["rope_theta"] = getattr(hf_config, "rope_theta", 10000.0)
+        # pass the checkpoint's tying through (Llama-3.2 ties; most others
+        # don't) — validate_family still rejects combinations the family
+        # contract forbids, rather than silently untying
+        kw["tie_embed_logits"] = bool(
+            getattr(hf_config, "tie_word_embeddings", False))
         if model_name == "mistral":
             kw["sliding_window_size"] = getattr(hf_config, "sliding_window", 4096)
         if model_name == "mixtral":
