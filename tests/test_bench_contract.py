@@ -122,3 +122,24 @@ def test_decode_bench_cpu_contract(evidence_dir):
                               "backend": "tpu"}, {}, tag="decode")
     assert bench.load_last_tpu(tag="decode")["value"] == 999.0
     assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_e2e_470m_contract_line():
+    """tools/e2e_470m.py off-TPU: headline 0, and the watcher predicate
+    must NOT count that line as captured evidence."""
+    from tools.e2e_470m import cpu_contract_record
+
+    line = cpu_contract_record()  # the record main() prints off-TPU
+    assert line["value"] == 0 and line["vs_baseline"] == 0
+    assert not _bench_on_tpu(json.dumps(line))
+    tpu = dict(line, value=23.4, backend="tpu")
+    assert _bench_on_tpu(json.dumps(tpu))
+
+
+def test_e2e_470m_in_watch_jobs():
+    from tools.tpu_watch import JOBS
+
+    names = [n for n, _, _, _ in JOBS]
+    assert "e2e_470m" in names
+    # stock bench stays first: the priority capture if the window is short
+    assert names[0] == "bench_stock"

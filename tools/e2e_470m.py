@@ -39,6 +39,16 @@ OUT_PATH = os.path.join(REPO, "E2E_470M.json")
 METRIC = "e2e_470m_wikitext_adjusted_ppl"
 
 
+def cpu_contract_record() -> dict:
+    """The off-TPU early-exit line (also asserted by test_bench_contract)."""
+    return {
+        "metric": METRIC, "value": 0, "unit": "ppl", "vs_baseline": 0,
+        "backend": "cpu",
+        "note": "off-TPU: full run is a day of single-core time; "
+                "use --force_cpu_full or the documented plan-B recipe "
+                "(docs/guide/e2e_smoke.md)"}
+
+
 def run(cmd, env=None, tail=4000):
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, env=env)
     if r.returncode != 0:
@@ -95,12 +105,7 @@ def main():
     backend = probe_backend(args.probe_timeout)
     on_tpu = backend != "cpu"
     if not on_tpu and not args.force_cpu_full:
-        print(json.dumps({
-            "metric": METRIC, "value": 0, "unit": "ppl", "vs_baseline": 0,
-            "backend": "cpu",
-            "note": "off-TPU: full run is a day of single-core time; "
-                    "use --force_cpu_full or the documented plan-B recipe "
-                    "(docs/guide/e2e_smoke.md)"}), flush=True)
+        print(json.dumps(cpu_contract_record()), flush=True)
         return
     wd = args.workdir
     os.makedirs(wd, exist_ok=True)
