@@ -338,6 +338,9 @@ class InferenceConfig:
     top_p: float = 0.0
     max_tokens_to_oom: int = 12000
     port: int = 5000
+    # weight-only int8 for decode (ops/quant.py): transformer-layer linears
+    # stored int8 in HBM, dequantized inside the GEMM — inference only
+    int8_weights: bool = False
 
 
 @dataclass

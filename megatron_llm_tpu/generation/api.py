@@ -38,6 +38,15 @@ class InferenceEngine:
 
     def __init__(self, cfg, params, tokenizer):
         self.cfg = cfg
+        if cfg.inference.int8_weights:
+            if getattr(cfg.model, "fp8", None):
+                raise ValueError(
+                    "int8_weights and fp8 are mutually exclusive: the fp8 "
+                    "linear path reads the unquantized 'kernel' leaves "
+                    "(ops/fp8.py)")
+            from megatron_llm_tpu.ops.quant import quantize_layer_weights_int8
+
+            params = quantize_layer_weights_int8(params)
         self.params = params
         self.tokenizer = tokenizer
 

@@ -133,7 +133,15 @@ def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None,
 
 
 def _linear(p: Params, x: jax.Array) -> jax.Array:
-    kernel = p["kernel"].astype(x.dtype)
+    if "kernel_q" in p:
+        # weight-only int8 (ops/quant.py): HBM reads int8, the convert to
+        # the activation dtype fuses into the GEMM; per-channel scale
+        # applies to the output (after the GLU chunk-axis restore)
+        kernel = p["kernel_q"].astype(x.dtype)
+        scale = p["kernel_scale"]
+    else:
+        kernel = p["kernel"].astype(x.dtype)
+        scale = None
     if kernel.ndim == 3:
         # GLU fc1 [h, 2, ffn]: flatten for one GEMM, restore the chunk axis
         # (same contract as ops/fp8.fp8_linear)
@@ -141,6 +149,8 @@ def _linear(p: Params, x: jax.Array) -> jax.Array:
         y = y.reshape(*y.shape[:-1], *kernel.shape[1:])
     else:
         y = x @ kernel
+    if scale is not None:
+        y = y * scale.astype(y.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y

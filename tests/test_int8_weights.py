@@ -1,0 +1,135 @@
+"""Weight-only int8 inference (ops/quant.py — beyond-reference; the
+reference decode reads fp16 weights, text_generation/generation.py:89)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.models import (
+    init_model_params,
+    make_config,
+    model_forward,
+)
+from megatron_llm_tpu.ops.quant import (
+    _quantize_kernel,
+    int8_quant_error_bound,
+    quantize_layer_weights_int8,
+)
+
+
+def _logits(res):
+    """model_forward returns (logits, aux...) tuples on some paths."""
+    x = res[0] if isinstance(res, tuple) else res
+    return np.asarray(x, np.float32)
+
+
+def _cfg(**kw):
+    name = kw.pop("model_name", "llama2")
+    d = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+             num_attention_heads_kv=2, vocab_size=256, params_dtype="float32",
+             max_position_embeddings=128, use_flash_attn=False)
+    d.update(kw)
+    return make_config(name, **d)
+
+
+def test_dequant_error_bound():
+    k = jax.random.normal(jax.random.PRNGKey(0), (32, 48)) * 0.3
+    q = _quantize_kernel(k)
+    assert q["kernel_q"].dtype == jnp.int8
+    deq = q["kernel_q"].astype(jnp.float32) * q["kernel_scale"][None, :]
+    err = float(jnp.max(jnp.abs(deq - k)))
+    assert err <= int8_quant_error_bound(k) + 1e-7
+
+
+def test_dequant_glu_and_stacked_axes():
+    # GLU fc1 [in, 2, ffn]: contraction axis -3; stacked [L, in, out]: -2
+    k_glu = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 24))
+    q = _quantize_kernel(k_glu)
+    assert q["kernel_scale"].shape == (2, 24)
+    deq = q["kernel_q"].astype(jnp.float32) * q["kernel_scale"][None]
+    assert float(jnp.max(jnp.abs(deq - k_glu))) <= int8_quant_error_bound(k_glu) + 1e-7
+
+    k_st = jax.random.normal(jax.random.PRNGKey(2), (3, 16, 24))
+    qs = _quantize_kernel(k_st)
+    assert qs["kernel_scale"].shape == (3, 24)
+
+
+def test_logits_close_and_structure():
+    cfg = _cfg()
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_layer_weights_int8(params)
+    # untouched outside the layer stack
+    assert "kernel" in qparams["lm_head"]
+    assert qparams["embedding"] is params["embedding"]
+    # quantized inside
+    qkv = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.dtype == jnp.int8,
+                               qparams["layers"]))
+    assert any(qkv)
+
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    ref = _logits(model_forward(cfg, params, tok))
+    out = _logits(model_forward(cfg, qparams, tok))
+    # W8A16 on a random-init tiny model: logits track closely and the
+    # argmax rarely moves
+    assert np.max(np.abs(ref - out)) < 0.25 * (np.max(np.abs(ref)) + 1.0)
+    agree = (ref.argmax(-1) == out.argmax(-1)).mean()
+    assert agree > 0.9, f"top-1 agreement {agree}"
+
+
+def test_moe_layers_left_unquantized():
+    cfg = make_config("mixtral", num_layers=2, hidden_size=64,
+                      num_attention_heads=4, num_attention_heads_kv=2,
+                      vocab_size=256, params_dtype="float32",
+                      max_position_embeddings=128, num_experts=4,
+                      moe_router_topk=2, use_flash_attn=False)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_layer_weights_int8(params)
+    moe = qparams["layers"]["moe"]
+    assert "kernel" in moe["router"] and "kernel" in moe["experts"]["fc1"]
+    # attention next door IS quantized
+    assert "kernel_q" in qparams["layers"]["attention"]["qkv"]
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    ref = _logits(model_forward(cfg, params, tok))
+    out = _logits(model_forward(cfg, qparams, tok))
+    assert np.isfinite(out).all()
+    assert np.max(np.abs(ref - out)) < 1.0
+
+
+def test_generation_with_int8_engine():
+    """The full decode path (KV cache, while_loop) with int8 weights via
+    the cfg.inference.int8_weights switch on InferenceEngine."""
+    from megatron_llm_tpu.generation import InferenceEngine
+
+    class _Tok:
+        vocab_size = 256
+        eod = 0
+
+        def tokenize(self, s):
+            return [min(ord(c), 255) for c in s]
+
+        def detokenize(self, ids):
+            return "".join(chr(max(1, i)) for i in ids)
+
+    cfg = _cfg()
+    cfg.inference.int8_weights = True
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, _Tok())
+    assert "kernel_q" in eng.params["layers"]["attention"]["qkv"]
+    out = eng.generate(["ab"], tokens_to_generate=4)
+    text = out[0] if isinstance(out, (list, tuple)) else out
+    assert text is not None
+
+
+def test_int8_plus_fp8_rejected():
+    from megatron_llm_tpu.generation import InferenceEngine
+
+    cfg = _cfg()
+    cfg.model.fp8 = "e4m3"
+    cfg.inference.int8_weights = True
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        InferenceEngine(cfg, params, None)

@@ -102,6 +102,9 @@ def main():
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--gen", type=int, default=128)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 for the transformer layers "
+                         "(ops/quant.py W8A16)")
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=1500.0)
     args = ap.parse_args()
@@ -165,12 +168,19 @@ def _run(args, finished):
     with global_mesh(mesh):
         params = init_model_params(cfg, jax.random.PRNGKey(0))
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        if args.int8:
+            # weight-only int8 (ops/quant.py): decode is HBM-bound, so
+            # halving the layer-weight bytes is the headline lever
+            from megatron_llm_tpu.ops.quant import quantize_layer_weights_int8
+
+            params = quantize_layer_weights_int8(params)
         rows = [bench_one(cfg, params, b, args.prompt, args.gen, vocab,
                           args.reps) for b in batches]
 
     headline = rows[-1]  # largest batch
+    variant = "_int8" if args.int8 else ""
     result = {
-        "metric": f"decode_tok_s_llama470m_b{headline['batch']}"
+        "metric": f"decode_tok_s_llama470m{variant}_b{headline['batch']}"
                   f"_p{args.prompt}_g{args.gen}_1chip",
         "value": headline["decode_tok_s"],
         "unit": "tok/s",
@@ -180,9 +190,9 @@ def _run(args, finished):
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
     }
     if result["backend"] != "cpu":
-        persist_tpu_result(result, vars(args), tag="decode")
+        persist_tpu_result(result, vars(args), tag="decode" + variant)
     else:
-        result = cpu_contract_line(result, tag="decode")
+        result = cpu_contract_line(result, tag="decode" + variant)
     finished.set()
     print(json.dumps(result), flush=True)
 

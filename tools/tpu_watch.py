@@ -87,6 +87,11 @@ JOBS = [
     # Has its own bench.py-style watchdog, so no subprocess timeout.
     ("decode_bench", [sys.executable, "tools/decode_bench.py"],
      False, _bench_on_tpu),
+    # weight-only int8 decode (ops/quant.py): the bf16-vs-int8 pair is the
+    # HBM-roofline story for generation
+    ("decode_bench_int8",
+     [sys.executable, "tools/decode_bench.py", "--int8"],
+     False, _bench_on_tpu),
     # VERDICT round-3 item 2: the MFU push sweep (mbs 24/32, chunked CE,
     # latency-hiding scheduler, rmsnorm micro). Runs LAST: the stock
     # evidence above is the priority if the window is short.
