@@ -67,16 +67,16 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
     if "lm_head" in names:
         return P(None, TP_AXIS)  # column-parallel output head
     if "qkv" in names:
-        if names[-1] == "kernel":
+        if names[-1] in ("kernel", "kernel_q"):
             return spec(None, TP_AXIS)  # column-parallel: shard fused head dim
         return spec(TP_AXIS)  # bias
     if "cross_attention" in names and names[-2] in ("q", "kv"):
         # T5 decoder inter-attention projections: column-parallel over heads
-        if names[-1] == "kernel":
+        if names[-1] in ("kernel", "kernel_q"):
             return spec(None, TP_AXIS)
         return spec(TP_AXIS)
     if "dense" in names:
-        if names[-1] == "kernel":
+        if names[-1] in ("kernel", "kernel_q"):
             return spec(TP_AXIS, None)  # row-parallel: shard input (head) dim
         return spec(None)  # row-parallel bias is replicated (added post-reduce)
     if "router" in names:
@@ -87,7 +87,7 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
         # ffn axis over tp — each (ep, tp) shard holds E/ep experts' tp-slice
         # (column/row-parallel per expert, exactly the dense fc1/fc2 rule).
         if "fc1" in names:
-            if names[-1] == "kernel":
+            if names[-1] in ("kernel", "kernel_q"):
                 # [E, h, 2, ffn] (GLU) or [E, h, ffn]
                 return (spec(EP_AXIS, None, None, TP_AXIS)
                         if ndim == 4 + len(lead) else spec(EP_AXIS, None, TP_AXIS))
@@ -95,16 +95,16 @@ def _spec_for_path(path: tuple, ndim: int, stacked: bool) -> P:
             return (spec(EP_AXIS, None, TP_AXIS)
                     if ndim == 3 + len(lead) else spec(EP_AXIS, TP_AXIS))
         if "fc2" in names:
-            if names[-1] == "kernel":
+            if names[-1] in ("kernel", "kernel_q"):
                 return spec(EP_AXIS, TP_AXIS, None)  # [E, ffn, h] row-parallel
             return spec(EP_AXIS, None)  # [E, h] added post-reduce
     if "fc1" in names:
-        if names[-1] == "kernel":
+        if names[-1] in ("kernel", "kernel_q"):
             # [h, 2, ffn] (GLU) or [h, ffn]: shard the ffn axis
             return spec(None, None, TP_AXIS) if ndim == 3 + len(lead) else spec(None, TP_AXIS)
         return spec(None, TP_AXIS) if ndim == 2 + len(lead) else spec(TP_AXIS)
     if "fc2" in names:
-        if names[-1] == "kernel":
+        if names[-1] in ("kernel", "kernel_q"):
             return spec(TP_AXIS, None)  # row-parallel
         return spec(None)
     # norms, everything else: replicated (layer-stacked keeps lead axis)

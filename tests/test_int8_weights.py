@@ -133,3 +133,24 @@ def test_int8_plus_fp8_rejected():
     params = init_model_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="mutually exclusive"):
         InferenceEngine(cfg, params, None)
+
+
+def test_quantized_tree_sharding_specs():
+    """Multi-chip TP with int8 weights: kernel_q takes the kernel's spec
+    (same shape/axes) and kernel_scale the bias-shaped rule — specs must
+    never exceed leaf rank (parallel/tp.py rule extension)."""
+    import jax.tree_util as tu
+
+    from megatron_llm_tpu.parallel.tp import param_partition_specs
+
+    cfg = _cfg()
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    q = quantize_layer_weights_int8(params)
+    specs = param_partition_specs(q)
+    for (path, leaf), spec in zip(tu.tree_flatten_with_path(q)[0],
+                                  tu.tree_leaves(specs)):
+        assert len(tuple(spec)) <= leaf.ndim, (path, spec, leaf.shape)
+    qkv = specs["layers"]["attention"]["qkv"]
+    # column-parallel: fused head dim sharded for the int8 kernel too
+    assert tuple(qkv["kernel_q"])[-1] == "tp"
+    assert tuple(qkv["kernel_scale"])[-1] == "tp"
