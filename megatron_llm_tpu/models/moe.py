@@ -85,6 +85,14 @@ def init_moe_params(cfg, key: jax.Array) -> Params:
     return p
 
 
+def _expert_kernel(p_lin: Params, dt) -> Tuple[jax.Array, Any]:
+    """Expert weight + optional int8 per-channel scale (the shared
+    quantized-leaf contract, ops/quant.py:resolve_kernel)."""
+    from megatron_llm_tpu.ops.quant import resolve_kernel
+
+    return resolve_kernel(p_lin, dt)
+
+
 def _ep_constraint(x: jax.Array, expert_axis: int) -> jax.Array:
     """Constrain an [G, E, C, ...] dispatched tensor so G rides dp and E rides
     ep — the boundary where XLA inserts the data<->expert all-to-all."""
@@ -221,10 +229,12 @@ def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     xe = _ep_constraint(xe, 1)
 
     experts = p["experts"]
-    fc1 = experts["fc1"]["kernel"].astype(dt)
+    fc1, s1 = _expert_kernel(experts["fc1"], dt)
     glu = m.glu_activation is not None
     # [g,e,c,(2,)f]; the bias broadcast [1,e,1,(2,)f] covers both layouts
     y = jnp.einsum("gech,ehuf->gecuf" if glu else "gech,ehf->gecf", xe, fc1)
+    if s1 is not None:  # int8 per-channel scale (same broadcast as bias)
+        y = y * s1.astype(dt)[None, :, None]
     if "bias" in experts["fc1"]:
         y = y + experts["fc1"]["bias"].astype(dt)[None, :, None]
     if glu:
@@ -232,7 +242,10 @@ def moe_sublayer(cfg, p: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         inter = y[..., 0, :] * act(y[..., 1, :])
     else:
         inter = get_mlp_activation(None, m.activation)(y)
-    out_e = jnp.einsum("gecf,efh->gech", inter, experts["fc2"]["kernel"].astype(dt))
+    fc2, s2 = _expert_kernel(experts["fc2"], dt)
+    out_e = jnp.einsum("gecf,efh->gech", inter, fc2)
+    if s2 is not None:
+        out_e = out_e * s2.astype(dt)[None, :, None]
     if "bias" in experts["fc2"]:
         out_e = out_e + experts["fc2"]["bias"].astype(dt)[None, :, None]
     out_e = _ep_constraint(out_e, 1)

@@ -133,15 +133,13 @@ def init_stacked_layers(cfg, key: jax.Array, num_layers: Optional[int] = None,
 
 
 def _linear(p: Params, x: jax.Array) -> jax.Array:
-    if "kernel_q" in p:
-        # weight-only int8 (ops/quant.py): HBM reads int8, the convert to
-        # the activation dtype fuses into the GEMM; per-channel scale
-        # applies to the output (after the GLU chunk-axis restore)
-        kernel = p["kernel_q"].astype(x.dtype)
-        scale = p["kernel_scale"]
-    else:
-        kernel = p["kernel"].astype(x.dtype)
-        scale = None
+    # weight-only int8 support (the shared quantized-leaf contract,
+    # ops/quant.py:resolve_kernel): HBM reads int8, the convert fuses into
+    # the GEMM; the per-channel scale applies to the output (after the GLU
+    # chunk-axis restore)
+    from megatron_llm_tpu.ops.quant import resolve_kernel
+
+    kernel, scale = resolve_kernel(p, x.dtype)
     if kernel.ndim == 3:
         # GLU fc1 [h, 2, ffn]: flatten for one GEMM, restore the chunk axis
         # (same contract as ops/fp8.fp8_linear)

@@ -67,20 +67,31 @@ def quantize_layer_weights_int8(params: dict) -> dict:
 
     def walk(node):
         if isinstance(node, dict):
-            if "router" in node or "experts" in node:
-                # MoE sublayer: the dropless dispatch consumes kernels
-                # directly (models/moe.py:206,224) — left unquantized
-                return node
             if "kernel" in node and getattr(node["kernel"], "ndim", 0) >= 2:
                 out = {k: v for k, v in node.items() if k != "kernel"}
                 out.update(_quantize_kernel(node["kernel"]))
                 return out
-            return {k: walk(v) for k, v in node.items()}
+            # MoE: expert FFN stacks quantize (their [E,...] kernels carry
+            # per-expert channel scales; models/moe.py:_expert_kernel
+            # consumes them); the router stays fp32 — routing logits are
+            # precision-sensitive and the [h, E] kernel is negligible HBM
+            return {k: (v if k == "router" else walk(v))
+                    for k, v in node.items()}
         return node
 
     out = dict(params)
     out["layers"] = walk(params["layers"])
     return out
+
+
+def resolve_kernel(p_lin: dict, dt) -> tuple:
+    """(weight-in-dt, optional per-channel scale) for a linear's param dict
+    — THE quantized-leaf contract, consumed by transformer._linear and
+    moe._expert_kernel; the int8->dt cast fuses into the downstream GEMM
+    read so HBM sees int8."""
+    if "kernel_q" in p_lin:
+        return p_lin["kernel_q"].astype(dt), p_lin["kernel_scale"]
+    return p_lin["kernel"].astype(dt), None
 
 
 def int8_quant_error_bound(kernel: jax.Array) -> float:

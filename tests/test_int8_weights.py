@@ -80,7 +80,7 @@ def test_logits_close_and_structure():
     assert agree > 0.9, f"top-1 agreement {agree}"
 
 
-def test_moe_layers_left_unquantized():
+def test_moe_experts_quantized_router_kept():
     cfg = make_config("mixtral", num_layers=2, hidden_size=64,
                       num_attention_heads=4, num_attention_heads_kv=2,
                       vocab_size=256, params_dtype="float32",
@@ -89,8 +89,11 @@ def test_moe_layers_left_unquantized():
     params = init_model_params(cfg, jax.random.PRNGKey(0))
     qparams = quantize_layer_weights_int8(params)
     moe = qparams["layers"]["moe"]
-    assert "kernel" in moe["router"] and "kernel" in moe["experts"]["fc1"]
-    # attention next door IS quantized
+    # router stays fp32 (routing is precision-sensitive, [h,E] negligible)
+    assert "kernel" in moe["router"]
+    # expert stacks ARE quantized, with per-expert channel scales
+    assert moe["experts"]["fc1"]["kernel_q"].dtype == jnp.int8
+    assert moe["experts"]["fc2"]["kernel_q"].dtype == jnp.int8
     assert "kernel_q" in qparams["layers"]["attention"]["qkv"]
     tok = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
     ref = _logits(model_forward(cfg, params, tok))
@@ -143,14 +146,24 @@ def test_quantized_tree_sharding_specs():
 
     from megatron_llm_tpu.parallel.tp import param_partition_specs
 
-    cfg = _cfg()
-    params = init_model_params(cfg, jax.random.PRNGKey(0))
-    q = quantize_layer_weights_int8(params)
-    specs = param_partition_specs(q)
-    for (path, leaf), spec in zip(tu.tree_flatten_with_path(q)[0],
-                                  tu.tree_leaves(specs)):
-        assert len(tuple(spec)) <= leaf.ndim, (path, spec, leaf.shape)
+    moe_cfg = make_config("mixtral", num_layers=2, hidden_size=64,
+                          num_attention_heads=4, num_attention_heads_kv=2,
+                          vocab_size=256, params_dtype="float32",
+                          max_position_embeddings=128, num_experts=4,
+                          moe_router_topk=2, use_flash_attn=False)
+    for cfg in (_cfg(), moe_cfg):
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        q = quantize_layer_weights_int8(params)
+        specs = param_partition_specs(q)
+        for (path, leaf), spec in zip(tu.tree_flatten_with_path(q)[0],
+                                      tu.tree_leaves(specs)):
+            assert len(tuple(spec)) <= leaf.ndim, (path, spec, leaf.shape)
     qkv = specs["layers"]["attention"]["qkv"]
     # column-parallel: fused head dim sharded for the int8 kernel too
     assert tuple(qkv["kernel_q"])[-1] == "tp"
     assert tuple(qkv["kernel_scale"])[-1] == "tp"
+    # expert stacks (leading layer-stack axis, then E): ep on the expert
+    # axis for both quantized leaves
+    fc1 = specs["layers"]["moe"]["experts"]["fc1"]
+    assert tuple(fc1["kernel_q"])[1] == "ep", tuple(fc1["kernel_q"])
+    assert tuple(fc1["kernel_scale"])[1] == "ep", tuple(fc1["kernel_scale"])
