@@ -166,6 +166,29 @@ CONFIGS = {
         tp=8, pp=1, cp=1, dp=4, ep=4, num_micro=4, mbs=1,
         schedule=None, vpp=None, recompute="full",
     ),
+    # Beyond the reference: Llama-3-8B (round-4 family) — the 128k vocab
+    # quadruples the head/embedding relative to llama2-7b and the "llama3"
+    # rope remap is active (3.1-style 32K via factor 4). Pure tp8 on
+    # v5e-8 genuinely does NOT fit — the compiler rejected it at 17.16 G
+    # vs 15.75 G: the +1.30B params over llama2-7b (embed/head +0.79B,
+    # wider FFN +1.31B, GQA -0.81B) cost ~1.8 GiB/chip of fp32 Adam
+    # state at tp8 — so the certified recipe is v5e-16: tp8 x dp2
+    # with the ZeRO-1 distributed optimizer sharding masters+moments over
+    # dp, exactly what the bigger head demands
+    "llama3_8b_tp8_dp2_v5e16": dict(
+        topology="v5e:4x4", family="llama3",
+        model=dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   num_attention_heads_kv=8, ffn_hidden_size=14336,
+                   vocab_size=128256, seq_length=4096,
+                   max_position_embeddings=32768,
+                   rope_scaling_factor=4.0, rope_scaling_type="llama3"),
+        tp=8, pp=1, cp=1, dp=2, num_micro=32, mbs=1,
+        schedule=None, vpp=None, recompute="full",
+        # chunked CE: at vocab 128256 the fp32 logits are 2 GiB/microbatch
+        # unsplit
+        extra=dict(accumulate_allreduce_grads_in_fp32=False,
+                   ce_vocab_chunks=8),
+    ),
     # BASELINE.json config 5 / north star: "Llama-2-70B TP=8 PP=8 DP=4 on
     # v5p-256 (GQA, distributed optimizer, sequence-parallel)"
     "llama2_70b_tp8_pp8_dp4_v5p256": dict(
