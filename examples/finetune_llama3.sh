@@ -1,0 +1,23 @@
+#!/bin/bash
+# Llama-3-8B finetune (beyond-reference family, round 4). The certified
+# memory recipe for 16-GiB chips is v5e-16 = tp8 x dp2 with the ZeRO-1
+# distributed optimizer — pure tp8 on v5e-8 does NOT fit (AOT-verified:
+# the 128k-vocab head + wider FFN cost ~1.8 GiB/chip of fp32 Adam state
+# more than llama2-7b; see PERF.md "AOT scale proof" and
+# tools/aot_scale_check.py:llama3_8b_tp8_dp2_v5e16).
+#
+# Convert the HF checkpoint first (handles the 3.1+ "llama3" rope remap
+# and 3.2-style tied embeddings automatically):
+#   python weights_conversion/hf_to_native.py --model meta-llama/Meta-Llama-3-8B \
+#       --out ckpts/llama3-8b --model_name llama3
+python finetune.py --model_name llama3-8b \
+    --tensor_model_parallel_size 8 --data_parallel_size 2 \
+    --use_distributed_optimizer true \
+    --load ${CKPT:-ckpts/llama3-8b} --save ${OUT:-ckpts/llama3-8b-ft} \
+    --tokenizer_type HFTokenizer --tokenizer_model ${TOK:-meta-llama/Meta-Llama-3-8B} \
+    --seq_length 4096 --micro_batch_size 1 --global_batch_size 64 \
+    --train_iters ${ITERS:-1000} --lr 2e-5 --lr_decay_style cosine \
+    --lr_warmup_iters 100 --weight_decay 0.1 \
+    --accumulate_allreduce_grads_in_fp32 false --ce_vocab_chunks 8 \
+    --recompute_granularity full \
+    --data_path ${DATA:-/data/corpus} --split "969,30,1"
