@@ -1,4 +1,5 @@
-"""End-to-end language-quality run of the 470M bench model (VERDICT r3 item 8).
+"""End-to-end language-quality run of the 470M bench model (VERDICT r3 item 8,
+extended per r4 item 8 to a staged full-epoch run with resume exercised).
 
 One command: corpus -> preprocess -> train the bench.py model shape
 (24 x h1024 x ffn4096, the "470M" config, vocab from the corpus) ->
@@ -9,14 +10,19 @@ tools/tpu_watch.py can treat it as a capture job (captured iff
 
 The corpus is tools/make_e2e_corpus.py --rich (~2M tokens of genuine
 English prose from installed-package docs, zero egress, reproducible).
-At 300 iters x gbs 16 x seq 256 the model sees ~1.2M tokens (<1 epoch),
-so the valid ppl is a real language-modeling number, not memorization —
-upgrading docs/guide/e2e_smoke.md's 0.6M-param plumbing check to a model
-that can actually model language.
+A FULL epoch is ~2M tokens; at gbs 16 x seq 256 (TPU) that is ~500
+iters (minutes), at gbs 4 (the CPU plan-B recipe) ~2000 iters (~32 h of
+single-core time). ``--stage_iters N`` therefore runs the training in
+stages of N iters, each stage a separate finetune.py process resuming
+from the previous stage's checkpoint (real resume through the tracker
+file + consumed_samples fast-forward), with a WIKITEXT eval after every
+stage and E2E_470M.json rewritten incrementally — a run killed at any
+point still leaves the best-so-far trajectory as evidence, and restarts
+of this script continue from the checkpoint instead of from scratch.
 
 Backend handling mirrors bench.py: probe in a subprocess; on TPU train
 bf16 (the bench dtype), on CPU shrink to the documented plan-B recipe
-(fp32, gbs 4, fewer iters — a day of single-core time otherwise).
+(fp32, gbs 4 — a day of single-core time otherwise).
 """
 
 from __future__ import annotations
@@ -59,6 +65,50 @@ def run(cmd, env=None, tail=4000):
     return r.stdout or ""
 
 
+def run_logged(cmd, log_path, env=None, tail=8000):
+    """Like run() but streams stdout+stderr to ``log_path`` (append) — an
+    hours-long background training stage must not hold its progress in a
+    pipe that dies with the process. Returns the log tail for parsing."""
+    with open(log_path, "a") as lf:
+        lf.write(f"\n==== {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+                 f" {' '.join(os.path.basename(c) for c in cmd[:3])} ====\n")
+        lf.flush()
+        r = subprocess.run(cmd, cwd=REPO, stdout=lf,
+                           stderr=subprocess.STDOUT, text=True, env=env)
+    with open(log_path) as lf2:
+        out_tail = lf2.read()[-tail:]
+    if r.returncode != 0:
+        label = next((c for c in cmd if c.endswith(".py")), cmd[0])
+        raise RuntimeError(
+            f"{os.path.basename(label)} rc={r.returncode}: {out_tail[-4000:]}")
+    return out_tail
+
+
+def parse_train_loss(out: str):
+    """Last "lm loss: X" on a training-iteration line; None when the log
+    format drifts — this is metadata, never worth discarding the run over
+    (ADVICE r4: an uncaught ValueError here threw away hours of training)."""
+    loss = None
+    for line in out.splitlines():
+        if "lm loss:" in line and "iteration" in line:
+            try:
+                loss = float(line.split("lm loss:")[1].split("|")[0])
+            except (ValueError, IndexError):
+                pass
+    return loss
+
+
+def done_iters(ckpt: str) -> int:
+    """Completed iterations per the checkpoint tracker (0 = fresh start)."""
+    try:
+        with open(os.path.join(
+                ckpt, "latest_checkpointed_iteration.txt")) as f:
+            txt = f.read().strip()
+        return 0 if txt == "release" else int(txt)
+    except (OSError, ValueError):
+        return 0
+
+
 def model_flags(seq, dtype, mbs, gbs, iters, vocab_file, flash):
     f = ["--model_name", "gpt",
          "--num_layers", "24", "--hidden_size", "1024",
@@ -77,7 +127,13 @@ def model_flags(seq, dtype, mbs, gbs, iters, vocab_file, flash):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir", default="/tmp/e2e470m_auto")
-    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--iters", type=int, default=300,
+                    help="total training iterations (the epoch is ~2M "
+                         "tokens: ~500 iters at gbs 16, ~2000 at gbs 4)")
+    ap.add_argument("--stage_iters", type=int, default=0,
+                    help="train in resume-exercising stages of this many "
+                         "iters, WIKITEXT eval + E2E_470M.json rewrite "
+                         "after each (0 = single shot)")
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=7200.0,
@@ -109,6 +165,7 @@ def main():
         return
     wd = args.workdir
     os.makedirs(wd, exist_ok=True)
+    train_log = os.path.join(wd, "train.log")
 
     cpu_env = dict(os.environ)
     cpu_env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -126,58 +183,113 @@ def main():
              "--append_eod"], env=cpu_env)
 
     if on_tpu:
-        dtype, mbs, gbs, iters, flash, env = (
-            "bfloat16", 16, 16, args.iters, True, dict(os.environ))
+        dtype, mbs, gbs, flash, env = "bfloat16", 16, 16, True, dict(os.environ)
+        total = args.iters
     else:  # --force_cpu_full
-        dtype, mbs, gbs, iters, flash, env = (
-            "float32", 4, 4, max(args.iters // 2, 100), False, cpu_env)
+        dtype, mbs, gbs, flash, env = "float32", 4, 4, False, cpu_env
+        total = args.iters if args.stage_iters else max(args.iters // 2, 100)
 
     vocab = os.path.join(wd, "vocab.txt")
     ckpt = os.path.join(wd, "ckpt")
-    lr_flags = ["--lr", "3e-4", "--lr_decay_style", "cosine",
-                "--lr_warmup_iters", str(max(iters // 10, 10)),
+    stage = args.stage_iters or total
+
+    def lr_flags(train_iters, save_interval):
+        # --lr_decay_iters=total: each stage sees train_iters=<its target>,
+        # so without the explicit decay horizon the cosine would complete
+        # per-stage and the LR would sawtooth across resumes instead of
+        # following ONE schedule over the whole run
+        return ["--lr", "3e-4", "--lr_decay_style", "cosine",
+                "--lr_warmup_iters", str(max(total // 10, 10)),
+                "--lr_decay_iters", str(total),
                 "--data_path", os.path.join(wd, "corpus"),
                 "--split", "98,2,0",
-                "--save", ckpt, "--save_interval", str(iters),
+                "--save", ckpt, "--save_interval", str(save_interval),
                 "--log_interval", "50",
-                "--eval_interval", str(iters), "--eval_iters", "20"]
-    train_out = run(
-        [sys.executable, "-u", "finetune.py",
-         *model_flags(args.seq, dtype, mbs, gbs, iters, vocab, flash),
-         *lr_flags], env=env)
-    # last "lm loss: X" on a training-iteration line
-    train_loss = None
-    for line in train_out.splitlines():
-        if "lm loss:" in line and "iteration" in line:
-            train_loss = float(line.split("lm loss:")[1].split("|")[0])
+                "--eval_interval", str(train_iters), "--eval_iters", "20"]
 
-    eval_out = run(
-        [sys.executable, "tasks/main.py", "--task", "WIKITEXT103",
-         "--valid_data", os.path.join(wd, "valid.txt"), "--load", ckpt,
-         *model_flags(args.seq, dtype, mbs, gbs, iters, vocab, flash)],
-        env=env)
-    result = None
-    for line in eval_out.splitlines():
-        if "WIKITEXT103" in line:
-            result = ast.literal_eval(line.strip())["WIKITEXT103"]
-    if result is None:
+    def wikitext_eval():
+        eval_out = run(
+            [sys.executable, "tasks/main.py", "--task", "WIKITEXT103",
+             "--valid_data", os.path.join(wd, "valid.txt"), "--load", ckpt,
+             *model_flags(args.seq, dtype, mbs, gbs, total, vocab, flash)],
+            env=env)
+        for line in eval_out.splitlines():
+            if "WIKITEXT103" in line:
+                return ast.literal_eval(line.strip())["WIKITEXT103"]
         raise RuntimeError(f"no WIKITEXT103 result in: {eval_out[-2000:]}")
 
-    rec = {
-        "metric": METRIC, "value": round(result["ppl"], 2), "unit": "ppl",
-        "vs_baseline": 0,  # no reference number for this corpus — evidence,
-                           # not a comparison
-        "backend": backend,
-        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "train": {"iters": iters, "gbs": gbs, "seq": args.seq,
-                  "dtype": dtype, "final_lm_loss": train_loss,
-                  "tokens_seen": iters * gbs * args.seq},
-        "eval": {k: (round(v, 4) if isinstance(v, float) else v)
-                 for k, v in result.items()},
-        "wall_s": round(time.time() - t0, 1),
-    }
-    with open(OUT_PATH, "w") as f:
-        json.dump(rec, f, indent=1)
+    def write_record(result, train_loss, done, resumes, final):
+        rec = {
+            "metric": METRIC, "value": round(result["ppl"], 2), "unit": "ppl",
+            "vs_baseline": 0,  # no reference number for this corpus —
+                               # evidence, not a comparison
+            "backend": backend,
+            "timestamp_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "train": {"iters": done, "target_iters": total, "gbs": gbs,
+                      "seq": args.seq, "dtype": dtype,
+                      "final_lm_loss": train_loss,
+                      "tokens_seen": done * gbs * args.seq,
+                      "resumes": resumes, "complete": final},
+            "eval": {k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in result.items()},
+            "trajectory": trajectory,
+            "wall_s": round(time.time() - t0, 1),
+        }
+        with open(OUT_PATH + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(OUT_PATH + ".tmp", OUT_PATH)
+        return rec
+
+    trajectory, resumes = [], 0
+    if os.path.exists(OUT_PATH) and done_iters(ckpt) > 0:
+        try:  # script restart mid-run: keep the earlier stages' points and
+            # the resume count (each stage after the first IS a resume; a
+            # record that said "resumes: 0" after a restart would deny the
+            # property this staged design exists to prove)
+            with open(OUT_PATH) as f:
+                prior = json.load(f)
+            trajectory = prior.get("trajectory", [])
+            resumes = prior.get("train", {}).get("resumes", 0)
+        except (OSError, ValueError):
+            pass
+
+    rec = None
+    while True:
+        done = done_iters(ckpt)
+        if done >= total:
+            break
+        # final-stage alignment: a save only fires when iteration %
+        # save_interval == 0, so a partial last stage (e.g. 500 -> 550)
+        # must shrink the interval or the tracker never advances and the
+        # loop would respawn the same stage forever
+        target = min(done + stage, total)
+        save_every = min(stage, target - done)
+        cmd = [sys.executable, "-u", "finetune.py",
+               *model_flags(args.seq, dtype, mbs, gbs, target, vocab, flash),
+               *lr_flags(target, save_every)]
+        if done > 0:
+            cmd += ["--load", ckpt]
+            resumes += 1
+        out_tail = run_logged(cmd, train_log, env=env)
+        train_loss = parse_train_loss(out_tail)
+        now_done = done_iters(ckpt)
+        if now_done <= done:  # progress guard: never spin on a stage that
+            raise RuntimeError(  # exits without advancing the tracker
+                f"stage made no checkpoint progress (tracker {done} -> "
+                f"{now_done}, target {target}); see {train_log}")
+        done = now_done
+        result = wikitext_eval()
+        trajectory.append({
+            "iters": done, "tokens": done * gbs * args.seq,
+            "ppl": round(result["ppl"], 2), "train_loss": train_loss})
+        rec = write_record(result, train_loss, done, resumes, done >= total)
+        print(json.dumps({"stage_done": done, "target": total,
+                          "ppl": rec["value"]}), flush=True)
+
+    if rec is None:  # training already complete on entry: eval only
+        result = wikitext_eval()
+        rec = write_record(result, None, done_iters(ckpt), resumes, True)
     print(json.dumps(rec), flush=True)
 
 

@@ -1,0 +1,231 @@
+"""Un-killable ≤60s TPU evidence capture — job #1 in the tpu_watch queue.
+
+Four rounds of VERDICTs demanded one driver-verifiable TPU number; every
+attempt died to the same failure shape: the tunnel answers briefly, the
+10-minute bench starts, and a harness timeout (or the tunnel dropping)
+kills it mid-step — leaving nothing. This job is built so that a one-shot
+window of under a minute still lands durable evidence:
+
+  * tiny model (4 x h512, ~45M params) on the REAL training path
+    (make_jitted_train_step) — compile is seconds, not minutes;
+  * evidence is persisted in PHASES, atomically, each one upgrading
+    ``BENCH_LAST_TPU_micro.json``:
+        contact   — backend + device_kind confirmed on TPU  (~5 s in)
+        step1     — one full train step executed, loss fetched
+        timed     — a scanned 10-step timing (tok/s + MFU)
+    a kill at ANY point after "contact" leaves a committed TPU record;
+  * SIGTERM/SIGINT write the current phase record on the way out;
+  * if no headline ``BENCH_LAST_TPU.json`` exists yet, the final record is
+    copied there too (clearly marked ``"micro": true``) so bench.py's
+    off-TPU fallback line carries real hardware evidence; a later stock
+    bench capture overwrites it with the real 470M measurement;
+  * the persistent compilation cache (/tmp/jax_cache) makes retry windows
+    nearly compile-free.
+
+Off TPU it prints the bench.py contract line (value 0, backend cpu).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import (  # noqa: E402
+    LAST_TPU_PATH, cpu_contract_line, flops_per_token, peak_flops,
+    probe_backend,
+)
+
+METRIC = "tpu_micro_capture_tok_s"
+MICRO_PATH = os.path.join(REPO, "BENCH_LAST_TPU_micro.json")
+
+_current: dict = {}  # latest phase record, flushed by the signal handler
+
+
+def _headline_is_free() -> bool:
+    """The headline slot is writable while it is empty OR still holds a
+    micro record — otherwise phase "contact" (value 0) would create the
+    file and then block its own "timed" upgrade forever. A real stock
+    bench record (no ``micro`` flag) is never clobbered."""
+    try:
+        with open(LAST_TPU_PATH) as f:
+            return bool(json.load(f).get("micro"))
+    except OSError:
+        return True
+    except ValueError:
+        return True  # unparseable leftovers are not evidence worth keeping
+
+
+def _persist(rec: dict) -> None:
+    """Atomic replace; each phase upgrades both evidence slots."""
+    global _current
+    _current = rec
+    tmp = MICRO_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, MICRO_PATH)
+        if _headline_is_free():
+            with open(tmp, "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, LAST_TPU_PATH)
+    except OSError:
+        pass
+
+
+def _flush_and_exit(signum, frame):
+    if _current:
+        rec = dict(_current)
+        rec["killed_by_signal"] = signum
+        _persist(rec)
+        print(json.dumps(rec), flush=True)
+    os._exit(128 + signum)
+
+
+def capture(iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "cpu":
+        try:  # TPU retry windows should not pay compile twice (CPU is
+            # excluded: XLA:CPU AOT cache entries carry machine-feature
+            # lists that mis-load across toolchain updates -> SIGILL risk)
+            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        except Exception:
+            pass
+
+    from megatron_llm_tpu.core.parallel_state import build_mesh
+    from megatron_llm_tpu.models import init_model_params, make_config
+    from megatron_llm_tpu.training_step import make_jitted_train_step
+
+    dev = jax.devices()[0]
+    base = {
+        "metric": METRIC, "unit": "tok/s", "vs_baseline": 0.0,
+        "backend": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "micro": True,
+        "note": "tiny-model liveness capture (tools/tpu_micro_capture.py); "
+                "tok/s+MFU are for the 4xh512 micro model, not the 470M "
+                "headline config",
+    }
+    if dev.platform != "cpu":
+        _persist({**base, "phase": "contact", "value": 0.0})
+
+    layers, hidden, heads, ffn, vocab, seq, mbs = 4, 512, 8, 2048, 8192, 512, 4
+    cfg = make_config(
+        "llama2", num_layers=layers, hidden_size=hidden,
+        num_attention_heads=heads, num_attention_heads_kv=heads,
+        ffn_hidden_size=ffn, vocab_size=vocab, seq_length=seq,
+        max_position_embeddings=seq, params_dtype="bfloat16",
+        micro_batch_size=mbs, global_batch_size=mbs,
+        train_iters=100, lr=1e-4,
+    )
+    mesh = build_mesh(devices=jax.devices()[:1])
+    with mesh:
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        step, _opt, sh = make_jitted_train_step(cfg, mesh, params)
+        opt_state = sh["opt_state_value"]
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (mbs, seq + 1), 0, vocab)
+        batch = sh["place_batch"]({
+            "tokens": tok[:, :-1], "labels": tok[:, 1:],
+            "loss_mask": jnp.ones((mbs, seq), jnp.float32)})
+
+        t0 = time.perf_counter()
+        params, opt_state, m = step(params, opt_state, batch, 0)
+        loss0 = float(m["lm loss"])  # forced fetch = the step really ran
+        first_step_s = time.perf_counter() - t0
+        if dev.platform != "cpu":
+            _persist({**base, "phase": "step1", "value": 0.0,
+                      "loss": round(loss0, 4), "n_params": n_params,
+                      "first_step_s_incl_compile": round(first_step_s, 2)})
+
+        def multi(p, o, b):
+            def body(c, it):
+                p, o = c
+                p, o, m = step(p, o, b, it)
+                return (p, o), m["lm loss"]
+            (p, o), losses = jax.lax.scan(body, (p, o), jnp.arange(iters))
+            return p, o, losses
+
+        multi = jax.jit(multi, donate_argnums=(0, 1))
+        params, opt_state, losses = multi(params, opt_state, batch)
+        _ = float(losses[-1])  # compile + warm
+        t0 = time.perf_counter()
+        params, opt_state, losses = multi(params, opt_state, batch)
+        last = float(losses[-1])
+        dt = (time.perf_counter() - t0) / iters
+
+    tok_s = mbs * seq / dt
+    mfu = (flops_per_token(n_params, layers, hidden, seq) * mbs * seq
+           / dt / peak_flops())
+    rec = {**base, "phase": "timed", "value": round(tok_s, 1),
+           "mfu_pct_micro_model": round(mfu * 100, 2),
+           "step_time_s": round(dt, 5), "n_params": n_params,
+           "loss": round(last, 4), "loss_descended": bool(last < loss0)}
+    if dev.platform != "cpu":
+        _persist(rec)
+    return rec
+
+
+def main() -> None:
+    signal.signal(signal.SIGTERM, _flush_and_exit)
+    signal.signal(signal.SIGINT, _flush_and_exit)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--probe_timeout", type=float, default=60.0)
+    ap.add_argument("--watchdog", type=float, default=240.0,
+                    help="clean self-exit long before tpu_watch would "
+                         "consider killing anything mid-step")
+    args = ap.parse_args()
+
+    def on_timeout():
+        # phase records are already on disk; exit cleanly with what we have
+        rec = dict(_current) if _current else {
+            "metric": METRIC, "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0, "error": "watchdog before contact"}
+        rec["watchdog_fired"] = True
+        print(json.dumps(rec), flush=True)
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    try:
+        if probe_backend(args.probe_timeout) == "cpu":
+            from megatron_llm_tpu.utils.platform import pin_cpu_platform
+            pin_cpu_platform()
+        rec = capture(args.iters)
+        dog.cancel()
+        if rec["backend"] == "cpu":
+            print(json.dumps(cpu_contract_line(rec, tag="micro")), flush=True)
+        else:
+            print(json.dumps(rec), flush=True)
+    except Exception as e:
+        dog.cancel()
+        rec = {"metric": METRIC, "value": 0.0, "unit": "tok/s",
+               "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"[:300]}
+        if _current:  # evidence already persisted survives the failure —
+            # and carries the backend, so tpu_watch counts a
+            # confirmed-on-hardware failure as captured (its documented
+            # contract) instead of re-burning every probe window on it
+            rec["last_phase"] = _current.get("phase")
+            rec["backend"] = _current.get("backend")
+        print(json.dumps(rec), flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -77,9 +77,22 @@ def _any_line_on_tpu(out: str) -> bool:
 
 JOBS = [
     # (name, cmd, needs_timeout, tpu_evidence_predicate)
+    #
+    # VERDICT round-4 item 1: job #1 is the ≤60s un-killable micro-capture.
+    # It persists phase records (contact/step1/timed) atomically as it goes,
+    # so a one-shot tunnel window — or a harness timeout killing the queue
+    # mid-job, the round-2 and round-4 failure shape — still leaves a
+    # committed TPU-backend record before the 10-minute bench even starts.
+    ("micro_capture", [sys.executable, "tools/tpu_micro_capture.py"],
+     False, _bench_on_tpu),
     ("bench_stock", [sys.executable, "bench.py"], False, _bench_on_tpu),
     ("kernel_check", [sys.executable, "tools/tpu_kernel_check.py", "--quick"],
      True, _kernel_check_on_tpu),
+    # VERDICT round-4 item 4 promoted the sweep above the decode pair: the
+    # 45% single-chip MFU push is a headline target, decode is secondary.
+    # Any row that lands on TPU counts (mid-sweep drop keeps earlier rows).
+    ("mfu_sweep", [sys.executable, "tools/mfu_sweep.py"],
+     False, _any_line_on_tpu),
     ("bench_32k", [sys.executable, "bench.py", "--seq", "32768",
                    "--rope_scaling", "8", "--mbs", "1", "--iters", "4"],
      False, _bench_on_tpu),
@@ -92,15 +105,12 @@ JOBS = [
     ("decode_bench_int8",
      [sys.executable, "tools/decode_bench.py", "--int8"],
      False, _bench_on_tpu),
-    # VERDICT round-3 item 2: the MFU push sweep (mbs 24/32, chunked CE,
-    # latency-hiding scheduler, rmsnorm micro). Runs LAST: the stock
-    # evidence above is the priority if the window is short.
-    ("mfu_sweep", [sys.executable, "tools/mfu_sweep.py"],
-     False, _any_line_on_tpu),
-    # VERDICT round-3 item 8: the 470M-model language-quality e2e (train +
-    # WIKITEXT ppl) — minutes on TPU, so it rides any window that survived
-    # the sweep; own watchdog, no subprocess timeout
-    ("e2e_470m", [sys.executable, "tools/e2e_470m.py"],
+    # VERDICT round-4 item 8: the 470M language-quality e2e, now a FULL
+    # epoch (~2M tokens = 500 iters at gbs 16) in resume-exercising stages
+    # of 100 iters with a WIKITEXT eval + E2E_470M.json rewrite per stage —
+    # minutes on TPU, and a mid-run drop keeps the completed stages.
+    ("e2e_470m", [sys.executable, "tools/e2e_470m.py",
+                  "--iters", "500", "--stage_iters", "100"],
      False, _bench_on_tpu),
 ]
 
@@ -147,17 +157,44 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
     return captured
 
 
+def _descendants(pid: int) -> list[int]:
+    """pid plus all its live descendants (/proc walk). The background e2e
+    trainer respawns a fresh finetune.py child every resume stage, so the
+    pause protocol must resolve the process TREE at signal time — a static
+    pid list would SIGSTOP the long-lived parent while the actual
+    CPU-burning child keeps running through the capture window."""
+    kids: dict[int, list[int]] = {}
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+            except (OSError, ValueError, IndexError):
+                continue
+            kids.setdefault(ppid, []).append(int(entry))
+    except OSError:
+        return [pid]
+    out, frontier = [], [pid]
+    while frontier:
+        p = frontier.pop()
+        out.append(p)
+        frontier.extend(kids.get(p, []))
+    return out
+
+
 def _signal_pause_pids(sig, pids=None) -> list[int]:
-    """Send ``sig`` to ``pids`` (default: every pid in MLT_PAUSE_PIDS);
-    returns the pids actually signalled. Single source for the pause
-    protocol — used by run_job (STOP/CONT around capture jobs) and the
-    signal handler (CONT on the way out)."""
+    """Send ``sig`` to ``pids`` (default: every pid in MLT_PAUSE_PIDS plus
+    its live descendants); returns the pids actually signalled. Single
+    source for the pause protocol — used by run_job (STOP/CONT around
+    capture jobs) and the signal handler (CONT on the way out)."""
     if pids is None:
         pids = []
         for pid_s in filter(None, os.environ.get(
                 "MLT_PAUSE_PIDS", "").split(",")):
             try:
-                pids.append(int(pid_s))
+                pids.extend(_descendants(int(pid_s)))
             except ValueError:
                 pass
     hit = []
