@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -95,7 +96,18 @@ def build_mesh(
         assert need <= n, f"dp*ep*pp*cp*tp = {need} > device count {n}"
     devices = list(devices)[:need]
     dev_array = np.asarray(devices).reshape(dp, ep, pp, cp, tp)
-    return Mesh(dev_array, (DP_AXIS, EP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS))
+    names = [DP_AXIS, EP_AXIS, PP_AXIS, CP_AXIS, TP_AXIS]
+    order = os.environ.get("MLT_MESH_ORDER")
+    if order:
+        # Experimental logical-axis reorder (tools/flash_nested_repro.py):
+        # a pure transpose — every axis keeps EXACTLY the same device
+        # groups, only the Mesh tuple order (and hence GSPMD's device
+        # enumeration) changes.
+        perm = [n.strip() for n in order.split(",")]
+        assert sorted(perm) == sorted(names), (perm, names)
+        dev_array = dev_array.transpose([names.index(n) for n in perm])
+        names = perm
+    return Mesh(dev_array, tuple(names))
 
 
 def build_mesh_from_config(cfg, devices=None) -> Mesh:

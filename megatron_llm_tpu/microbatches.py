@@ -40,9 +40,9 @@ class ConstantNumMicroBatches(NumMicroBatchesCalculator):
         super().__init__()
         micro_batch_times_dp = micro_batch_size * data_parallel_size
         assert global_batch_size % micro_batch_times_dp == 0, (
-            f"global batch size ({global_batch_size}) is not divisible by "
-            f"micro batch size ({micro_batch_size}) times data parallel size "
-            f"({data_parallel_size})"
+            f"gbs {global_batch_size} must split into whole microbatches: "
+            f"mbs {micro_batch_size} x dp {data_parallel_size} = "
+            f"{micro_batch_times_dp} does not divide it"
         )
         self.num_micro_batches = global_batch_size // micro_batch_times_dp
         assert self.num_micro_batches >= 1
@@ -58,7 +58,7 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
     """
 
     def __init__(self, start_batch_size: int, batch_size_increment: int,
-                 ramup_samples: int, global_batch_size: int,
+                 rampup_samples: int, global_batch_size: int,
                  micro_batch_size: int, data_parallel_size: int):
         super().__init__()
         self.micro_batch_size = micro_batch_size
@@ -72,22 +72,23 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
         assert global_batch_size >= start_batch_size
         self.start_batch_size = start_batch_size
         self.batch_size_increment = batch_size_increment
-        self.ramup_samples = ramup_samples
+        self.rampup_samples = rampup_samples
         self.global_batch_size = global_batch_size
 
         diff = global_batch_size - start_batch_size
         assert diff % batch_size_increment == 0, (
-            f"global batch ({global_batch_size}) - start ({start_batch_size}) "
-            f"not divisible by increment ({batch_size_increment})"
+            f"ramp span {diff} (= gbs {global_batch_size} - start "
+            f"{start_batch_size}) must be a whole number of "
+            f"{batch_size_increment}-sample increments"
         )
         num_increments = diff // batch_size_increment
         self.rampup_samples_per_increment = (
-            ramup_samples / num_increments if num_increments > 0 else 0
+            rampup_samples / num_increments if num_increments > 0 else 0
         )
         self.update(0, False)
 
     def update(self, consumed_samples: int, consistency_check: bool = True):
-        if consumed_samples > self.ramup_samples or (
+        if consumed_samples > self.rampup_samples or (
             self.rampup_samples_per_increment == 0
         ):
             bs = self.global_batch_size

@@ -11,6 +11,7 @@ inserts the all-reduces the reference issues by hand (layers.py:187-210).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -98,19 +99,87 @@ def make_rope_cache(cfg) -> Optional[Tuple[jax.Array, jax.Array]]:
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _take_rows_matmul_bwd(rows: int, chunk: int, table_dtype: str):
+    """``take(table, ids, axis=0)`` whose BACKWARD is a one-hot matmul
+    (``dtable = one_hot(ids).T @ g``, token-chunked) instead of the take
+    transpose's scatter-add.
+
+    Two TPU reasons: (1) scatter is the one op class the MXU cannot touch;
+    (2) XLA's scatter *partitioner* CHECK-crashes
+    (spmd_partitioner_util.cc:506, ExpandDeviceGroupsWithIota) when this
+    scatter-add sits inside the 1F1B tick loop under the pipeline's
+    partial-manual shard_map with a nested-manual flash region and
+    dp-sharded ZeRO-1 state — the round-4 "pp x dp>1 x tp>1 flash
+    fallback" root cause (tools/flash_nested_repro.py). The forward is the
+    unchanged gather; only the vjp differs (same additive semantics,
+    accumulated in the cotangent dtype like the scatter it replaces).
+    """
+    import numpy as np
+
+    @jax.custom_vjp
+    def take(table, ids):
+        return jnp.take(table, ids, axis=0)
+
+    def fwd(table, ids):
+        return jnp.take(table, ids, axis=0), ids
+
+    def bwd(res, g):
+        ids, tdt = res, jnp.dtype(table_dtype)
+        h = g.shape[-1]
+        n = int(np.prod(ids.shape))
+        gf = g.reshape(n, h)
+        idf = ids.reshape(n)
+        # largest divisor of n that fits the chunk budget — requiring exact
+        # divisibility by 4096 would silently fall back to one unbounded
+        # [n, rows] one-hot for e.g. n=6144 (the transient this bounds)
+        c = next((d for d in range(min(chunk, n), 0, -1) if n % d == 0), n)
+        if c < n:
+            # bound the [n, rows] one-hot transient (1 GiB at n=4096,
+            # vocab 128k, bf16) by accumulating over token chunks
+            def body(acc, xs):
+                i_c, g_c = xs
+                oh = jax.nn.one_hot(i_c, rows, dtype=g_c.dtype)
+                return acc + jnp.matmul(
+                    oh.T, g_c, preferred_element_type=acc.dtype), None
+
+            acc0 = jnp.zeros((rows, h), g.dtype)
+            dtable, _ = jax.lax.scan(
+                body, acc0,
+                (idf.reshape(n // c, c), gf.reshape(n // c, c, h)))
+        else:
+            oh = jax.nn.one_hot(idf, rows, dtype=gf.dtype)
+            dtable = jnp.matmul(oh.T, gf, preferred_element_type=gf.dtype)
+        return dtable.astype(tdt), np.zeros(ids.shape, jax.dtypes.float0)
+
+    take.defvjp(fwd, bwd)
+    return take
+
+
+def _embed_take(cfg, table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Embedding-table row lookup; under pipeline parallelism the gradient
+    is the matmul form (see :func:`_take_rows_matmul_bwd` — the scatter-add
+    would sit inside the pp shard_map's tick loop)."""
+    if cfg.parallel.pipeline_model_parallel_size > 1:
+        return _take_rows_matmul_bwd(
+            table.shape[0], 4096, str(table.dtype))(table, ids)
+    return jnp.take(table, ids, axis=0)
+
+
 def embed_tokens(
     cfg, params: Params, tokens: jax.Array,
     position_ids: Optional[jax.Array] = None,
     tokentype_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     emb = params["embedding"]["word_embeddings"]
-    hidden = jnp.take(emb, tokens, axis=0)
+    hidden = _embed_take(cfg, emb, tokens)
     if cfg.model.position_embedding_type == "absolute":
         pos = position_ids if position_ids is not None else jnp.arange(tokens.shape[1])[None]
-        hidden = hidden + jnp.take(params["embedding"]["position_embeddings"], pos, axis=0)
+        hidden = hidden + _embed_take(
+            cfg, params["embedding"]["position_embeddings"], pos)
     if tokentype_ids is not None:
-        hidden = hidden + jnp.take(
-            params["embedding"]["tokentype_embeddings"], tokentype_ids, axis=0
+        hidden = hidden + _embed_take(
+            cfg, params["embedding"]["tokentype_embeddings"], tokentype_ids
         )
     return hidden.astype(_compute_dtype(cfg))
 

@@ -36,32 +36,6 @@ def _flash_available() -> bool:
         return False
 
 
-def _nested_manual_dp_and_tp() -> bool:
-    """True when flash would need a nested shard_map over BOTH dp and tp
-    inside an enclosing manual (pipeline) context — a combination that hits
-    an XLA SPMD-partitioner CHECK crash (spmd_partitioner_util.cc:506:
-    partition_group_list counts; reproduced by tools/aot_scale_check.py's
-    70B tp8 x pp8 x dp4 config and minimized to dp2 x pp2 x tp2). The
-    dispatcher falls back to xla_attention for exactly this combination:
-    pp x dp x tp configs run at moderate seq (bias fits), and long-seq
-    configs add cp>1 which routes to ring attention before this check.
-    Revisit when the XLA bug is fixed."""
-    import jax.sharding as jsh
-
-    from megatron_llm_tpu.core import parallel_state as ps
-
-    abstract = jsh.get_abstract_mesh()
-    if abstract is None or abstract.empty or not abstract.manual_axes:
-        return False
-    if not ps.mesh_is_initialized():
-        return False
-    shape = ps.get_global_mesh().shape
-    dp = 1
-    for ax in ps.DATA_AXES:
-        dp *= shape.get(ax, 1)
-    return dp > 1 and shape.get(ps.TP_AXIS, 1) > 1
-
-
 def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
                    block_kv, causal=True):
     """Run the Pallas kernel, wrapped in shard_map when a non-trivial mesh is
@@ -246,7 +220,14 @@ def attention(
         and sq >= 128
         and q.shape[-1] in (64, 128, 256)
         and _flash_available()
-        and not _nested_manual_dp_and_tp()
+        # Round-4 note: pp x dp>1 x tp>1 used to fall back to xla_attention
+        # here — an XLA scatter-partitioner CHECK crash that turned out to
+        # be the EMBEDDING-grad scatter-add inside the pipeline tick loop,
+        # not the nested flash shard_map itself. Fixed at the root by the
+        # matmul-backward embedding under pp
+        # (models/language_model.py:_take_rows_matmul_bwd,
+        # tools/flash_nested_repro.py) — flash now dispatches at every
+        # sharding incl. the tp8 x pp8 x dp4 north star.
     )
     if flash_ok:
         return _flash_sharded(

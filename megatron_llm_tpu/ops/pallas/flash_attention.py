@@ -456,18 +456,37 @@ def _flash_bwd(scale, causal, sliding_window, block_q, block_kv, interpret,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _env_block(var: str, seq: int) -> Optional[int]:
+def _env_block(var: str, seq: int, cap: int = 1024) -> Optional[int]:
     """Sweep-only block-size override (tools/mfu_sweep.py retune rows).
 
-    Ignored unless it evenly divides ``seq`` — an override tuned for the
-    bench shape must not break other call sites (e.g. a decode step with a
-    different KV length) in the same process.
+    Ignored (with a one-line note — the override is process-wide, so a
+    silently dropped value would make a sweep row measure the default)
+    unless it
+      * evenly divides ``seq`` — an override tuned for the bench shape must
+        not break other call sites (e.g. a decode step with a different KV
+        length) in the same process;
+      * is a multiple of the minimum TPU tile (128 lanes; ADVICE r4 #2 — a
+        non-tile value passes divisibility at some seqs and then dies as an
+        opaque Mosaic compile error mid-sweep);
+      * respects the same VMEM cap as :func:`_auto_block` (1024, or 512 at
+        head_dim 256 — the caller passes the cap it would auto-pick under).
     """
     v = os.environ.get(var)
     if not v:
         return None
     blk = int(v)
-    return blk if 0 < blk <= seq and seq % blk == 0 else None
+    if blk % 128 != 0 or blk > cap or blk <= 0:
+        # intrinsically invalid value: warn — silently measuring the
+        # default mid-sweep is worse than the noise
+        print(f"[flash_attention] ignoring {var}={blk} "
+              f"(must be a positive multiple of 128 and <= VMEM cap {cap})",
+              flush=True)
+        return None
+    if not (blk <= seq and seq % blk == 0):
+        # by-design silent skip: an override tuned for the bench shape must
+        # not break (or spam) other-seq call sites in the same process
+        return None
+    return blk
 
 
 def _auto_block(seq: int, cap: int = 1024) -> int:
@@ -505,13 +524,14 @@ def flash_attention(
     b, sq, n, d = q.shape
     cap = 1024 if d <= 128 else 512  # VMEM, see _auto_block
     if block_q is None:
-        block_q = _env_block("MLT_FLASH_BLOCK_Q", sq) or _auto_block(sq, cap)
+        block_q = (_env_block("MLT_FLASH_BLOCK_Q", sq, cap)
+                   or _auto_block(sq, cap))
     if block_kv is None:
         # measured (v5e, seq 8192, window 256): large KV blocks win even for
         # small sliding windows — grid-iteration overhead outweighs the
         # masked compute whole-tile pruning would save (1024x1024 98 ms vs
         # 512x512 109 ms vs 512x256 134 ms) — so no window-based cap
-        block_kv = (_env_block("MLT_FLASH_BLOCK_KV", k.shape[1])
+        block_kv = (_env_block("MLT_FLASH_BLOCK_KV", k.shape[1], cap)
                     or _auto_block(k.shape[1], cap))
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:

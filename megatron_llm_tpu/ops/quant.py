@@ -26,12 +26,16 @@ import jax
 import jax.numpy as jnp
 
 
-def _contraction_axis(kernel) -> int:
+def _contraction_axis(kernel, name: str = "") -> int:
     """The contraction (input) axis: -2 for plain kernels ([..., in, out],
     incl. a stacked leading layer axis), -3 for GLU fc1 kernels
     ([..., in, 2, ffn] — the chunk axis of size 2 sits between in and ffn,
-    see init_layer_params). Single source for quantize + error bound."""
-    return -3 if (kernel.ndim >= 3 and kernel.shape[-2] == 2) else -2
+    see init_layer_params). The GLU case is keyed on the param PATH (only
+    ``fc1`` kernels carry the chunk axis) AND the shape — a bare shape
+    sniff would mis-route any non-GLU stacked kernel whose penultimate dim
+    happens to be 2 (ADVICE r4 #1)."""
+    is_glu_fc1 = name == "fc1" and kernel.ndim >= 3 and kernel.shape[-2] == 2
+    return -3 if is_glu_fc1 else -2
 
 
 def _channel_scale(kernel: jax.Array, axis: int) -> jax.Array:
@@ -50,9 +54,9 @@ def _quant_jit(kernel: jax.Array, axis: int):
     return q, scale
 
 
-def _quantize_kernel(kernel: jax.Array) -> dict:
+def _quantize_kernel(kernel: jax.Array, name: str = "") -> dict:
     """Per-output-channel symmetric int8 (see :func:`_contraction_axis`)."""
-    q, scale = _quant_jit(kernel, _contraction_axis(kernel))
+    q, scale = _quant_jit(kernel, _contraction_axis(kernel, name))
     return {"kernel_q": q, "kernel_scale": scale}
 
 
@@ -65,17 +69,17 @@ def quantize_layer_weights_int8(params: dict) -> dict:
     (and ``cfg.model.fp8``) expects the original ``kernel`` leaves.
     """
 
-    def walk(node):
+    def walk(node, name=""):
         if isinstance(node, dict):
             if "kernel" in node and getattr(node["kernel"], "ndim", 0) >= 2:
                 out = {k: v for k, v in node.items() if k != "kernel"}
-                out.update(_quantize_kernel(node["kernel"]))
+                out.update(_quantize_kernel(node["kernel"], name))
                 return out
             # MoE: expert FFN stacks quantize (their [E,...] kernels carry
             # per-expert channel scales; models/moe.py:_expert_kernel
             # consumes them); the router stays fp32 — routing logits are
             # precision-sensitive and the [h, E] kernel is negligible HBM
-            return {k: (v if k == "router" else walk(v))
+            return {k: (v if k == "router" else walk(v, k))
                     for k, v in node.items()}
         return node
 
@@ -94,8 +98,8 @@ def resolve_kernel(p_lin: dict, dt) -> tuple:
     return p_lin["kernel"].astype(dt), None
 
 
-def int8_quant_error_bound(kernel: jax.Array) -> float:
+def int8_quant_error_bound(kernel: jax.Array, name: str = "") -> float:
     """Max absolute dequantization error = scale/2 per channel (useful in
     tests: |w - q*scale| <= absmax/254 + eps)."""
-    scale = _channel_scale(kernel, _contraction_axis(kernel))
+    scale = _channel_scale(kernel, _contraction_axis(kernel, name))
     return float(jnp.max(scale) / 2.0)

@@ -182,11 +182,20 @@ def scanned_adam(cfg, params: Any) -> optax.GradientTransformation:
         def leaf(g, mu, nu, p, decay):
             if (p.ndim >= 2 and 1 < p.shape[0] <= _SCAN_UPDATE_MAX_LEADING
                     and p.size >= _SCAN_UPDATE_MIN_ELEMENTS):
+                # explicit dynamic_update_slice (.at[i].set with a scalar
+                # index lowers to the same DUS; spelled out so the
+                # in-place-alias + robust-SPMD-partitioning intent is
+                # guaranteed, not an implementation detail of jnp indexing
+                # — scatters are the one op class whose partitioner can
+                # CHECK-crash under partial-manual meshes, see
+                # models/language_model.py:_take_rows_matmul_bwd)
+                dus = jax.lax.dynamic_update_index_in_dim
+
                 def body(i, carry):
                     mu, nu, p = carry
                     mu_i, nu_i, u_i = one(g[i], mu[i], nu[i], p[i], decay)
-                    return (mu.at[i].set(mu_i), nu.at[i].set(nu_i),
-                            p.at[i].set(p[i] + u_i))
+                    return (dus(mu, mu_i, i, 0), dus(nu, nu_i, i, 0),
+                            dus(p, p[i] + u_i, i, 0))
 
                 return jax.lax.fori_loop(0, p.shape[0], body, (mu, nu, p))
             mu2, nu2, u = one(g, mu, nu, p, decay)

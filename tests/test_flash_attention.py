@@ -175,22 +175,30 @@ def test_grads_bidirectional_segment_ids():
 
 def test_env_block_override(monkeypatch):
     """MLT_FLASH_BLOCK_Q/KV (tools/mfu_sweep.py retune rows): applied when
-    it divides the call's seq, silently ignored otherwise, numerics
+    it divides the call's seq, is a 128-lane-tile multiple, and respects
+    the VMEM cap (ADVICE r4 #2); ignored with a note otherwise; numerics
     unchanged either way."""
     from megatron_llm_tpu.ops.pallas import flash_attention as fa
 
-    q, k, v = _rand_qkv(jax.random.PRNGKey(9), s=128, d=64)
+    q, k, v = _rand_qkv(jax.random.PRNGKey(9), s=256, d=64)
     base = flash_attention(q, k, v, interpret=True)
 
-    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "64")
-    monkeypatch.setenv("MLT_FLASH_BLOCK_KV", "32")
-    assert fa._env_block("MLT_FLASH_BLOCK_Q", 128) == 64
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "128")
+    monkeypatch.setenv("MLT_FLASH_BLOCK_KV", "128")
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 256) == 128
     out = flash_attention(q, k, v, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                atol=2e-5, rtol=2e-5)
 
-    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "100")  # does not divide 128
-    assert fa._env_block("MLT_FLASH_BLOCK_Q", 128) is None
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "100")  # does not divide 256
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 256) is None
+    # ADVICE r4 #2: a divisor that is NOT a 128-multiple (passes the old
+    # check, dies as an opaque Mosaic/VMEM error later) is now rejected...
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "64")
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 256) is None
+    # ...as is one above the VMEM cap the caller would auto-pick under
+    monkeypatch.setenv("MLT_FLASH_BLOCK_Q", "1024")
+    assert fa._env_block("MLT_FLASH_BLOCK_Q", 2048, cap=512) is None
     out2 = flash_attention(q, k, v, interpret=True)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(base),
                                atol=2e-5, rtol=2e-5)

@@ -1,0 +1,153 @@
+"""Flash-attention numerics UNDER SHARDED MESHES (round-4 VERDICT item 3).
+
+Round 4 certified the shard_map compositions by AOT *compile* only; these
+tests run the Pallas kernel (interpret mode on the virtual CPU mesh — the
+same kernel code paths, minus Mosaic codegen) through the real
+``_flash_sharded`` dispatch wrappers and compare against ``xla_attention``:
+
+  * dp x tp pjit path (the single shard_map over batch/heads)
+  * nested-manual composition: enclosing {pp, cp}-manual shard_map (the
+    pipeline engine's context) with the inner flash shard_map over
+    dp/ep/tp — the exact structure of the (round-5 fixed) tp8 x pp8 x dp4
+    north-star layout, including the backward kernels
+  * GQA + causal + segment-ids variants on the sharded paths
+
+A mis-sharded composition shows up as a numeric mismatch here (each shard
+would compute attention over the wrong slice), not a compile error.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.ops.attention import _flash_sharded, xla_attention
+from megatron_llm_tpu.ops.attention import make_attention_bias
+
+
+def _qkv(key, b=4, s=256, n=4, nkv=None, d=64, dtype=jnp.float32):
+    nkv = nkv or n
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n, d), dtype) * 0.3
+    k = jax.random.normal(kk, (b, s, nkv, d), dtype) * 0.3
+    v = jax.random.normal(kv, (b, s, nkv, d), dtype) * 0.3
+    return q, k, v
+
+
+def _ref(q, k, v, causal=True, segment_ids=None, sliding_window=None):
+    bias = make_attention_bias(
+        q.shape[1], k.shape[1], causal=causal, sliding_window=sliding_window,
+        segment_ids_q=segment_ids, segment_ids_kv=segment_ids)
+    return xla_attention(q, k, v, bias=bias, scale=1.0 / (q.shape[-1] ** 0.5))
+
+
+@pytest.mark.parametrize("nkv,segmented", [(4, False), (2, False), (2, True)])
+def test_flash_dp_tp_pjit_parity(eight_devices, nkv, segmented):
+    """dp2 x tp2 pjit path, fwd + grads vs XLA attention."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=2, data_parallel_size=2,
+                         devices=eight_devices[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(0), nkv=nkv)
+    seg = None
+    if segmented:
+        seg = jnp.concatenate([jnp.zeros((4, 128), jnp.int32),
+                               jnp.ones((4, 128), jnp.int32)], axis=1)
+
+    with ps.global_mesh(mesh), mesh:
+        qs = NamedSharding(mesh, P(("dp", "ep"), None, "tp", None))
+        qp = jax.device_put(q, qs)
+        kp = jax.device_put(k, NamedSharding(
+            mesh, P(("dp", "ep"), None, None, None)))
+        vp = jax.device_put(v, NamedSharding(
+            mesh, P(("dp", "ep"), None, None, None)))
+
+        def loss(q_, k_, v_):
+            o = _flash_sharded(q_, k_, v_, seg, 1.0 / 8.0, None, 128, 128)
+            return (o.astype(jnp.float32) ** 2).sum(), o
+
+        (val, out), grads = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)
+        )(qp, kp, vp)
+
+    def ref_loss(q_, k_, v_):
+        o = _ref(q_, k_, v_, segment_ids=seg)
+        return (o.astype(jnp.float32) ** 2).sum(), o
+
+    (rval, rout), rgrads = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(float(val), float(rval), rtol=1e-5)
+    for g, rg in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_flash_nested_manual_parity(eight_devices):
+    """The pipeline composition: enclosing {pp, cp}-manual shard_map, inner
+    flash shard_map over dp/ep/tp — dp2 x pp2 x tp2, the minimized
+    north-star structure. Every pp shard sees the same (replicated)
+    microbatch here, so the output must equal the unsharded reference; a
+    wrong nested in_spec would feed each shard the wrong q/k/v slice."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=2,
+                         pipeline_model_parallel_size=2,
+                         data_parallel_size=2, devices=eight_devices)
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=4, s=256, n=4, nkv=2)
+
+    with ps.global_mesh(mesh), mesh:
+        def body(q_, k_, v_):
+            o = _flash_sharded(q_, k_, v_, None, 1.0 / 8.0, None, 128, 128)
+            # touch pp like the tick loop does (identity ppermute keeps
+            # values comparable to the reference)
+            perm = [(i, i) for i in range(2)]
+            return jax.lax.ppermute(o, ps.PP_AXIS, perm)
+
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            axis_names={ps.PP_AXIS, ps.CP_AXIS}, check_vma=False)
+
+        def loss(q_, k_, v_):
+            o = fn(q_, k_, v_)
+            return (o.astype(jnp.float32) ** 2).sum(), o
+
+        (val, out), grads = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)
+        )(q, k, v)
+
+    rout = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout),
+                               atol=2e-5, rtol=2e-5)
+
+    def ref_loss(q_, k_, v_):
+        o = _ref(q_, k_, v_)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    rgrads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, rg in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_flash_nested_manual_sliding_window(eight_devices):
+    """Sliding-window masking survives the nested composition (Mistral
+    family at the pipelined layouts)."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=2,
+                         pipeline_model_parallel_size=2,
+                         data_parallel_size=2, devices=eight_devices)
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=2, s=256, n=4, nkv=4)
+
+    with ps.global_mesh(mesh), mesh:
+        fn = jax.shard_map(
+            lambda q_, k_, v_: _flash_sharded(
+                q_, k_, v_, None, 1.0 / 8.0, 64, 128, 128),
+            mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            axis_names={ps.PP_AXIS, ps.CP_AXIS}, check_vma=False)
+        out = jax.jit(fn)(q, k, v)
+
+    rout = _ref(q, k, v, sliding_window=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout),
+                               atol=2e-5, rtol=2e-5)

@@ -45,16 +45,25 @@ def test_dequant_error_bound():
 
 
 def test_dequant_glu_and_stacked_axes():
-    # GLU fc1 [in, 2, ffn]: contraction axis -3; stacked [L, in, out]: -2
+    # GLU fc1 [in, 2, ffn]: contraction axis -3 (keyed on the param path,
+    # not shape alone — ADVICE r4 #1); stacked [L, in, out]: -2
     k_glu = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 24))
-    q = _quantize_kernel(k_glu)
+    q = _quantize_kernel(k_glu, "fc1")
     assert q["kernel_scale"].shape == (2, 24)
     deq = q["kernel_q"].astype(jnp.float32) * q["kernel_scale"][None]
-    assert float(jnp.max(jnp.abs(deq - k_glu))) <= int8_quant_error_bound(k_glu) + 1e-7
+    assert float(jnp.max(jnp.abs(deq - k_glu))) <= (
+        int8_quant_error_bound(k_glu, "fc1") + 1e-7)
 
     k_st = jax.random.normal(jax.random.PRNGKey(2), (3, 16, 24))
     qs = _quantize_kernel(k_st)
     assert qs["kernel_scale"].shape == (3, 24)
+
+    # a NON-fc1 stacked kernel whose penultimate dim happens to be 2 must
+    # quantize along -2 like any plain kernel (the old shape sniff would
+    # silently pick -3)
+    k_trap = jax.random.normal(jax.random.PRNGKey(3), (3, 16, 2))
+    qt = _quantize_kernel(k_trap, "out_proj")
+    assert qt["kernel_scale"].shape == (3, 2)
 
 
 def test_logits_close_and_structure():
