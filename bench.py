@@ -269,6 +269,10 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
     from megatron_llm_tpu.models import init_model_params, make_config
     from megatron_llm_tpu.training_step import make_jitted_train_step
 
+    from megatron_llm_tpu.utils.platform import enable_tpu_compilation_cache
+
+    enable_tpu_compilation_cache()
+
     layers, hidden, heads, kv, ffn, vocab = 24, 1024, 16, 16, 4096, 32000
     on_cpu = jax.default_backend() == "cpu"
     if on_cpu:

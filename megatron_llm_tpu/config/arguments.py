@@ -56,6 +56,9 @@ class ModelConfig:
     # plain activation when glu_activation is None: 'gelu' | 'relu' | 'squared_relu'
     activation: str = "gelu"
     use_bias: bool = False  # reference --no_bias inverted
+    # Qwen2-style: bias on the fused QKV projection ONLY (dense/mlp stay
+    # bias-free); independent of use_bias (beyond-reference family)
+    add_qkv_bias: bool = False
     # Falcon-style: attention and MLP computed in parallel from the same LN.
     parallel_attn: bool = False
     # Falcon-40B style: separate LN for the parallel MLP branch.
@@ -581,6 +584,19 @@ ARCH_DEFAULTS = {
         moe_router_topk=2,
         rope_theta=1_000_000.0,
     ),
+    # Qwen2/2.5 (beyond-reference): llama2 block + bias on the QKV
+    # projection only + rope_theta 1e6; small checkpoints (<=1.5B) tie
+    # embeddings, which config_from_hf passes through
+    "qwen2": dict(
+        use_rms_norm=True,
+        glu_activation="swiglu",
+        use_bias=False,
+        add_qkv_bias=True,
+        tie_embed_logits=False,
+        position_embedding_type="rotary",
+        layernorm_epsilon=1e-6,
+        rope_theta=1_000_000.0,
+    ),
 }
 
 # Canonical model sizes (hidden/layers/heads/kv-heads/ffn) for convenience.
@@ -699,8 +715,8 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     )
     parser.add_argument("--model_name", type=str, default=None,
                         help="gpt|llama|llama2|codellama|llama3|falcon|"
-                             "mistral|mixtral|bert|t5 or a canonical size "
-                             "like llama2-7b / llama3-8b")
+                             "mistral|mixtral|qwen2|bert|t5 or a canonical "
+                             "size like llama2-7b / llama3-8b")
     seen = set()
     for group_name, group_cls in _GROUPS.items():
         group = parser.add_argument_group(group_name)

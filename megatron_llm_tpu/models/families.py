@@ -44,6 +44,14 @@ def validate_family(cfg: Config) -> Config:
         _check(m.use_rms_norm and m.glu_activation == "swiglu",
                "mixtral uses the llama block")
         _check(not m.use_bias, "mixtral has no biases")
+    elif name == "qwen2":
+        # beyond-reference: llama block + QKV-only bias
+        _check(m.position_embedding_type == "rotary",
+               "qwen2 requires rotary embeddings")
+        _check(m.use_rms_norm and m.glu_activation == "swiglu",
+               "qwen2 uses the llama block")
+        _check(not m.use_bias, "qwen2 has no global biases")
+        _check(m.add_qkv_bias, "qwen2 requires add_qkv_bias")
     return cfg
 
 

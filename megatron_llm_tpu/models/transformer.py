@@ -108,8 +108,10 @@ def init_layer_params(cfg, key: jax.Array, cross_attention: bool = False) -> Par
             p["cross_attention"]["q"]["bias"] = jnp.zeros((n * d,), jnp.float32)
             p["cross_attention"]["kv"]["bias"] = jnp.zeros((2 * nkv * d,), jnp.float32)
             p["cross_attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
-    if m.use_bias:
+    if m.use_bias or m.add_qkv_bias:
+        # add_qkv_bias: Qwen2-style QKV-only bias (dense/mlp stay bias-free)
         p["attention"]["qkv"]["bias"] = jnp.zeros(((n + 2 * nkv) * d,), jnp.float32)
+    if m.use_bias:
         p["attention"]["dense"]["bias"] = jnp.zeros((h,), jnp.float32)
         if "mlp" in p:
             p["mlp"]["fc1"]["bias"] = jnp.zeros((2, ffn) if glu else (ffn,), jnp.float32)

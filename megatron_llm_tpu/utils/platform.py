@@ -37,3 +37,19 @@ def pin_cpu_platform(n_devices: int | None = None) -> None:
         raise RuntimeError(
             "pin_cpu_platform called after a non-CPU jax backend was "
             f"initialized ({jax.default_backend()}); pin before any jax use")
+
+
+def enable_tpu_compilation_cache(path: str = "/tmp/jax_cache") -> None:
+    """Persistent compilation cache for TPU runs (bench.py,
+    tools/tpu_micro_capture.py): a retried tunnel window should not pay the
+    20-40s compile twice. CPU is excluded deliberately — XLA:CPU AOT cache
+    entries carry machine-feature lists that mis-load across toolchain
+    updates (SIGILL risk, observed round 5)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        pass  # cache is an optimization, never a failure

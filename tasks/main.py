@@ -105,6 +105,18 @@ def run_zeroshot(cfg, extra):
     tokenizer = build_tokenizer(cfg)
     mesh, params = _load_params_for_eval(cfg)
     with global_mesh(mesh):
+        if cfg.inference.int8_weights:
+            # weight-only int8 zeroshot eval (ops/quant.py): the e2e
+            # quality gate for the decode-path quantization —
+            # `--int8_weights` on the same checkpoint measures the ppl
+            # delta vs the full-precision run (round-4 VERDICT item 5)
+            if cfg.model.fp8:
+                raise ValueError(  # same guard as generation/api.py
+                    "--int8_weights and fp8 are mutually exclusive: the "
+                    "fp8 GEMM path reads the unquantized kernel leaves")
+            from megatron_llm_tpu.ops.quant import quantize_layer_weights_int8
+
+            params = quantize_layer_weights_int8(params)
         if extra.task == "WIKITEXT103":
             with open(extra.valid_data) as f:
                 text = f.read()

@@ -196,6 +196,34 @@ def test_micro_capture_first_and_unbounded():
     assert bounded is False and pred is _bench_on_tpu
 
 
+def test_watch_evidence_autocommit(tmp_path, monkeypatch):
+    """A captured job's evidence files are git-committed immediately — a
+    one-shot tunnel window must not depend on the builder noticing before
+    the round (or the session) ends."""
+    import subprocess
+
+    from tools import tpu_watch as tw
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "-C", str(repo), "config", "user.email", "t@t"],
+                   check=True)
+    subprocess.run(["git", "-C", str(repo), "config", "user.name", "t"],
+                   check=True)
+    (repo / "BENCH_LAST_TPU_micro.json").write_text('{"backend": "tpu"}\n')
+    monkeypatch.setattr(tw, "REPO", str(repo))
+    tw._commit_evidence("micro_capture")
+    log = subprocess.run(["git", "-C", str(repo), "log", "--oneline"],
+                         capture_output=True, text=True).stdout
+    assert "micro_capture evidence captured" in log
+    # idempotent: nothing staged -> no second commit, no error
+    tw._commit_evidence("micro_capture")
+    log2 = subprocess.run(["git", "-C", str(repo), "log", "--oneline"],
+                          capture_output=True, text=True).stdout
+    assert log2.count("evidence captured") == 1
+
+
 def test_pause_protocol_resolves_descendants():
     """MLT_PAUSE_PIDS entries expand to the live process tree at signal
     time (the e2e trainer respawns its compute child every resume stage)."""

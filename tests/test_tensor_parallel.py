@@ -65,6 +65,33 @@ def test_tp_forward_matches_single_device(eight_devices, tp, sp):
     )
 
 
+def test_tp_forward_qwen2_qkv_bias(eight_devices):
+    """Qwen2's QKV-only bias under tensor parallelism: the fused bias
+    shards column-parallel with the kernel (parallel/tp.py qkv bias rule);
+    a wrong spec would offset the wrong heads' logits."""
+    base = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                num_attention_heads_kv=2, vocab_size=256, seq_length=32,
+                max_position_embeddings=64, params_dtype="float32",
+                use_flash_attn=False)
+    cfg1 = make_config("qwen2", **base)
+    params = init_model_params(cfg1, jax.random.PRNGKey(0))
+    # non-zero bias so a mis-sharded bias actually changes the logits
+    qkv = params["layers"]["attention"]["qkv"]
+    qkv["bias"] = jax.random.normal(
+        jax.random.PRNGKey(7), qkv["bias"].shape, qkv["bias"].dtype) * 0.1
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    ref_logits, _ = model_forward(cfg1, params, tokens)
+
+    cfgN = make_config("qwen2", **base, tensor_model_parallel_size=2)
+    mesh = build_mesh(tensor_model_parallel_size=2, devices=eight_devices[:2])
+    with mesh:
+        sharded = jax.device_put(params, param_shardings(mesh, params))
+        tp_logits, _ = jax.jit(
+            lambda p, t: model_forward(cfgN, p, t))(sharded, tokens)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(tp_logits), atol=2e-4, rtol=2e-4)
+
+
 def test_train_step_tp_dp_matches_single(eight_devices):
     """One full train step on tp=2 x dp=4 must match single-device numerics."""
     tok = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256)

@@ -51,6 +51,16 @@ CANDIDATES = [
     ("mbs12_sel_attn_ce8",
      ["--mbs", "12", "--recompute", "selective",
       "--policy", "save_dots_and_attn", "--ce_chunks", "8"], {}),
+    # save_attn_only: near-full-remat memory (only the flash outputs kept)
+    # with the backward spared the whole kernel re-run — the policy the
+    # round-2 outage cut from the sweep (PERF.md measurement record note);
+    # should fit larger mbs than save_dots_and_attn
+    ("mbs16_attnonly_ce8",
+     ["--mbs", "16", "--recompute", "selective",
+      "--policy", "save_attn_only", "--ce_chunks", "8"], {}),
+    ("mbs24_attnonly_ce8",
+     ["--mbs", "24", "--recompute", "selective",
+      "--policy", "save_attn_only", "--ce_chunks", "8"], {}),
     ("mbs24_full_ce8", ["--mbs", "24", "--ce_chunks", "8"], {}),
     ("mbs16_full_lhs",
      [], {"XLA_FLAGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),

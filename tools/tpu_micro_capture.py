@@ -95,13 +95,9 @@ def capture(iters: int) -> dict:
     import jax
     import jax.numpy as jnp
 
-    if jax.default_backend() != "cpu":
-        try:  # TPU retry windows should not pay compile twice (CPU is
-            # excluded: XLA:CPU AOT cache entries carry machine-feature
-            # lists that mis-load across toolchain updates -> SIGILL risk)
-            jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-        except Exception:
-            pass
+    from megatron_llm_tpu.utils.platform import enable_tpu_compilation_cache
+
+    enable_tpu_compilation_cache()
 
     from megatron_llm_tpu.core.parallel_state import build_mesh
     from megatron_llm_tpu.models import init_model_params, make_config

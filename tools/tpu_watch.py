@@ -122,6 +122,36 @@ def log(event: dict) -> None:
     print(json.dumps(event), flush=True)
 
 
+EVIDENCE_GLOBS = ["BENCH_LAST_TPU*.json", "MFU_SWEEP.json", "E2E_470M.json",
+                  "TPU_WATCH_LOG.jsonl"]
+
+
+def _commit_evidence(job: str) -> None:
+    """Best-effort git commit of the persisted evidence files right after a
+    capture — the round can end (or the builder session die) between the
+    capture and the next manual commit, and a one-shot tunnel window's
+    evidence must not depend on anyone noticing in time."""
+    import glob
+
+    paths = [p for g in EVIDENCE_GLOBS
+             for p in glob.glob(os.path.join(REPO, g))]
+    if not paths:
+        return
+    for attempt in range(3):  # index.lock contention with a human commit
+        try:
+            subprocess.run(["git", "add", "--"] + paths, cwd=REPO,
+                           capture_output=True, timeout=60)
+            r = subprocess.run(
+                ["git", "commit", "-m",
+                 f"tpu_watch: {job} evidence captured", "--"] + paths,
+                cwd=REPO, capture_output=True, text=True, timeout=60)
+            if r.returncode == 0 or "nothing to commit" in (r.stdout or ""):
+                return
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        time.sleep(5)
+
+
 def run_job(name: str, cmd: list[str], timeout_s: float | None,
             on_tpu) -> bool:
     """Returns True iff the job produced TPU evidence (ran on hardware).
@@ -154,6 +184,8 @@ def run_job(name: str, cmd: list[str], timeout_s: float | None,
          "passed": r.returncode == 0,
          "seconds": round(time.time() - t0, 1),
          "tail": tail, **({"stderr_tail": err_tail} if err_tail else {})})
+    if captured:
+        _commit_evidence(name)
     return captured
 
 

@@ -80,6 +80,25 @@ def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
         v = W("self_attn.v_proj", i)
         return pack_qkv(q, k, v, n, nkv, d)
 
+    def qkv_bias(i):
+        # Qwen2: per-projection bias vectors ride the same interleave +
+        # group-major fuse as the kernels (a column-vector is just a
+        # kernel with h=1)
+        B = lambda name: _np(  # noqa: E731
+            state[f"model.layers.{i}.{name}.bias"])[:, None]
+        qb = hf_rows_to_interleaved(B("self_attn.q_proj"), d)
+        kb = hf_rows_to_interleaved(B("self_attn.k_proj"), d)
+        return pack_qkv(qb, kb, B("self_attn.v_proj"), n, nkv, d)[0]
+
+    attention = {
+        "qkv": {"kernel": stack(qkv_kernel)},
+        "dense": {
+            "kernel": stack(lambda i: W("self_attn.o_proj", i).T)
+        },
+    }
+    if m.add_qkv_bias:
+        attention["qkv"]["bias"] = stack(qkv_bias)
+
     params = {
         "embedding": {
             "word_embeddings": emb_pad(_np(state["model.embed_tokens.weight"]))
@@ -89,12 +108,7 @@ def convert_llama_state(state: Dict[str, Any], cfg) -> Dict[str, Any]:
             "post_norm": {
                 "scale": stack(lambda i: W("post_attention_layernorm", i))
             },
-            "attention": {
-                "qkv": {"kernel": stack(qkv_kernel)},
-                "dense": {
-                    "kernel": stack(lambda i: W("self_attn.o_proj", i).T)
-                },
-            },
+            "attention": attention,
         },
         "final_norm": {"scale": _np(state["model.norm.weight"])},
     }
@@ -298,6 +312,20 @@ def config_from_hf(hf_config, model_name: str):
             getattr(hf_config, "tie_word_embeddings", False))
         if model_name == "mistral":
             kw["sliding_window_size"] = getattr(hf_config, "sliding_window", 4096)
+        if model_name == "qwen2":
+            # Qwen2 SWA is layer-banded (full attention below
+            # max_window_layers); native sliding_window_size is uniform, so
+            # only the all-layers case maps — anything else must fail
+            # loudly (same posture as rope_scaling above)
+            if getattr(hf_config, "use_sliding_window", False):
+                mwl = getattr(hf_config, "max_window_layers",
+                              hf_config.num_hidden_layers)
+                if mwl < hf_config.num_hidden_layers:
+                    raise ValueError(
+                        "qwen2 with max_window_layers < num_hidden_layers "
+                        "(mixed full/sliding attention) has no native "
+                        "equivalent")
+                kw["sliding_window_size"] = hf_config.sliding_window
         if model_name == "mixtral":
             kw["num_experts"] = hf_config.num_local_experts
             kw["moe_router_topk"] = hf_config.num_experts_per_tok
@@ -322,7 +350,7 @@ def main():
     ap.add_argument("--out", required=True, help="output checkpoint dir")
     ap.add_argument("--model_name", default="llama2",
                     choices=["llama", "llama2", "codellama", "llama3",
-                             "mistral", "mixtral", "falcon"])
+                             "mistral", "mixtral", "falcon", "qwen2"])
     args = ap.parse_args()
 
     import orbax.checkpoint as ocp

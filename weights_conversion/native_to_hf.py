@@ -46,6 +46,16 @@ def to_hf_llama_state(params: Dict[str, Any], cfg, vocab_size: int) -> Dict[str,
         state[f"{pre}.self_attn.o_proj.weight"] = np.ascontiguousarray(
             get("attention", "dense", "kernel").T
         )
+        if m.add_qkv_bias:
+            # Qwen2: the fused bias vector is a 1-column kernel — same
+            # unpack + de-interleave as the weights
+            qb, kb, vb = unpack_qkv(
+                get("attention", "qkv", "bias")[None, :], n, nkv, d)
+            state[f"{pre}.self_attn.q_proj.bias"] = (
+                interleaved_rows_to_hf(qb, d)[:, 0])
+            state[f"{pre}.self_attn.k_proj.bias"] = (
+                interleaved_rows_to_hf(kb, d)[:, 0])
+            state[f"{pre}.self_attn.v_proj.bias"] = vb[:, 0]
         if m.num_experts is not None:
             # inverse of the mixtral branch in convert_llama_state
             state[f"{pre}.block_sparse_moe.gate.weight"] = (
@@ -171,6 +181,10 @@ def hf_config_from_native(cfg, vocab_size: int):
         common["rope_scaling"] = rope_scaling
     if cfg.model_name == "mistral":
         return MistralConfig(sliding_window=m.sliding_window_size, **common)
+    if cfg.model_name == "qwen2":
+        from transformers import Qwen2Config
+
+        return Qwen2Config(**common)
     if cfg.model_name == "mixtral":
         from transformers import MixtralConfig
 
