@@ -144,8 +144,11 @@ def _fwd_kernel(
 
 def _fwd(
     q, k, v, seg_q, seg_kv, scale, causal, sliding_window, block_q, block_kv,
-    interpret,
+    interpret, out_dtype=None,
 ):
+    """``out_dtype``: ring callers (parallel/ring.py) accumulate per-chunk
+    partials across cp steps and request fp32 to avoid one extra rounding
+    per chunk; the default (q.dtype) is the plain-attention contract."""
     b, n, sq, d = q.shape
     _, nkv, skv, _ = k.shape
     g = n // nkv
@@ -190,7 +193,7 @@ def _fwd(
                          lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((b, n, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -318,8 +321,12 @@ def _bwd_dkv_kernel(
 
 def _bwd(
     scale, causal, sliding_window, block_q, block_kv, interpret,
-    residuals, grads,
+    residuals, grads, delta=None, out_dtype=None,
 ):
+    """``delta``/``out_dtype``: ring callers (parallel/ring.py) invoke this
+    once per KV chunk inside a lax.scan — they precompute the loop-invariant
+    delta = rowsum(do*o) once outside (XLA cannot CSE across scan
+    iterations) and request fp32 gradients for cross-chunk accumulation."""
     q, k, v, o, lse, seg_q, seg_kv = residuals
     do = grads[0]
     b, n, sq, d = q.shape
@@ -328,9 +335,11 @@ def _bwd(
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
 
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [b, n, sq, 1] — same tiled layout as lse
+    if delta is None:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+            keepdims=True
+        )  # [b, n, sq, 1] — same tiled layout as lse
 
     segmented = seg_q is not None
 
@@ -363,7 +372,7 @@ def _bwd(
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bh, qi, ki: (bh // n, bh % n, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*args)
@@ -408,8 +417,8 @@ def _bwd(
                          lambda bh, ki, gi, qi: (bh // nkv, bh % nkv, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, out_dtype or k.dtype),
+            jax.ShapeDtypeStruct(v.shape, out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, d), jnp.float32),
@@ -507,6 +516,24 @@ def _auto_block(seq: int, cap: int = 1024) -> int:
     return seq
 
 
+def pick_blocks(sq: int, skv: int, d: int) -> tuple:
+    """THE block-size policy (VMEM cap by head_dim, sweep env overrides,
+    auto fallback) — single source for flash_attention and the
+    flash-in-ring path (parallel/ring.py), so MLT_FLASH_BLOCK_Q/KV sweeps
+    apply to both and the cap never diverges.
+
+    Measured (v5e, seq 8192, window 256): large KV blocks win even for
+    small sliding windows — grid-iteration overhead outweighs the masked
+    compute whole-tile pruning would save (1024x1024 98 ms vs 512x512
+    109 ms vs 512x256 134 ms) — so no window-based cap."""
+    cap = 1024 if d <= 128 else 512  # VMEM, see _auto_block
+    block_q = (_env_block("MLT_FLASH_BLOCK_Q", sq, cap)
+               or _auto_block(sq, cap))
+    block_kv = (_env_block("MLT_FLASH_BLOCK_KV", skv, cap)
+                or _auto_block(skv, cap))
+    return block_q, block_kv
+
+
 def flash_attention(
     q: jax.Array,  # [b, s, n, d]
     k: jax.Array,  # [b, s, nkv, d]
@@ -522,17 +549,11 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] inputs."""
     b, sq, n, d = q.shape
-    cap = 1024 if d <= 128 else 512  # VMEM, see _auto_block
+    auto_q, auto_kv = pick_blocks(sq, k.shape[1], d)
     if block_q is None:
-        block_q = (_env_block("MLT_FLASH_BLOCK_Q", sq, cap)
-                   or _auto_block(sq, cap))
+        block_q = auto_q
     if block_kv is None:
-        # measured (v5e, seq 8192, window 256): large KV blocks win even for
-        # small sliding windows — grid-iteration overhead outweighs the
-        # masked compute whole-tile pruning would save (1024x1024 98 ms vs
-        # 512x512 109 ms vs 512x256 134 ms) — so no window-based cap
-        block_kv = (_env_block("MLT_FLASH_BLOCK_KV", k.shape[1], cap)
-                    or _auto_block(k.shape[1], cap))
+        block_kv = auto_kv
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = target_platform() == "cpu"

@@ -132,6 +132,267 @@ def apply_zigzag(batch: Dict[str, np.ndarray], cp: int) -> Dict[str, np.ndarray]
 
 
 # ---------------------------------------------------------------------------
+# Flash-in-ring: the Pallas kernel computes each (Q-chunk, KV-chunk) pair
+# ---------------------------------------------------------------------------
+#
+# The jnp ring loop below materializes [.., blk, skv] fp32 score tensors in
+# HBM between the two matmuls of every ring step — XLA cannot fuse a matmul
+# -> softmax -> matmul chain the way a flash kernel tiles it through VMEM.
+# For the CONTIGUOUS chunk layout (token_idx=None; zigzag is opt-in), each
+# ring step's masking structure collapses to one of exactly three cases per
+# (Q-chunk i, KV-chunk src) pair (equal chunk sizes):
+#     src > i   entirely above the causal diagonal  -> skip (lse = -inf)
+#     src == i  the diagonal chunk                  -> flash with causal=True
+#     src < i   entirely below                      -> flash with causal=False
+# so the unmodified kernel covers every case, chunk results merge by their
+# log-sum-exp, and the BACKWARD is exact per chunk: FlashAttention's bwd
+# only needs the GLOBAL per-row lse and delta = rowsum(do*o) — both of
+# which the forward merge produces — so each KV chunk's (dq+, dk, dv)
+# contribution is one _bwd kernel call with the global residuals, with dk/dv
+# accumulators riding the same ppermute ring home to their owner chip.
+# (Sliding windows span chunk boundaries at offsets the kernel cannot
+# express, and zigzag breaks storage-order masking — both fall back to the
+# jnp path.)
+
+
+def _flash_ring_blocks(s: int, d: int) -> tuple:
+    # the kernel module's single block policy: VMEM cap by head_dim AND the
+    # MLT_FLASH_BLOCK_Q/KV sweep overrides (a retune sweep must reach the
+    # ring path too, not just plain flash_attention)
+    from megatron_llm_tpu.ops.pallas.flash_attention import pick_blocks
+
+    return pick_blocks(s, s, d)
+
+
+def _ring_perm(cp: int) -> list:
+    """The KV-rotation permutation — shared by fwd and bwd so the two ring
+    directions can never diverge silently."""
+    return [(j, (j + 1) % cp) for j in range(cp)]
+
+
+def _ring_case_index(src, i, causal):
+    """skip(0) / causal-diagonal(1) / unmasked(2) classification of a
+    (Q-chunk i, KV-chunk src) pair — THE masking policy of the flash ring,
+    shared by forward and backward (a divergence would be a silent
+    wrong-gradient bug, not a crash)."""
+    if not causal:
+        return jnp.int32(2)
+    return jnp.where(src == i, jnp.int32(1),
+                     jnp.where(src < i, jnp.int32(2), jnp.int32(0)))
+
+
+def _flash_ring_supported(q, token_idx, sliding_window) -> bool:
+    if token_idx is not None or sliding_window is not None:
+        return False
+    b, s, n, d = q.shape
+    if d not in (64, 128, 256) or s < 128 or s % 128 != 0:
+        return False
+    try:
+        from megatron_llm_tpu.ops.pallas import flash_attention  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+                         interpret, axis_name):
+    """Returns (out [b,n,s,d] in qh.dtype, global lse [b,n,s,1] fp32).
+
+    ``i`` is this device's cp coordinate, computed by the CALLER outside
+    any nested shard_map: lax.axis_index lowers to its own
+    manual-computation op, and emitting it where cp is not part of the
+    innermost manual set double-binds the axis (sdy verifier error).
+    ppermute does not have that problem — it stays inside."""
+    from megatron_llm_tpu.ops.pallas.flash_attention import _fwd
+
+    cp = lax.axis_size(axis_name)
+    b, n, s, d = qh.shape
+    perm = _ring_perm(cp)
+
+    def chunk_cases(kh_t, vh_t, skv3_t):
+        def skip():
+            # fp32 partials: each chunk output is merged across cp steps,
+            # and rounding every partial to bf16 first would add up to cp
+            # roundings per element (the jnp ring accumulates fp32 too)
+            return (jnp.zeros(qh.shape, jnp.float32),
+                    jnp.full((b, n, s, 1), NEG_INF, jnp.float32))
+
+        def diag():
+            return tuple(_fwd(qh, kh_t, vh_t, sq3, skv3_t, scale, True,
+                              None, bq, bkv, interpret,
+                              out_dtype=jnp.float32))
+
+        def full():
+            return tuple(_fwd(qh, kh_t, vh_t, sq3, skv3_t, scale, False,
+                              None, bq, bkv, interpret,
+                              out_dtype=jnp.float32))
+
+        return skip, diag, full
+
+    def step(carry, _):
+        acc, m_run, l_run, kh_t, vh_t, skv3_t, src = carry
+        out_t, lse_t = lax.switch(_ring_case_index(src, i, causal),
+                                  chunk_cases(kh_t, vh_t, skv3_t))
+        lse_t = lse_t[..., 0]  # [b, n, s]
+        m_new = jnp.maximum(m_run, lse_t)
+        # fully-masked-so-far rows keep lse at NEG_INF; exp of (NEG-NEG)
+        # would be 1 and poison the merge
+        alpha = jnp.where(m_run <= NEG_INF * 0.5, 0.0,
+                          jnp.exp(m_run - m_new))
+        beta = jnp.where(lse_t <= NEG_INF * 0.5, 0.0,
+                         jnp.exp(lse_t - m_new))
+        acc = acc * alpha[..., None] + out_t * beta[..., None]
+        l_run = l_run * alpha + beta
+        kh_t = lax.ppermute(kh_t, axis_name, perm)
+        vh_t = lax.ppermute(vh_t, axis_name, perm)
+        if skv3_t is not None:
+            skv3_t = lax.ppermute(skv3_t, axis_name, perm)
+        return (acc, m_new, l_run, kh_t, vh_t, skv3_t,
+                (src - 1) % cp), None
+
+    acc0 = jnp.zeros((b, n, s, d), jnp.float32)
+    m0 = jnp.full((b, n, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n, s), jnp.float32)
+    (acc, m_run, l_run, *_), _ = lax.scan(
+        step, (acc0, m0, l0, kh, vh, skv3, i), None, length=cp)
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = (acc / l_safe[..., None]).astype(qh.dtype)
+    lse = (m_run + jnp.log(l_safe))[..., None]
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_ring(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv, interpret,
+                axis_name):
+    out, _ = _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal,
+                                  bq, bkv, interpret, axis_name)
+    return out
+
+
+def _flash_ring_fwd(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+                    interpret, axis_name):
+    out, lse = _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal,
+                                    bq, bkv, interpret, axis_name)
+    return out, (qh, kh, vh, sq3, skv3, i, out, lse)
+
+
+def _flash_ring_bwd(scale, causal, bq, bkv, interpret, axis_name,
+                    residuals, do):
+    from megatron_llm_tpu.ops.pallas.flash_attention import _bwd
+
+    qh, kh, vh, sq3, skv3, i, out, lse = residuals
+    cp = lax.axis_size(axis_name)
+    perm = _ring_perm(cp)
+    # delta = rowsum(do * o) is loop-invariant — computed ONCE here (XLA
+    # cannot CSE across scan iterations; recomputing it per ring step would
+    # waste cp-1 full-tensor passes), fp32 kernel outputs for the same
+    # one-rounding accumulation policy as the forward
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    def chunk_cases(kh_t, vh_t, skv3_t):
+        def run(causal_flag):
+            dq, dk, dv, _, _ = _bwd(
+                scale, causal_flag, None, bq, bkv, interpret,
+                (qh, kh_t, vh_t, out, lse, sq3, skv3_t), (do,),
+                delta=delta, out_dtype=jnp.float32)
+            return dq, dk, dv
+
+        def skip():
+            return (jnp.zeros(qh.shape, jnp.float32),
+                    jnp.zeros(kh.shape, jnp.float32),
+                    jnp.zeros(vh.shape, jnp.float32))
+
+        return skip, lambda: run(True), lambda: run(False)
+
+    def step(carry, _):
+        dq_acc, dk_acc, dv_acc, kh_t, vh_t, skv3_t, src = carry
+        dq_t, dk_t, dv_t = lax.switch(_ring_case_index(src, i, causal),
+                                      chunk_cases(kh_t, vh_t, skv3_t))
+        dq_acc = dq_acc + dq_t
+        # dk/dv accumulators ride the ring WITH their chunk: after cp
+        # permutes each chunk's accumulated gradient is back at its owner
+        dk_acc = dk_acc + dk_t
+        dv_acc = dv_acc + dv_t
+        kh_t = lax.ppermute(kh_t, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        vh_t = lax.ppermute(vh_t, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        if skv3_t is not None:
+            skv3_t = lax.ppermute(skv3_t, axis_name, perm)
+        return (dq_acc, dk_acc, dv_acc, kh_t, vh_t, skv3_t,
+                (src - 1) % cp), None
+
+    (dq, dk, dv, *_), _ = lax.scan(
+        step,
+        (jnp.zeros(qh.shape, jnp.float32), jnp.zeros(kh.shape, jnp.float32),
+         jnp.zeros(vh.shape, jnp.float32), kh, vh, skv3, i),
+        None, length=cp)
+    return (dq.astype(qh.dtype), dk.astype(kh.dtype), dv.astype(vh.dtype),
+            None, None, None)
+
+
+_flash_ring.defvjp(_flash_ring_fwd, _flash_ring_bwd)
+
+
+def _ring_attention_flash_core(q, k, v, seg_q, seg_kv, i, *, axis_name,
+                               scale, causal, interpret):
+    """[b, s, n, d] wrapper over the kernel-layout ring (see module note).
+    Every mesh axis must already be manual in the calling context; ``i``
+    is the cp coordinate computed where cp was bound (see
+    _flash_ring_fwd_impl's docstring)."""
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    sq3 = seg_q.astype(jnp.int32)[:, None, :] if seg_q is not None else None
+    skv3 = (seg_kv.astype(jnp.int32)[:, None, :]
+            if seg_kv is not None else None)
+    bq, bkv = _flash_ring_blocks(q.shape[1], q.shape[-1])
+    out = _flash_ring(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+                      interpret, axis_name)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _ring_attention_flash(q, k, v, seg_q, seg_kv, *, axis_name, scale,
+                          causal, interpret):
+    """Dispatch the flash ring, manualizing any remaining auto mesh axes.
+
+    From pjit-land the enclosing ring shard_map is full-manual and the
+    kernels run directly; from the pipeline body only {pp, cp} are manual,
+    and Mosaic kernels reject being left under ANY auto axis — so the
+    whole ring loop (kernels + ppermutes; cp stays bound from the outer
+    context) nests one shard_map over the rest, batch on (dp, ep), heads
+    on tp (same composition as ops/attention._flash_sharded)."""
+    abstract = jax.sharding.get_abstract_mesh()
+    auto = set()
+    if abstract is not None and not abstract.empty and abstract.manual_axes:
+        auto = set(abstract.axis_names) - set(abstract.manual_axes)
+    kw = dict(axis_name=axis_name, scale=scale, causal=causal,
+              interpret=interpret)
+    # the cp coordinate is computed HERE — where the caller's context binds
+    # cp — and passed in: lax.axis_index emitted inside the nested
+    # shard_map would double-bind the axis (sdy verifier error)
+    i = lax.axis_index(axis_name)
+    if not auto:
+        return _ring_attention_flash_core(q, k, v, seg_q, seg_kv, i, **kw)
+    qs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
+    segs = P(ps.DATA_AXES, None)
+    if seg_q is None:
+        fn = shard_map(
+            lambda q_, k_, v_, i_: _ring_attention_flash_core(
+                q_, k_, v_, None, None, i_, **kw),
+            mesh=abstract, in_specs=(qs, qs, qs, P()), out_specs=qs,
+            axis_names=auto, check_vma=False)
+        return fn(q, k, v, i)
+    fn = shard_map(
+        lambda q_, k_, v_, sq_, skv_, i_: _ring_attention_flash_core(
+            q_, k_, v_, sq_, skv_, i_, **kw),
+        mesh=abstract, in_specs=(qs, qs, qs, segs, segs, P()), out_specs=qs,
+        axis_names=auto, check_vma=False)
+    return fn(q, k, v, seg_q, seg_kv, i)
+
+
+# ---------------------------------------------------------------------------
 # The ring loop (runs inside shard_map; cp axis is manual)
 # ---------------------------------------------------------------------------
 
@@ -277,10 +538,29 @@ def ring_attention_manual(
     ``cp`` (e.g. the pipeline body, parallel/pipeline.py): operates on local
     seq shards directly, no inner shard_map."""
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    idx = _local_indices(token_idx, q.shape[1], ps.CP_AXIS)
-    return _ring_attention_local(
-        q, k, v, idx, idx, segment_ids, segment_ids,
+    return _dispatch_local(
+        q, k, v, segment_ids, token_idx,
         axis_name=ps.CP_AXIS, scale=scale, causal=causal,
+        sliding_window=sliding_window,
+    )
+
+
+def _dispatch_local(q, k, v, seg, tok, *, axis_name, scale, causal,
+                    sliding_window):
+    """Route a cp-local attention call: the Pallas flash-in-ring path when
+    the kernel covers the masking structure (TPU target, contiguous
+    chunks, no sliding window), the jnp online-softmax ring otherwise."""
+    from megatron_llm_tpu.core.parallel_state import target_platform
+
+    if (target_platform() == "tpu"
+            and _flash_ring_supported(q, tok, sliding_window)):
+        return _ring_attention_flash(
+            q, k, v, seg, seg, axis_name=axis_name, scale=scale,
+            causal=causal, interpret=False)
+    idx = _local_indices(tok, q.shape[1], axis_name)
+    return _ring_attention_local(
+        q, k, v, idx, idx, seg, seg,
+        axis_name=axis_name, scale=scale, causal=causal,
         sliding_window=sliding_window,
     )
 
@@ -335,10 +615,7 @@ def ring_attention(
               sliding_window=sliding_window)
 
     def local(q_, k_, v_, seg_=None, tok_=None):
-        idx = _local_indices(tok_, s_local, ps.CP_AXIS)
-        return _ring_attention_local(
-            q_, k_, v_, idx, idx, seg_, seg_, **kw
-        )
+        return _dispatch_local(q_, k_, v_, seg_, tok_, **kw)
 
     in_specs = [qs, qs, qs]
     args = [q, k, v]

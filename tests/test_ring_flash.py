@@ -1,0 +1,142 @@
+"""Flash-in-ring context parallelism (parallel/ring.py round-5 addition).
+
+The Pallas kernel computes each (Q-chunk, KV-chunk) ring step for the
+contiguous layout; chunk results merge by log-sum-exp and the backward
+calls the flash bwd kernel per chunk against the GLOBAL (out, lse)
+residuals, dk/dv accumulators riding the ppermute ring home. These tests
+run the composition in interpret mode on the virtual CPU mesh and pin it
+against full (unsharded) XLA attention — forward and gradients, causal /
+bidirectional / GQA / segment-gated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.ops.attention import make_attention_bias, xla_attention
+from megatron_llm_tpu.parallel.ring import (
+    _flash_ring_supported,
+    _ring_attention_flash,
+)
+
+
+def _qkv(key, b=2, s=256, n=4, nkv=2, d=64):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n, d), jnp.float32) * 0.3
+    k = jax.random.normal(kk, (b, s, nkv, d), jnp.float32) * 0.3
+    v = jax.random.normal(kv, (b, s, nkv, d), jnp.float32) * 0.3
+    return q, k, v
+
+
+def _run_ring_flash(mesh, cp, q, k, v, seg=None, causal=True):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qs = P(None, "cp", None, None)
+    segs = P(None, "cp")
+
+    if seg is None:
+        fn = jax.shard_map(
+            lambda q_, k_, v_: _ring_attention_flash(
+                q_, k_, v_, None, None, axis_name=ps.CP_AXIS, scale=scale,
+                causal=causal, interpret=True),
+            mesh=mesh, in_specs=(qs, qs, qs), out_specs=qs,
+            axis_names={ps.CP_AXIS}, check_vma=False)
+
+        def loss(q_, k_, v_):
+            o = fn(q_, k_, v_)
+            return (o.astype(jnp.float32) ** 2).sum(), o
+
+        return jax.jit(jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+
+    fn = jax.shard_map(
+        lambda q_, k_, v_, s_: _ring_attention_flash(
+            q_, k_, v_, s_, s_, axis_name=ps.CP_AXIS, scale=scale,
+            causal=causal, interpret=True),
+        mesh=mesh, in_specs=(qs, qs, qs, segs), out_specs=qs,
+        axis_names={ps.CP_AXIS}, check_vma=False)
+
+    def loss(q_, k_, v_):
+        o = fn(q_, k_, v_, seg)
+        return (o.astype(jnp.float32) ** 2).sum(), o
+
+    return jax.jit(jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+
+
+def _reference(q, k, v, seg=None, causal=True):
+    bias = make_attention_bias(
+        q.shape[1], k.shape[1], causal=causal,
+        segment_ids_q=seg, segment_ids_kv=seg)
+
+    def loss(q_, k_, v_):
+        o = xla_attention(q_, k_, v_, bias=bias,
+                          scale=1.0 / (q.shape[-1] ** 0.5))
+        return (o.astype(jnp.float32) ** 2).sum(), o
+
+    return jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+
+
+@pytest.mark.parametrize("cp,causal", [(2, True), (2, False), (4, True)])
+def test_ring_flash_parity(eight_devices, cp, causal):
+    mesh = ps.build_mesh(context_parallel_size=cp, devices=eight_devices[:cp])
+    q, k, v = _qkv(jax.random.PRNGKey(0), s=128 * cp)
+    with ps.global_mesh(mesh), mesh:
+        (val, out), grads = _run_ring_flash(mesh, cp, q, k, v, causal=causal)
+    (rval, rout), rgrads = _reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout),
+                               atol=2e-5, rtol=2e-5)
+    for g, rg in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_ring_flash_bf16_accumulation(eight_devices):
+    """bf16 inputs: per-chunk partials stay fp32 through the cross-chunk
+    merge (one final rounding, like the jnp ring) — the output must track
+    an fp32-computed reference to bf16 resolution, independent of cp."""
+    cp = 4
+    mesh = ps.build_mesh(context_parallel_size=cp, devices=eight_devices[:cp])
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=128 * cp)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    with ps.global_mesh(mesh), mesh:
+        (_, out), _grads = _run_ring_flash(mesh, cp, qb, kb, vb, causal=True)
+    assert out.dtype == jnp.bfloat16
+    (_, rout), _ = _reference(qb.astype(jnp.float32), kb.astype(jnp.float32),
+                              vb.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(rout), atol=2e-2, rtol=2e-2)
+
+
+def test_ring_flash_segments(eight_devices):
+    """Packed-document gating across chunk boundaries: a document spanning
+    the cp split must not attend across its boundary."""
+    cp = 2
+    mesh = ps.build_mesh(context_parallel_size=cp, devices=eight_devices[:cp])
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=2, s=256)
+    # doc boundary NOT on the chunk boundary (doc 0: [0,180), doc 1: rest)
+    seg = (jnp.arange(256)[None, :] >= 180).astype(jnp.int32)
+    seg = jnp.broadcast_to(seg, (2, 256))
+    with ps.global_mesh(mesh), mesh:
+        (val, out), grads = _run_ring_flash(mesh, cp, q, k, v, seg=seg)
+    (rval, rout), rgrads = _reference(q, k, v, seg=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout),
+                               atol=2e-5, rtol=2e-5)
+    for g, rg in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_ring_flash_gating():
+    """The dispatcher must fall back to the jnp ring for the structures the
+    kernel cannot mask: zigzag token_idx, sliding windows, off-tile seqs."""
+    q = jnp.zeros((1, 256, 4, 64))
+    assert _flash_ring_supported(q, None, None)
+    assert not _flash_ring_supported(q, jnp.arange(256), None)  # zigzag
+    assert not _flash_ring_supported(q, None, 128)  # sliding window
+    assert not _flash_ring_supported(jnp.zeros((1, 200, 4, 64)), None, None)
+    assert not _flash_ring_supported(jnp.zeros((1, 256, 4, 32)), None, None)
