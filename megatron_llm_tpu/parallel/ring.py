@@ -152,7 +152,11 @@ def apply_zigzag(batch: Dict[str, np.ndarray], cp: int) -> Dict[str, np.ndarray]
 # accumulators riding the same ppermute ring home to their owner chip.
 # (Sliding windows span chunk boundaries at offsets the kernel cannot
 # express, and zigzag breaks storage-order masking — both fall back to the
-# jnp path.)
+# jnp path. Zigzag COULD be kernelized striped-attention style — each
+# device holds two contiguous sub-chunks, so every (q-sub, kv-sub) pair is
+# again skip/diag/full at quarter granularity, 4 kernel calls per ring
+# step — future work; the contiguous flash ring already strictly
+# dominates the jnp path, which does full masked compute every step.)
 
 
 def _flash_ring_blocks(s: int, d: int) -> tuple:
