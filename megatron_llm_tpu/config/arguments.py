@@ -150,6 +150,11 @@ class ParallelConfig:
     # Megatron-style sequence parallelism: shard seq dim over tp in LN/dropout
     # regions (activation memory / TP).
     sequence_parallel: bool = False
+    # declares that cp batches follow the STANDARD zigzag layout
+    # (parallel/ring.py:apply_zigzag) — lets causal ring attention use the
+    # striped Pallas kernels instead of the jnp fallback; set it alongside
+    # the data-side apply_zigzag transform
+    cp_zigzag: bool = False
     # Context parallelism (ring attention) size — extension beyond reference.
     context_parallel_size: int = 1
     # Expert parallelism for MoE — extension beyond reference.

@@ -245,6 +245,7 @@ def attention_sublayer(
             use_flash=cfg.training.use_flash_attn,
             dropout_rate=0.0 if deterministic else m.attention_dropout,
             dropout_key=dropout_key,
+            zigzag=cfg.parallel.cp_zigzag,
         )
 
     # named so remat policies can save the attention output and skip

@@ -182,9 +182,12 @@ def attention(
     dropout_key: Optional[jax.Array] = None,
     block_q: Optional[int] = None,
     block_kv: Optional[int] = None,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Dispatch between ring attention (cp > 1), the Pallas flash kernel,
-    and the XLA fallback."""
+    and the XLA fallback. ``zigzag`` declares the standard apply_zigzag
+    token layout (cfg --cp_zigzag), which lets the ring path use the
+    striped flash kernels instead of the jnp fallback."""
     sq = q.shape[1]
 
     from megatron_llm_tpu.core import parallel_state as ps
@@ -208,6 +211,7 @@ def attention(
         return ring_attention(
             q, k, v, segment_ids=segment_ids, token_idx=token_idx,
             causal=causal, sliding_window=sliding_window, scale=scale,
+            zigzag=zigzag,
         )
     flash_ok = (
         use_flash

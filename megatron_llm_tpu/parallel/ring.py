@@ -150,13 +150,12 @@ def apply_zigzag(batch: Dict[str, np.ndarray], cp: int) -> Dict[str, np.ndarray]
 # which the forward merge produces — so each KV chunk's (dq+, dk, dv)
 # contribution is one _bwd kernel call with the global residuals, with dk/dv
 # accumulators riding the same ppermute ring home to their owner chip.
-# (Sliding windows span chunk boundaries at offsets the kernel cannot
-# express, and zigzag breaks storage-order masking — both fall back to the
-# jnp path. Zigzag COULD be kernelized striped-attention style — each
-# device holds two contiguous sub-chunks, so every (q-sub, kv-sub) pair is
-# again skip/diag/full at quarter granularity, 4 kernel calls per ring
-# step — future work; the contiguous flash ring already strictly
-# dominates the jnp path, which does full masked compute every step.)
+# Sliding windows span chunk boundaries at offsets the kernel cannot
+# express and fall back to the jnp path. The zigzag layout IS kernelized —
+# the striped variant further below (declared via the ``zigzag`` contract
+# flag); non-causal permuted batches need no striping at all (their
+# masking is order-independent) and use this contiguous ring directly.
+# See _dispatch_local for the routing table.
 
 
 def _flash_ring_blocks(s: int, d: int) -> tuple:
@@ -185,10 +184,7 @@ def _ring_case_index(src, i, causal):
                      jnp.where(src < i, jnp.int32(2), jnp.int32(0)))
 
 
-def _flash_ring_supported(q, token_idx, sliding_window) -> bool:
-    if token_idx is not None or sliding_window is not None:
-        return False
-    b, s, n, d = q.shape
+def _flash_shapes_ok(s: int, d: int) -> bool:
     if d not in (64, 128, 256) or s < 128 or s % 128 != 0:
         return False
     try:
@@ -196,6 +192,18 @@ def _flash_ring_supported(q, token_idx, sliding_window) -> bool:
     except ImportError:
         return False
     return True
+
+
+def _merge_chunk(acc, m_run, l_run, out_t, lse_t):
+    """Log-sum-exp merge of one chunk's (normalized out, lse) into the
+    running (acc fp32, max, normalizer) — shared by the contiguous and
+    striped rings. Guards the all-masked-so-far rows (lse at NEG_INF;
+    exp of NEG-NEG would be 1 and poison the merge)."""
+    m_new = jnp.maximum(m_run, lse_t)
+    alpha = jnp.where(m_run <= NEG_INF * 0.5, 0.0, jnp.exp(m_run - m_new))
+    beta = jnp.where(lse_t <= NEG_INF * 0.5, 0.0, jnp.exp(lse_t - m_new))
+    acc = acc * alpha[..., None] + out_t * beta[..., None]
+    return acc, m_new, l_run * alpha + beta
 
 
 def _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
@@ -237,21 +245,13 @@ def _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
         acc, m_run, l_run, kh_t, vh_t, skv3_t, src = carry
         out_t, lse_t = lax.switch(_ring_case_index(src, i, causal),
                                   chunk_cases(kh_t, vh_t, skv3_t))
-        lse_t = lse_t[..., 0]  # [b, n, s]
-        m_new = jnp.maximum(m_run, lse_t)
-        # fully-masked-so-far rows keep lse at NEG_INF; exp of (NEG-NEG)
-        # would be 1 and poison the merge
-        alpha = jnp.where(m_run <= NEG_INF * 0.5, 0.0,
-                          jnp.exp(m_run - m_new))
-        beta = jnp.where(lse_t <= NEG_INF * 0.5, 0.0,
-                         jnp.exp(lse_t - m_new))
-        acc = acc * alpha[..., None] + out_t * beta[..., None]
-        l_run = l_run * alpha + beta
+        acc, m_run, l_run = _merge_chunk(acc, m_run, l_run, out_t,
+                                         lse_t[..., 0])
         kh_t = lax.ppermute(kh_t, axis_name, perm)
         vh_t = lax.ppermute(vh_t, axis_name, perm)
         if skv3_t is not None:
             skv3_t = lax.ppermute(skv3_t, axis_name, perm)
-        return (acc, m_new, l_run, kh_t, vh_t, skv3_t,
+        return (acc, m_run, l_run, kh_t, vh_t, skv3_t,
                 (src - 1) % cp), None
 
     acc0 = jnp.zeros((b, n, s, d), jnp.float32)
@@ -339,26 +339,225 @@ def _flash_ring_bwd(scale, causal, bq, bkv, interpret, axis_name,
 _flash_ring.defvjp(_flash_ring_fwd, _flash_ring_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Striped flash ring: the zigzag layout, kernelized (round 5)
+# ---------------------------------------------------------------------------
+#
+# Under the standard zigzag layout (apply_zigzag: device j holds global
+# chunks j and 2cp-1-j of 2cp chunks, concatenated [A_j, B_j]) every
+# (q-sub, kv-sub) pair is again a contiguous block pair, so the kernel
+# covers it at half-chunk granularity. With causal masking only THREE of
+# the four pairs are ever live:
+#     A_i vs A_src   the contiguous 3-way case on (src, i)
+#     B_i vs A_src   q chunk 2cp-1-i >= cp > src      -> always unmasked
+#     B_i vs B_src   compares (2cp-1-i, 2cp-1-src)    -> the 3-way case
+#                    with the roles of src and i SWAPPED
+#     A_i vs B_src   kv chunk 2cp-1-src >= cp > i     -> always masked
+# which is what makes zigzag balanced: each device does ~1.5 half-chunk
+# kernels per step regardless of its rank, vs the contiguous layout where
+# step t idles every device below rank t. (Callers declare the layout via
+# the ``zigzag`` contract flag — token order is runtime data; non-causal
+# permuted batches need no striping at all since their masking is
+# order-independent and the plain flash ring is used.)
+
+
+def _zz_cases(i, src, causal):
+    case_aa = _ring_case_index(src, i, causal)
+    case_bb = _ring_case_index(i, src, causal)
+    return case_aa, case_bb
+
+
+def _split_half(x, axis):
+    c = x.shape[axis] // 2
+    return (lax.slice_in_dim(x, 0, c, axis=axis),
+            lax.slice_in_dim(x, c, 2 * c, axis=axis))
+
+
+def _flash_ring_zz_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq,
+                            bkv, interpret, axis_name):
+    from megatron_llm_tpu.ops.pallas.flash_attention import _fwd
+
+    assert causal, "striped ring is causal-only (see module note)"
+    cp = lax.axis_size(axis_name)
+    b, n, s, d = qh.shape
+    c = s // 2
+    perm = _ring_perm(cp)
+    qA, qB = _split_half(qh, 2)
+    sqA, sqB = _split_half(sq3, 2) if sq3 is not None else (None, None)
+
+    def fwd_pair(q_, k_, v_, sq_, skv_, causal_flag):
+        return tuple(_fwd(q_, k_, v_, sq_, skv_, scale, causal_flag, None,
+                          bq, bkv, interpret, out_dtype=jnp.float32))
+
+    def skip_out():
+        return (jnp.zeros((b, n, c, d), jnp.float32),
+                jnp.full((b, n, c, 1), NEG_INF, jnp.float32))
+
+    def step(carry, _):
+        accA, mA, lA, accB, mB, lB, kh_t, vh_t, skv3_t, src = carry
+        kA, kB = _split_half(kh_t, 2)
+        vA, vB = _split_half(vh_t, 2)
+        skvA, skvB = (_split_half(skv3_t, 2) if skv3_t is not None
+                      else (None, None))
+        case_aa, case_bb = _zz_cases(i, src, causal)
+        outAA, lseAA = lax.switch(case_aa, (
+            skip_out,
+            lambda: fwd_pair(qA, kA, vA, sqA, skvA, True),
+            lambda: fwd_pair(qA, kA, vA, sqA, skvA, False)))
+        accA, mA, lA = _merge_chunk(accA, mA, lA, outAA, lseAA[..., 0])
+        outBA, lseBA = fwd_pair(qB, kA, vA, sqB, skvA, False)
+        accB, mB, lB = _merge_chunk(accB, mB, lB, outBA, lseBA[..., 0])
+        outBB, lseBB = lax.switch(case_bb, (
+            skip_out,
+            lambda: fwd_pair(qB, kB, vB, sqB, skvB, True),
+            lambda: fwd_pair(qB, kB, vB, sqB, skvB, False)))
+        accB, mB, lB = _merge_chunk(accB, mB, lB, outBB, lseBB[..., 0])
+        kh_t = lax.ppermute(kh_t, axis_name, perm)
+        vh_t = lax.ppermute(vh_t, axis_name, perm)
+        if skv3_t is not None:
+            skv3_t = lax.ppermute(skv3_t, axis_name, perm)
+        return (accA, mA, lA, accB, mB, lB, kh_t, vh_t, skv3_t,
+                (src - 1) % cp), None
+
+    z = lambda: jnp.zeros((b, n, c, d), jnp.float32)  # noqa: E731
+    mneg = lambda: jnp.full((b, n, c), NEG_INF, jnp.float32)  # noqa: E731
+    l0 = lambda: jnp.zeros((b, n, c), jnp.float32)  # noqa: E731
+    (accA, mA, lA, accB, mB, lB, *_), _ = lax.scan(
+        step, (z(), mneg(), l0(), z(), mneg(), l0(), kh, vh, skv3, i),
+        None, length=cp)
+
+    def fin(acc, m_run, l_run):
+        l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+        return (acc / l_safe[..., None]).astype(qh.dtype), \
+            (m_run + jnp.log(l_safe))[..., None]
+
+    outA, lseA = fin(accA, mA, lA)
+    outB, lseB = fin(accB, mB, lB)
+    return (jnp.concatenate([outA, outB], axis=2),
+            jnp.concatenate([lseA, lseB], axis=2))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_ring_zz(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+                   interpret, axis_name):
+    out, _ = _flash_ring_zz_fwd_impl(qh, kh, vh, sq3, skv3, i, scale,
+                                     causal, bq, bkv, interpret, axis_name)
+    return out
+
+
+def _flash_ring_zz_fwd(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+                       interpret, axis_name):
+    out, lse = _flash_ring_zz_fwd_impl(qh, kh, vh, sq3, skv3, i, scale,
+                                       causal, bq, bkv, interpret,
+                                       axis_name)
+    return out, (qh, kh, vh, sq3, skv3, i, out, lse)
+
+
+def _flash_ring_zz_bwd(scale, causal, bq, bkv, interpret, axis_name,
+                       residuals, do):
+    from megatron_llm_tpu.ops.pallas.flash_attention import _bwd
+
+    qh, kh, vh, sq3, skv3, i, out, lse = residuals
+    cp = lax.axis_size(axis_name)
+    b, n, s, d = qh.shape
+    nkv = kh.shape[1]
+    c = s // 2
+    perm = _ring_perm(cp)
+    qA, qB = _split_half(qh, 2)
+    sqA, sqB = _split_half(sq3, 2) if sq3 is not None else (None, None)
+    outA, outB = _split_half(out, 2)
+    lseA, lseB = _split_half(lse, 2)
+    doA, doB = _split_half(do, 2)
+    # loop-invariant delta, computed once per q-sub (same rationale as the
+    # contiguous bwd)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    deltaA, deltaB = _split_half(delta, 2)
+
+    def run_pair(q_, k_, v_, o_, lse_, do_, delta_, sq_, skv_, causal_flag):
+        dq, dk, dv, _, _ = _bwd(
+            scale, causal_flag, None, bq, bkv, interpret,
+            (q_, k_, v_, o_, lse_, sq_, skv_), (do_,),
+            delta=delta_, out_dtype=jnp.float32)
+        return dq, dk, dv
+
+    def zeros3():
+        return (jnp.zeros((b, n, c, d), jnp.float32),
+                jnp.zeros((b, nkv, c, d), jnp.float32),
+                jnp.zeros((b, nkv, c, d), jnp.float32))
+
+    def step(carry, _):
+        dqA, dqB, dk_acc, dv_acc, kh_t, vh_t, skv3_t, src = carry
+        kA, kB = _split_half(kh_t, 2)
+        vA, vB = _split_half(vh_t, 2)
+        skvA, skvB = (_split_half(skv3_t, 2) if skv3_t is not None
+                      else (None, None))
+        case_aa, case_bb = _zz_cases(i, src, causal)
+        dqAA, dkAA, dvAA = lax.switch(case_aa, (
+            zeros3,
+            lambda: run_pair(qA, kA, vA, outA, lseA, doA, deltaA,
+                             sqA, skvA, True),
+            lambda: run_pair(qA, kA, vA, outA, lseA, doA, deltaA,
+                             sqA, skvA, False)))
+        dqBA, dkBA, dvBA = run_pair(qB, kA, vA, outB, lseB, doB, deltaB,
+                                    sqB, skvA, False)
+        dqBB, dkBB, dvBB = lax.switch(case_bb, (
+            zeros3,
+            lambda: run_pair(qB, kB, vB, outB, lseB, doB, deltaB,
+                             sqB, skvB, True),
+            lambda: run_pair(qB, kB, vB, outB, lseB, doB, deltaB,
+                             sqB, skvB, False)))
+        dqA = dqA + dqAA
+        dqB = dqB + dqBA + dqBB
+        dk_acc = dk_acc + jnp.concatenate([dkAA + dkBA, dkBB], axis=2)
+        dv_acc = dv_acc + jnp.concatenate([dvAA + dvBA, dvBB], axis=2)
+        kh_t = lax.ppermute(kh_t, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        vh_t = lax.ppermute(vh_t, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        if skv3_t is not None:
+            skv3_t = lax.ppermute(skv3_t, axis_name, perm)
+        return (dqA, dqB, dk_acc, dv_acc, kh_t, vh_t, skv3_t,
+                (src - 1) % cp), None
+
+    (dqA, dqB, dk, dv, *_), _ = lax.scan(
+        step,
+        (jnp.zeros((b, n, c, d), jnp.float32),
+         jnp.zeros((b, n, c, d), jnp.float32),
+         jnp.zeros(kh.shape, jnp.float32), jnp.zeros(vh.shape, jnp.float32),
+         kh, vh, skv3, i),
+        None, length=cp)
+    dq = jnp.concatenate([dqA, dqB], axis=2)
+    return (dq.astype(qh.dtype), dk.astype(kh.dtype), dv.astype(vh.dtype),
+            None, None, None)
+
+
+_flash_ring_zz.defvjp(_flash_ring_zz_fwd, _flash_ring_zz_bwd)
+
+
 def _ring_attention_flash_core(q, k, v, seg_q, seg_kv, i, *, axis_name,
-                               scale, causal, interpret):
+                               scale, causal, interpret, striped=False):
     """[b, s, n, d] wrapper over the kernel-layout ring (see module note).
     Every mesh axis must already be manual in the calling context; ``i``
     is the cp coordinate computed where cp was bound (see
-    _flash_ring_fwd_impl's docstring)."""
+    _flash_ring_fwd_impl's docstring); ``striped`` selects the zigzag
+    half-chunk variant."""
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
     sq3 = seg_q.astype(jnp.int32)[:, None, :] if seg_q is not None else None
     skv3 = (seg_kv.astype(jnp.int32)[:, None, :]
             if seg_kv is not None else None)
-    bq, bkv = _flash_ring_blocks(q.shape[1], q.shape[-1])
-    out = _flash_ring(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
-                      interpret, axis_name)
+    sub = 2 if striped else 1
+    bq, bkv = _flash_ring_blocks(q.shape[1] // sub, q.shape[-1])
+    ring = _flash_ring_zz if striped else _flash_ring
+    out = ring(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
+               interpret, axis_name)
     return out.transpose(0, 2, 1, 3)
 
 
 def _ring_attention_flash(q, k, v, seg_q, seg_kv, *, axis_name, scale,
-                          causal, interpret):
+                          causal, interpret, striped=False):
     """Dispatch the flash ring, manualizing any remaining auto mesh axes.
 
     From pjit-land the enclosing ring shard_map is full-manual and the
@@ -372,7 +571,7 @@ def _ring_attention_flash(q, k, v, seg_q, seg_kv, *, axis_name, scale,
     if abstract is not None and not abstract.empty and abstract.manual_axes:
         auto = set(abstract.axis_names) - set(abstract.manual_axes)
     kw = dict(axis_name=axis_name, scale=scale, causal=causal,
-              interpret=interpret)
+              interpret=interpret, striped=striped)
     # the cp coordinate is computed HERE — where the caller's context binds
     # cp — and passed in: lax.axis_index emitted inside the nested
     # shard_map would double-bind the axis (sdy verifier error)
@@ -537,6 +736,7 @@ def ring_attention_manual(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     scale: Optional[float] = None,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Ring attention for callers already inside a shard_map that manualizes
     ``cp`` (e.g. the pipeline body, parallel/pipeline.py): operates on local
@@ -545,22 +745,42 @@ def ring_attention_manual(
     return _dispatch_local(
         q, k, v, segment_ids, token_idx,
         axis_name=ps.CP_AXIS, scale=scale, causal=causal,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, zigzag=zigzag,
     )
 
 
 def _dispatch_local(q, k, v, seg, tok, *, axis_name, scale, causal,
-                    sliding_window):
-    """Route a cp-local attention call: the Pallas flash-in-ring path when
-    the kernel covers the masking structure (TPU target, contiguous
-    chunks, no sliding window), the jnp online-softmax ring otherwise."""
+                    sliding_window, zigzag=False):
+    """Route a cp-local attention call to the fastest correct path:
+
+    * contiguous chunks (no token_idx)         -> flash ring
+    * permuted order but NON-causal            -> flash ring (order-
+      independent masking: causal off, segments compare by value)
+    * causal + declared standard zigzag layout -> striped flash ring
+    * anything else (sliding windows, custom permutations, off-tile
+      shapes, non-TPU targets)                 -> jnp online-softmax ring
+
+    ``zigzag`` is a CONTRACT flag (cfg --cp_zigzag / apply_zigzag): token
+    order is runtime data, so the caller declares the standard layout
+    rather than the dispatcher inspecting it.
+    """
     from megatron_llm_tpu.core.parallel_state import target_platform
 
-    if (target_platform() == "tpu"
-            and _flash_ring_supported(q, tok, sliding_window)):
-        return _ring_attention_flash(
-            q, k, v, seg, seg, axis_name=axis_name, scale=scale,
-            causal=causal, interpret=False)
+    if target_platform() == "tpu" and sliding_window is None:
+        if tok is None and _flash_shapes_ok(q.shape[1], q.shape[-1]):
+            return _ring_attention_flash(
+                q, k, v, seg, seg, axis_name=axis_name, scale=scale,
+                causal=causal, interpret=False)
+        if (tok is not None and not causal
+                and _flash_shapes_ok(q.shape[1], q.shape[-1])):
+            return _ring_attention_flash(
+                q, k, v, seg, seg, axis_name=axis_name, scale=scale,
+                causal=False, interpret=False)
+        if (tok is not None and causal and zigzag and q.shape[1] % 2 == 0
+                and _flash_shapes_ok(q.shape[1] // 2, q.shape[-1])):
+            return _ring_attention_flash(
+                q, k, v, seg, seg, axis_name=axis_name, scale=scale,
+                causal=True, interpret=False, striped=True)
     idx = _local_indices(tok, q.shape[1], axis_name)
     return _ring_attention_local(
         q, k, v, idx, idx, seg, seg,
@@ -590,6 +810,7 @@ def ring_attention(
     sliding_window: Optional[int] = None,
     scale: Optional[float] = None,
     mesh: Optional[Mesh] = None,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Context-parallel attention: seq over ``cp``, heads over ``tp``,
     batch over ``dp``.
@@ -597,11 +818,14 @@ def ring_attention(
     Called from the ops/attention dispatcher when the active mesh has cp > 1.
     From pjit-land it wraps the ring loop in shard_map; from inside an
     enclosing shard_map that already manualizes cp it runs locally.
+    ``zigzag`` declares the standard apply_zigzag layout (see
+    _dispatch_local).
     """
     if cp_is_manual():
         return ring_attention_manual(
             q, k, v, segment_ids=segment_ids, token_idx=token_idx,
             causal=causal, sliding_window=sliding_window, scale=scale,
+            zigzag=zigzag,
         )
     mesh = mesh or ps.get_global_mesh()
     cp = mesh.shape.get(ps.CP_AXIS, 1)
@@ -616,7 +840,7 @@ def ring_attention(
     s_local = q.shape[1] // cp
 
     kw = dict(axis_name=ps.CP_AXIS, scale=scale, causal=causal,
-              sliding_window=sliding_window)
+              sliding_window=sliding_window, zigzag=zigzag)
 
     def local(q_, k_, v_, seg_=None, tok_=None):
         return _dispatch_local(q_, k_, v_, seg_, tok_, **kw)

@@ -19,10 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from megatron_llm_tpu.core import parallel_state as ps
 from megatron_llm_tpu.ops.attention import make_attention_bias, xla_attention
-from megatron_llm_tpu.parallel.ring import (
-    _flash_ring_supported,
-    _ring_attention_flash,
-)
+from megatron_llm_tpu.parallel.ring import _ring_attention_flash
 
 
 def _qkv(key, b=2, s=256, n=4, nkv=2, d=64):
@@ -131,12 +128,117 @@ def test_ring_flash_segments(eight_devices):
                                    atol=3e-4, rtol=3e-4)
 
 
-def test_ring_flash_gating():
-    """The dispatcher must fall back to the jnp ring for the structures the
-    kernel cannot mask: zigzag token_idx, sliding windows, off-tile seqs."""
+@pytest.mark.parametrize("cp,segmented", [(2, False), (4, False), (2, True)])
+def test_ring_flash_striped_zigzag(eight_devices, cp, segmented):
+    """Striped (zigzag) flash ring vs full attention in ORIGINAL token
+    order: apply the standard zigzag permutation to the inputs, run the
+    striped kernels, and the output/grad rows must equal the reference's
+    under the same permutation. Covers the 3-live-pairs case analysis
+    (AA switch, BA always-full, BB swapped-roles switch, AB masked)."""
+    from megatron_llm_tpu.parallel.ring import (
+        _ring_attention_flash,
+        zigzag_permutation,
+    )
+
+    s = 256 * cp  # each half-chunk is 128 — the kernel tile minimum
+    mesh = ps.build_mesh(context_parallel_size=cp, devices=eight_devices[:cp])
+    q, k, v = _qkv(jax.random.PRNGKey(4), b=2, s=s)
+    seg = None
+    if segmented:
+        seg = (jnp.arange(s)[None, :] >= (s // 2 + 64)).astype(jnp.int32)
+        seg = jnp.broadcast_to(seg, (2, s))
+    perm = zigzag_permutation(s, cp)
+    qp, kp, vp = q[:, perm], k[:, perm], v[:, perm]
+    segp = seg[:, perm] if seg is not None else None
+
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qs = P(None, "cp", None, None)
+    segs = P(None, "cp")
+
+    with ps.global_mesh(mesh), mesh:
+        if segp is None:
+            fn = jax.shard_map(
+                lambda q_, k_, v_: _ring_attention_flash(
+                    q_, k_, v_, None, None, axis_name=ps.CP_AXIS,
+                    scale=scale, causal=True, interpret=True, striped=True),
+                mesh=mesh, in_specs=(qs, qs, qs), out_specs=qs,
+                axis_names={ps.CP_AXIS}, check_vma=False)
+
+            def loss(q_, k_, v_):
+                o = fn(q_, k_, v_)
+                return (o.astype(jnp.float32) ** 2).sum(), o
+        else:
+            fn = jax.shard_map(
+                lambda q_, k_, v_, s_: _ring_attention_flash(
+                    q_, k_, v_, s_, s_, axis_name=ps.CP_AXIS,
+                    scale=scale, causal=True, interpret=True, striped=True),
+                mesh=mesh, in_specs=(qs, qs, qs, segs), out_specs=qs,
+                axis_names={ps.CP_AXIS}, check_vma=False)
+
+            def loss(q_, k_, v_):
+                o = fn(q_, k_, v_, segp)
+                return (o.astype(jnp.float32) ** 2).sum(), o
+
+        (val, out), grads = jax.jit(jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True))(qp, kp, vp)
+
+    (rval, rout), rgrads = _reference(q, k, v, seg=seg, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(rout[:, perm]),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(float(val), float(rval), rtol=1e-5)
+    for g, rg in zip(grads, rgrads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg[:, perm]),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_ring_flash_dispatch_routing(monkeypatch):
+    """Drive _dispatch_local itself (the production routing table), with
+    the three backends stubbed to recorders: every branch — contiguous
+    flash, non-causal-permuted flash, striped zigzag, and each jnp
+    fallback reason (sliding window, undeclared zigzag, off-tile shapes,
+    non-TPU target) — must pick exactly the path the docstring promises."""
+    from megatron_llm_tpu.parallel import ring
+
+    calls = []
+
+    def fake_flash(q, k, v, sq, skv, *, axis_name, scale, causal,
+                   interpret, striped=False):
+        calls.append(("flash", causal, striped))
+        return q
+
+    def fake_local(q, k, v, qi, ki, sq, skv, **kw):
+        calls.append(("jnp", kw["causal"], False))
+        return q
+
+    monkeypatch.setattr(ring, "_ring_attention_flash", fake_flash)
+    monkeypatch.setattr(ring, "_ring_attention_local", fake_local)
+    monkeypatch.setattr(ring, "_local_indices",
+                        lambda tok, s, ax: jnp.arange(s))
+    monkeypatch.setattr(ring.ps, "target_platform", lambda: "tpu")
+
     q = jnp.zeros((1, 256, 4, 64))
-    assert _flash_ring_supported(q, None, None)
-    assert not _flash_ring_supported(q, jnp.arange(256), None)  # zigzag
-    assert not _flash_ring_supported(q, None, 128)  # sliding window
-    assert not _flash_ring_supported(jnp.zeros((1, 200, 4, 64)), None, None)
-    assert not _flash_ring_supported(jnp.zeros((1, 256, 4, 32)), None, None)
+    kw = dict(axis_name="cp", scale=0.125, sliding_window=None)
+    tok = jnp.arange(256)
+
+    def route(**over):
+        calls.clear()
+        args = dict(kw, causal=True, zigzag=False)
+        args.update(over)
+        ring._dispatch_local(args.pop("q", q), q, q, None,
+                             args.pop("tok", None), **args)
+        return calls[-1]
+
+    assert route() == ("flash", True, False)  # contiguous
+    assert route(tok=tok, zigzag=True) == ("flash", True, True)  # striped
+    assert route(tok=tok, causal=False) == ("flash", False, False)  # order-
+    # independent masking: plain flash even though permuted
+    assert route(tok=tok) == ("jnp", True, False)  # undeclared permutation
+    assert route(sliding_window=64) == ("jnp", True, False)
+    assert route(q=jnp.zeros((1, 200, 4, 64)))[0] == "jnp"  # off-tile seq
+    assert route(q=jnp.zeros((1, 256, 4, 32)))[0] == "jnp"  # head_dim 32
+    # striped needs BOTH half-chunks on the kernel tile grid
+    assert route(q=jnp.zeros((1, 192, 4, 64)), tok=jnp.arange(192),
+                 zigzag=True)[0] == "jnp"
+
+    monkeypatch.setattr(ring.ps, "target_platform", lambda: "cpu")
+    assert route() == ("jnp", True, False)  # non-TPU target
