@@ -28,7 +28,7 @@ def _topo_devices(name):
     return list(np.array(topo.devices).ravel())
 
 
-def _lower_and_compile(cfg, mesh, gbs, seq):
+def _lower_and_compile(cfg, mesh, gbs, seq, extra_batch=None):
     from megatron_llm_tpu.core.parallel_state import global_mesh
     from megatron_llm_tpu.models import init_model_params
     from megatron_llm_tpu.optimizer.optimizer import get_optimizer
@@ -45,6 +45,7 @@ def _lower_and_compile(cfg, mesh, gbs, seq):
             "tokens": jax.ShapeDtypeStruct((gbs, seq), jnp.int32),
             "labels": jax.ShapeDtypeStruct((gbs, seq), jnp.int32),
             "loss_mask": jax.ShapeDtypeStruct((gbs, seq), jnp.float32),
+            **(extra_batch or {}),
         }
         lowered = step.lower(params_abs, opt_abs, batch,
                              jax.ShapeDtypeStruct((), jnp.int32))
@@ -98,6 +99,41 @@ def test_aot_1f1b_vpp_nested_shard_map_composes():
     cfg.parallel.recompute_granularity = "full"
     cfg.finalize()
     _lowered, compiled = _lower_and_compile(cfg, mesh, 8, 256)
+    assert compiled.memory_analysis().argument_size_in_bytes > 0
+
+
+def test_aot_striped_zigzag_ring_compiles():
+    """The striped (zigzag) flash ring composes with the FULL jitted train
+    step for a TPU target: cp2 + cp_zigzag + a token_idx batch must lower
+    the half-chunk Mosaic kernels (the CPU dryrun can only exercise the
+    jnp fallback — dispatch is TPU-target-only)."""
+    from megatron_llm_tpu.core.parallel_state import build_mesh
+    from megatron_llm_tpu.models import make_config
+    from megatron_llm_tpu.parallel.ring import zigzag_permutation
+
+    devices = _topo_devices("v5e:2x4")
+    mesh = build_mesh(tensor_model_parallel_size=2, context_parallel_size=2,
+                      data_parallel_size=2, devices=devices)
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=512, num_attention_heads=8,
+        num_attention_heads_kv=8, ffn_hidden_size=1024, vocab_size=4096,
+        seq_length=1024, max_position_embeddings=1024,
+        params_dtype="bfloat16",
+        tensor_model_parallel_size=2, context_parallel_size=2,
+        sequence_parallel=True, use_distributed_optimizer=True,
+        micro_batch_size=1, global_batch_size=2, train_iters=10)
+    cfg.parallel.data_parallel_size = 2
+    cfg.parallel.num_micro_batches = 1
+    cfg.parallel.cp_zigzag = True
+    cfg.finalize()
+    gbs, s = 2, 1024
+    lowered, compiled = _lower_and_compile(cfg, mesh, gbs, s, extra_batch={
+        "position_ids": jax.ShapeDtypeStruct((gbs, s), jnp.int32),
+        "token_idx": jax.ShapeDtypeStruct(
+            zigzag_permutation(s, 2).shape, jnp.int32),
+    })
+    assert lowered.as_text().count("tpu_custom_call") > 0, (
+        "striped ring must lower Mosaic kernels, not the jnp fallback")
     assert compiled.memory_analysis().argument_size_in_bytes > 0
 
 
