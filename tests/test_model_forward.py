@@ -166,3 +166,36 @@ def test_remat_policies_compile_and_train(granularity, policy):
             first = float(loss)
         last = float(loss)
     assert np.isfinite(last) and last < first
+
+
+def test_matmul_backward_embedding_matches_take_vjp():
+    """models/language_model.py:_take_rows_matmul_bwd — the pp-path
+    embedding whose backward is a one-hot matmul instead of the take
+    transpose's scatter-add (the round-5 partitioner-crash fix). Gradients
+    must match jnp.take's vjp on BOTH the single-matmul path (small n)
+    and the token-chunked path (n > 4096, incl. a non-4096-divisible n
+    that must pick the largest fitting divisor, not fall back to one
+    unbounded one-hot)."""
+    import numpy as np
+
+    from megatron_llm_tpu.models.language_model import _take_rows_matmul_bwd
+
+    vocab, h = 512, 16
+    table = jax.random.normal(jax.random.PRNGKey(0), (vocab, h))
+
+    for shape in [(2, 64),        # single matmul
+                  (2, 4096),      # n=8192: exact 4096 chunks
+                  (2, 2304)]:     # n=4608: largest divisor <= 4096 is 2304
+        ids = jax.random.randint(jax.random.PRNGKey(1), shape, 0, vocab)
+        take = _take_rows_matmul_bwd(vocab, 4096, str(table.dtype))
+
+        def loss_mm(t):
+            return (take(t, ids).astype(jnp.float32) ** 2).sum()
+
+        def loss_ref(t):
+            return (jnp.take(t, ids, axis=0).astype(jnp.float32) ** 2).sum()
+
+        g_mm = jax.grad(loss_mm)(table)
+        g_ref = jax.grad(loss_ref)(table)
+        np.testing.assert_allclose(np.asarray(g_mm), np.asarray(g_ref),
+                                   atol=2e-4, rtol=2e-4)
