@@ -156,13 +156,34 @@ def _take_rows_matmul_bwd(rows: int, chunk: int, table_dtype: str):
     return take
 
 
+# Bound on the [chunk, rows] one-hot transient in the matmul backward of
+# the 1F1B embedding path (sized for the fp32 worst case regardless of
+# table dtype — the transient is built in the COTANGENT dtype, which a
+# generic caller may keep wider than the table): chunk 512 at vocab 32k,
+# 128 at 128k. 64 MiB keeps the per-tick bwd transient small next to the
+# full-logits footprint the pipelined CE is certified against
+# (tests/test_pipeline.py::test_gpipe_ce_memory_bounded) while still
+# giving the MXU large tiles.
+_EMBED_BWD_ONE_HOT_CAP_BYTES = 64 * 2 ** 20
+
+
 def _embed_take(cfg, table: jax.Array, ids: jax.Array) -> jax.Array:
-    """Embedding-table row lookup; under pipeline parallelism the gradient
-    is the matmul form (see :func:`_take_rows_matmul_bwd` — the scatter-add
-    would sit inside the pp shard_map's tick loop)."""
-    if cfg.parallel.pipeline_model_parallel_size > 1:
-        return _take_rows_matmul_bwd(
-            table.shape[0], 4096, str(table.dtype))(table, ids)
+    """Embedding-table row lookup.
+
+    Under the 1F1B schedules the gradient is the matmul form
+    (:func:`_take_rows_matmul_bwd`): their per-tick vjp puts the take
+    transpose's scatter-add inside the pp shard_map's tick loop, where
+    XLA's scatter partitioner CHECK-crashes (the round-4 pp x dp>1 x tp>1
+    blocker). GPipe keeps the plain take/scatter — its whole-batch
+    embedding sits outside the tick loop, partitions fine (verified by
+    the round-5 bisection), and the scatter is cheaper in memory than
+    even a chunked one-hot."""
+    if (cfg.parallel.pipeline_model_parallel_size > 1
+            and cfg.parallel.pipeline_schedule != "gpipe"):
+        rows = table.shape[0]
+        c = max(128, _EMBED_BWD_ONE_HOT_CAP_BYTES // (rows * 4))
+        c = 1 << (int(c).bit_length() - 1)  # power of two: stable divisors
+        return _take_rows_matmul_bwd(rows, c, str(table.dtype))(table, ids)
     return jnp.take(table, ids, axis=0)
 
 

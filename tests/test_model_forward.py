@@ -168,6 +168,46 @@ def test_remat_policies_compile_and_train(granularity, policy):
     assert np.isfinite(last) and last < first
 
 
+def test_embed_take_dispatch_and_chunk_policy(monkeypatch):
+    """models/language_model.py:_embed_take — the schedule branch and the
+    chunk-cap arithmetic: GPipe (whole-batch embed outside the tick loop)
+    keeps the plain take/scatter; the 1F1B schedules get the matmul
+    backward with a power-of-two chunk bounding the fp32 one-hot
+    transient at 64 MiB."""
+    import megatron_llm_tpu.models.language_model as lm
+
+    calls = []
+    real = lm._take_rows_matmul_bwd
+
+    def spy(rows, chunk, dt):
+        calls.append((rows, chunk))
+        return real(rows, chunk, dt)
+
+    monkeypatch.setattr(lm, "_take_rows_matmul_bwd", spy)
+    table = jnp.zeros((32000, 8), jnp.float32)
+    ids = jnp.zeros((2, 16), jnp.int32)
+
+    def cfg_for(pp, schedule):
+        cfg = make_config(
+            "llama2", num_layers=2, hidden_size=32, num_attention_heads=2,
+            num_attention_heads_kv=2, vocab_size=256,
+            pipeline_model_parallel_size=pp, use_flash_attn=False)
+        cfg.parallel.pipeline_schedule = schedule
+        return cfg
+
+    lm._embed_take(cfg_for(1, "1f1b"), table, ids)
+    assert not calls  # pp=1: plain take
+    lm._embed_take(cfg_for(2, "gpipe"), table, ids)
+    assert not calls  # GPipe: plain take (scatter partitions fine there)
+    lm._embed_take(cfg_for(2, "1f1b"), table, ids)
+    # 64 MiB / (32000 rows * 4 B) = 524 -> power-of-two floor 512
+    assert calls == [(32000, 512)]
+    calls.clear()
+    big = jnp.zeros((131072, 8), jnp.bfloat16)  # 128k vocab: fp32-sized cap
+    lm._embed_take(cfg_for(2, "1f1b"), big, ids)
+    assert calls == [(131072, 128)]
+
+
 def test_matmul_backward_embedding_matches_take_vjp():
     """models/language_model.py:_take_rows_matmul_bwd — the pp-path
     embedding whose backward is a one-hot matmul instead of the take
