@@ -1,0 +1,215 @@
+"""Continuous-batching engine benchmark — prints ONE JSON line for the driver.
+
+Metric: decode tokens/sec of the paged-KV continuous-batching engine
+(generation/engine.py) at full occupancy (8 concurrent requests), on the
+470M bench model.  Rows sweep occupancy (1 / 4 / 8 concurrent requests) and
+report per-tick latency alongside throughput; every row also times the
+SEQUENTIAL per-request dense path (generation.generate_tokens, one call per
+request — the legacy server shape) on the same requests, so
+``speedup_vs_sequential`` is an apples-to-apples continuous-batching win on
+identical hardware and weights.
+
+Acceptance gate (ISSUE 1): at 8 concurrent requests the engine is >= 3x the
+sequential path — on CPU (where the sanity shape runs in tier-1 time) and a
+fortiori on TPU, where the fused tick amortizes far better.
+
+Same tunnel-hardening contract as bench.py: backend probed in a bounded
+subprocess; off-TPU the headline is 0 with the run riding under
+``cpu_sanity`` (a CPU timing is not a TPU measurement); TPU measurements
+persist to ``BENCH_LAST_TPU_engine_decode.json``; a watchdog turns hangs
+into structured error lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import (  # noqa: E402
+    cpu_contract_line,
+    persist_tpu_result,
+    probe_backend,
+)
+
+METRIC = "engine_decode_tok_s_llama470m_c8_1chip"
+
+
+def _requests(num: int, prompt: int, gen: int, vocab: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, vocab, prompt)]
+            for _ in range(num)]
+
+
+def bench_engine(cfg, params, concurrency: int, prompt: int, gen: int,
+                 vocab: int, reps: int) -> dict:
+    """Engine throughput at one occupancy level vs the sequential path."""
+    import jax
+    import numpy as np
+
+    from megatron_llm_tpu.generation import (
+        ContinuousBatchingEngine,
+        generate_tokens,
+    )
+
+    prompts = _requests(concurrency, prompt, gen, vocab)
+
+    def run_engine():
+        eng = ContinuousBatchingEngine(
+            cfg, params, None, max_slots=max(concurrency, 1),
+            max_seq=prompt + gen)
+        reqs = [eng.submit(p, gen, top_k=1, termination_id=0,
+                           use_eod_for_termination=False) for p in prompts]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=600)
+        return eng
+
+    # warm the compile caches (prefill bucket + tick), then time
+    run_engine()
+    best = float("inf")
+    ticks = 0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        eng = run_engine()
+        dt = time.perf_counter() - t0
+        if dt < best:
+            best, ticks = dt, eng.ticks
+
+    # sequential baseline: one dense generate_tokens call per request
+    # (compile once on the first call, timing from the second rep)
+    S = prompt + gen
+    def run_sequential():
+        for p in prompts:
+            tokens = np.zeros((1, S), np.int32)
+            tokens[0, :prompt] = p
+            r = generate_tokens(
+                cfg, params, tokens, np.asarray([prompt], np.int32), S,
+                prefill_len=prompt, termination_id=0,
+                sample_key=jax.random.PRNGKey(0), top_k=1,
+                use_eod_for_termination=False)
+            jax.block_until_ready(r.tokens)
+
+    run_sequential()
+    seq_best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_sequential()
+        seq_best = min(seq_best, time.perf_counter() - t0)
+
+    total_tokens = concurrency * gen
+    return {
+        "concurrency": concurrency,
+        "prompt_len": prompt,
+        "gen_len": gen,
+        "engine_s": round(best, 4),
+        "engine_tok_s": round(total_tokens / best, 1),
+        "tick_ms": round(best / max(ticks, 1) * 1e3, 3),
+        "ticks": ticks,
+        "sequential_s": round(seq_best, 4),
+        "sequential_tok_s": round(total_tokens / seq_best, 1),
+        "speedup_vs_sequential": round(seq_best / best, 2),
+    }
+
+
+def _run(args, finished):
+    layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
+    levels = [int(x) for x in args.concurrency.split(",")]
+    if probe_backend(args.probe_timeout) == "cpu":
+        from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+        # CPU sanity shape: small enough for tier-1 time, big enough that
+        # the >=3x batching gate is a real measurement, not noise
+        layers, args.prompt, args.gen, args.reps = 2, 32, 24, 1
+        hidden, heads, ffn, vocab = 256, 4, 512, 1024
+
+    import jax
+
+    from megatron_llm_tpu.core.parallel_state import build_mesh, global_mesh
+    from megatron_llm_tpu.models import init_model_params, make_config
+
+    cfg = make_config(
+        "llama2", num_layers=layers, hidden_size=hidden,
+        num_attention_heads=heads, num_attention_heads_kv=heads,
+        ffn_hidden_size=ffn, vocab_size=vocab,
+        seq_length=max(2048, args.prompt + args.gen),
+        max_position_embeddings=max(2048, args.prompt + args.gen),
+        params_dtype="bfloat16" if jax.default_backend() != "cpu"
+        else "float32",
+        micro_batch_size=1, global_batch_size=1, train_iters=1,
+    )
+    mesh = build_mesh(devices=jax.devices()[:1])
+    with global_mesh(mesh):
+        params = init_model_params(cfg, jax.random.PRNGKey(0))
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        rows = [bench_engine(cfg, params, c, args.prompt, args.gen, vocab,
+                             args.reps) for c in levels]
+
+    headline = rows[-1]
+    result = {
+        "metric": METRIC.replace(
+            "_c8_", f"_c{headline['concurrency']}_"),
+        "value": headline["engine_tok_s"],
+        "unit": "tok/s",
+        "speedup_vs_sequential": headline["speedup_vs_sequential"],
+        "n_params": n_params,
+        "rows": rows,
+        "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    if result["backend"] != "cpu":
+        persist_tpu_result(result, vars(args), tag="engine_decode")
+    else:
+        result = cpu_contract_line(result, tag="engine_decode")
+    finished.set()
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--concurrency", default="1,4,8",
+                    help="comma-separated occupancy levels (requests)")
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--gen", type=int, default=128)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--watchdog", type=float, default=1500.0)
+    args = ap.parse_args()
+
+    finished = threading.Event()
+
+    def on_timeout():
+        if finished.is_set():
+            return
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "tok/s",
+            "error": f"watchdog: engine decode bench exceeded "
+                     f"{args.watchdog}s",
+        }), flush=True)
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    try:
+        _run(args, finished)
+    except Exception as e:  # structured error line, never a bare traceback
+        finished.set()
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "tok/s",
+            "error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -349,6 +349,13 @@ class InferenceConfig:
     # weight-only int8 for decode (ops/quant.py): transformer-layer linears
     # stored int8 in HBM, dequantized inside the GEMM — inference only
     int8_weights: bool = False
+    # continuous-batching engine (generation/engine.py): decode slots per
+    # tick, KV page granularity, pool size (None = slots * pages_per_seq
+    # + 1 null page), and the per-sequence length cap (None = seq_length)
+    max_batch_slots: int = 8
+    page_size: int = 16
+    kv_pool_pages: Optional[int] = None
+    engine_max_seq: Optional[int] = None
 
 
 @dataclass

@@ -1,17 +1,27 @@
-"""Text generation — megatron/text_generation analog."""
+"""Text generation — megatron/text_generation analog, plus the
+continuous-batching serving engine (generation/engine.py)."""
 
 from megatron_llm_tpu.generation.api import InferenceEngine
+from megatron_llm_tpu.generation.engine import (
+    ContinuousBatchingEngine,
+    EngineRequest,
+    PagedKVPool,
+)
 from megatron_llm_tpu.generation.generation import (
     beam_search,
     generate_tokens,
     score_tokens,
 )
-from megatron_llm_tpu.generation.sampling import sample
+from megatron_llm_tpu.generation.sampling import sample, sample_per_slot
 
 __all__ = [
+    "ContinuousBatchingEngine",
+    "EngineRequest",
     "InferenceEngine",
+    "PagedKVPool",
     "beam_search",
     "generate_tokens",
-    "score_tokens",
     "sample",
+    "sample_per_slot",
+    "score_tokens",
 ]

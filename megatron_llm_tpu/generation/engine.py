@@ -1,0 +1,583 @@
+"""Continuous-batching decode engine on a paged KV cache.
+
+The legacy serving shape (generation/api.InferenceEngine) is the paper's:
+one request at a time, a dense ``[L, b, max_seq, nkv, d]`` cache allocated
+per call, and a program compiled per (batch, max_seq) bucket.  This engine
+is the TPU-serving shape the Ragged-Paged-Attention and Gemma-on-Cloud-TPU
+studies (PAPERS.md) converge on: keep ONE fixed-shape decode program
+resident and keep its batch full.
+
+* **Paged KV pool** (:class:`PagedKVPool`): all in-flight sequences share a
+  ``[L, num_pages, page_size, nkv, d]`` pool; a sequence owns an ordered
+  page list (its block table).  Admission allocates the full page budget
+  ``ceil(min(prompt+max_new, max_seq)/page_size)`` up front — no mid-flight
+  preemption — and frees it the moment the request finishes, so short
+  requests return pages while long ones keep decoding.  Page 0 is the
+  reserved *null page*: idle slots' block tables point at it and their
+  writes land there, never attended.
+
+* **Slots + fixed shapes**: the decode tick runs ``max_slots`` rows every
+  time, active or not.  Block tables, positions, per-slot sampling params
+  and per-slot PRNG keys are *traced* inputs, so the tick compiles ONCE;
+  prefill compiles once per prompt-length bucket (BUCKET multiples, same
+  policy as generation/api.py).  Off-by-default slots cost one row of
+  wasted FLOPs — the price of never recompiling.
+
+* **Scheduler**: ``submit`` enqueues; admission fills free slots whenever
+  slots+pages allow (FCFS).  A prefill runs the prompt through the dense
+  cache path once (no logits head — ``logits_postprocess=False``) and
+  scatters the resulting K/V into the request's pages; the slot then joins
+  the shared per-tick decode.  The first generated token is sampled by the
+  slot's first tick, which re-feeds the last prompt token at position
+  ``prompt_len - 1`` (rewriting that K/V entry with identical values), so
+  every sampled token flows through the same tick program.
+
+* **Decode tick**: one fused jitted step — embed [slots, 1] tokens, write
+  each row's K/V into its current page, paged attention over block tables
+  (Pallas kernel on TPU, jnp gather fallback elsewhere —
+  ops/paged_attention.py), per-slot sampling (sampling.sample_per_slot),
+  token log-probs.  Pool buffers are donated, so the cache updates in
+  place.
+
+Threading: ``submit`` may be called from any thread (e.g. concurrent HTTP
+handlers — generation/server.py); device work happens on whichever thread
+drives :meth:`step`, either the built-in background loop (:meth:`start`) or
+a caller loop (:meth:`run_until_idle`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.generation import generation as gen
+from megatron_llm_tpu.generation.sampling import sample_per_slot
+from megatron_llm_tpu.generation.tokenization import detokenize_generations
+from megatron_llm_tpu.models.language_model import (
+    _compute_dtype,
+    make_rope_cache,
+    model_forward,
+)
+from megatron_llm_tpu.ops.paged_attention import PagedState
+
+NULL_PAGE = 0
+
+
+def _bucket_up(n: int, bucket: int = gen.BUCKET) -> int:
+    return -(-n // bucket) * bucket
+
+
+class PagedKVPool:
+    """Device page pool + host free-list allocator.
+
+    The device arrays are plain stacked pytrees ``[L, P, page, nkv, d]``
+    (scanned over L exactly like the dense cache); the allocator is
+    host-side python — alloc/free happen at request admission/retirement,
+    thousands of times below tick frequency.
+    """
+
+    def __init__(self, cfg, num_pages: int, page_size: int, dtype=None):
+        m = cfg.model
+        dtype = dtype or _compute_dtype(cfg)
+        shape = (m.num_layers, num_pages, page_size,
+                 m.num_attention_heads_kv, m.kv_channels)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # page 0 reserved as the null page (never allocated)
+        self._free: deque = deque(range(1, num_pages))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None if the pool can't satisfy the request."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert p != NULL_PAGE, "null page is never allocated"
+            self._free.append(p)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One in-flight generation; ``result()`` blocks until finished."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    termination_id: Optional[int] = None
+    use_eod_for_termination: bool = True
+    stop_on_double_eol: bool = False
+    stop_on_eol: bool = False
+    seed: Optional[int] = None
+    return_log_probs: bool = False
+
+    # engine-filled state
+    generated: List[int] = dataclasses.field(default_factory=list)
+    log_probs: List[float] = dataclasses.field(default_factory=list)
+    prompt_log_probs: Optional[List[float]] = None
+    finished: bool = False
+    error: Optional[str] = None
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False)
+    _pages: List[int] = dataclasses.field(default_factory=list, repr=False)
+    _step: int = 0  # decode ticks taken (== len(generated))
+
+    def result(self, timeout: Optional[float] = None):
+        """Wait for completion; returns (full token list, gen log-probs)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error:
+            raise RuntimeError(self.error)
+        return list(self.prompt) + self.generated, list(self.log_probs)
+
+
+class ContinuousBatchingEngine:
+    """Shared-tick decode over a paged pool; the serving tentpole."""
+
+    def __init__(self, cfg, params, tokenizer=None, *,
+                 max_slots: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_seq: Optional[int] = None):
+        inf = cfg.inference
+        self.cfg = cfg
+        if inf.int8_weights:
+            # same decode-weight quantization contract as api.InferenceEngine
+            from megatron_llm_tpu.ops.quant import quantize_layer_weights_int8
+
+            params = quantize_layer_weights_int8(params)
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_slots = max_slots or inf.max_batch_slots
+        self.page_size = page_size or inf.page_size
+        self.max_seq = (max_seq or inf.engine_max_seq
+                        or min(cfg.data.seq_length,
+                               cfg.model.max_position_embeddings))
+        assert self.max_seq <= cfg.model.max_position_embeddings
+        assert gen.BUCKET % self.page_size == 0, (
+            "page_size must divide the prefill bucket so bucketed prefills "
+            "scatter whole pages")
+        self.pages_per_seq = -(-self.max_seq // self.page_size)
+        num_pages = (num_pages or inf.kv_pool_pages
+                     or self.max_slots * self.pages_per_seq + 1)
+        self.pool = PagedKVPool(cfg, num_pages, self.page_size)
+
+        s = self.max_slots
+        self._block_tables = np.zeros((s, self.pages_per_seq), np.int32)
+        self._positions = np.zeros((s,), np.int32)
+        self._tokens = np.zeros((s,), np.int32)
+        self._temperature = np.ones((s,), np.float32)
+        self._top_k = np.ones((s,), np.int32)  # idle slots decode greedy
+        self._top_p = np.zeros((s,), np.float32)
+        self._keys = np.zeros((s, 2), np.uint32)
+        self._steps = np.zeros((s,), np.int32)
+        self._slots: List[Optional[EngineRequest]] = [None] * s
+
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        # serializes device-driving (step) across caller threads; state
+        # mutation is under _lock, device dispatch under _drive_lock
+        self._drive_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+
+        self._tick_fn = None
+        self._prefill_fns: Dict[Tuple[int, bool], object] = {}
+        # device mirror of the per-slot arrays; rebuilt from the host copies
+        # whenever admission/retirement changes the slot layout
+        self._dev_state: Optional[Tuple] = None
+        self._dirty = True
+        # tick telemetry for the decode bench
+        self.ticks = 0
+        self.ticked_tokens = 0
+
+    # -- compiled programs -------------------------------------------------
+
+    def _tick(self):
+        """The fused decode-tick program, compiled once per (config, engine
+        geometry) — shared ACROSS engine instances via the fingerprint-keyed
+        generation cache, so rebuilding an engine never recompiles."""
+        if self._tick_fn is not None:
+            return self._tick_fn
+        cfg = self.cfg
+        m = cfg.model
+
+        def tick(params, pool_k, pool_v, block_tables, positions, tokens,
+                 req_keys, steps, temperature, top_k, top_p):
+            rope = make_rope_cache(cfg)
+            logits, (pool_k, pool_v) = model_forward(
+                cfg, params, tokens[:, None],
+                position_ids=positions[:, None],
+                rope_cache=rope, kv_caches=(pool_k, pool_v),
+                paged=PagedState(block_tables, positions),
+            )
+            last = logits[:, -1]
+            keys = jax.vmap(jax.random.fold_in)(req_keys, steps)
+            next_tok = sample_per_slot(
+                keys, last, top_k=top_k, top_p=top_p,
+                temperature=temperature, vocab_size=m.vocab_size)
+            logp = gen._gather_token_log_probs(last, next_tok)
+            # advance the device-resident slot state in-program so steady
+            # ticks need no host->device uploads (step() re-uploads from the
+            # host copy only after admit/retire dirties the layout)
+            return (pool_k, pool_v, next_tok, logp,
+                    positions + 1, steps + 1)
+
+        statics = ("engine_tick", self.max_slots, self.pages_per_seq,
+                   self.page_size, self.pool.num_pages, str(self.pool.k.dtype))
+        self._tick_fn = gen.cached_jit(
+            self.cfg, "engine_tick", statics, lambda: tick,
+            donate_argnums=(1, 2))
+        return self._tick_fn
+
+    def _prefill(self, s_pre: int, with_log_probs: bool):
+        key = (s_pre, with_log_probs)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        L = cfg.model.num_layers
+        nkv, d = cfg.model.num_attention_heads_kv, cfg.model.kv_channels
+        page = self.page_size
+        npg = s_pre // page
+
+        def prefill(params, tokens, pool_k, pool_v, page_ids):
+            caches = gen.init_kv_caches(cfg, 1, s_pre, pool_k.dtype)
+            out, (ck, cv) = model_forward(
+                cfg, params, tokens,
+                position_ids=jnp.arange(s_pre)[None, :],
+                rope_cache=make_rope_cache(cfg),
+                kv_caches=caches, cache_index=jnp.int32(0),
+                logits_postprocess=with_log_probs,
+            )
+            pages_k = ck.reshape(L, npg, page, nkv, d)
+            pages_v = cv.reshape(L, npg, page, nkv, d)
+            pool_k = pool_k.at[:, page_ids].set(pages_k)
+            pool_v = pool_v.at[:, page_ids].set(pages_v)
+            if with_log_probs:
+                # teacher-forced prompt log-probs (api logprobs contract)
+                lp = gen._gather_token_log_probs(out[:, :-1], tokens[:, 1:])
+                return pool_k, pool_v, lp[0]
+            return pool_k, pool_v
+
+        statics = (s_pre, with_log_probs, self.page_size,
+                   self.pool.num_pages, str(self.pool.k.dtype))
+        fn = gen.cached_jit(self.cfg, "engine_prefill", statics,
+                            lambda: prefill, donate_argnums=(2, 3))
+        self._prefill_fns[key] = fn
+        return fn
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               **kw) -> EngineRequest:
+        """Enqueue a generation; returns the request future.
+
+        Raises ValueError for requests that can never fit (the legacy
+        engine's request-size guard, generation/api._check_limits)."""
+        prompt = [int(t) for t in prompt]
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_seq:
+            raise ValueError(
+                "Length of prompt + tokens_to_generate longer than allowed")
+        req = EngineRequest(prompt=prompt, max_new_tokens=max_new_tokens, **kw)
+        with self._work:
+            self._queue.append(req)
+            self._work.notify()
+        return req
+
+    def _pages_needed(self, req: EngineRequest) -> int:
+        total = min(len(req.prompt) + req.max_new_tokens, self.max_seq)
+        return -(-total // self.page_size)
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots while slots+pages allow.
+
+        FCFS admission: blocks behind the queue head rather than starving
+        large requests (pages for the whole request are reserved here, so an
+        admitted request can always run to its budget)."""
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                try:
+                    slot = self._slots.index(None)
+                except ValueError:
+                    return
+                req = self._queue[0]
+                pages = self.pool.alloc(self._pages_needed(req))
+                if pages is None:
+                    return
+                self._queue.popleft()
+            try:
+                self._place(req, slot, pages)
+            except Exception as e:  # noqa: BLE001 — surface to the waiter
+                self.pool.free(pages)
+                req.error = f"{type(e).__name__}: {e}"
+                req.finished = True
+                req._done.set()
+
+    def _place(self, req: EngineRequest, slot: int, pages: List[int]) -> None:
+        """Prefill the prompt into ``pages`` and activate the slot."""
+        prompt_len = len(req.prompt)
+        s_pre = min(_bucket_up(prompt_len), _bucket_up(self.max_seq))
+        tokens = np.zeros((1, s_pre), np.int32)
+        tokens[0, :prompt_len] = req.prompt
+        # pages for the bucket-padded tail beyond the request's budget route
+        # to the null page; decode overwrites in-budget positions one by one
+        page_ids = np.full((s_pre // self.page_size,), NULL_PAGE, np.int32)
+        n = min(len(pages), len(page_ids))
+        page_ids[:n] = pages[:n]
+
+        out = self._prefill(s_pre, req.return_log_probs)(
+            self.params, jnp.asarray(tokens), self.pool.k, self.pool.v,
+            jnp.asarray(page_ids))
+        if req.return_log_probs:
+            self.pool.k, self.pool.v, prompt_lp = out
+            req.prompt_log_probs = [
+                float(x) for x in np.asarray(prompt_lp)[: prompt_len - 1]]
+        else:
+            self.pool.k, self.pool.v = out
+
+        seed = req.seed
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+
+        with self._lock:
+            req._pages = pages
+            self._slots[slot] = req
+            bt = np.full((self.pages_per_seq,), NULL_PAGE, np.int32)
+            bt[: len(pages)] = pages
+            self._block_tables[slot] = bt
+            # first tick re-feeds the last prompt token at prompt_len-1:
+            # identical K/V rewrite, and the tick samples generated token #1
+            self._positions[slot] = prompt_len - 1
+            self._tokens[slot] = req.prompt[-1]
+            self._temperature[slot] = req.temperature
+            self._top_k[slot] = req.top_k
+            self._top_p[slot] = req.top_p
+            self._keys[slot] = key
+            self._steps[slot] = 0
+            self._dirty = True
+
+    def _retire(self, slot: int) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._block_tables[slot] = NULL_PAGE
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self._top_k[slot] = 1
+        self._top_p[slot] = 0.0
+        self._temperature[slot] = 1.0
+        pages, req._pages = req._pages, []
+        self.pool.free(pages)
+        self._dirty = True
+        req.finished = True
+        req._done.set()
+
+    def _stopped_by_token(self, req: EngineRequest, tok: int) -> bool:
+        if req.stop_on_double_eol:
+            prev = (req.generated[-2] if len(req.generated) > 1
+                    else req.prompt[-1])
+            return tok == gen.GPT2_DOUBLE_EOL or (
+                tok == gen.GPT2_EOL and prev == gen.GPT2_EOL)
+        if req.stop_on_eol:
+            return tok in (gen.GPT2_EOL, gen.GPT2_DOUBLE_EOL)
+        if not req.use_eod_for_termination or req.termination_id is None:
+            return False
+        return tok == req.termination_id
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> int:
+        """Admit what fits, run one fused decode tick over every slot, and
+        retire finished requests.  Returns the number of active slots the
+        tick advanced (0 = idle, nothing ran).  Call from one driver at a
+        time (:meth:`run_until_idle` / the background loop serialize via
+        ``_drive_lock``)."""
+        self._admit()
+        with self._lock:
+            active = [i for i, r in enumerate(self._slots) if r is not None]
+            if not active:
+                return 0
+            if self._dirty:
+                self._dev_state = (jnp.asarray(self._block_tables),
+                                   jnp.asarray(self._positions),
+                                   jnp.asarray(self._tokens),
+                                   jnp.asarray(self._keys),
+                                   jnp.asarray(self._steps),
+                                   jnp.asarray(self._temperature),
+                                   jnp.asarray(self._top_k),
+                                   jnp.asarray(self._top_p))
+                self._dirty = False
+            bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
+
+        (self.pool.k, self.pool.v, next_tok, logp,
+         new_pos, new_steps) = self._tick()(
+            self.params, self.pool.k, self.pool.v,
+            bt, pos, toks, keys, steps, temp, tk, tp)
+        next_np = np.asarray(next_tok)
+        logp_np = np.asarray(logp)
+
+        with self._lock:
+            if not self._dirty:
+                # steady state: the tick already advanced the device mirror
+                self._dev_state = (bt, new_pos, next_tok, keys, new_steps,
+                                   temp, tk, tp)
+            self.ticks += 1
+            self.ticked_tokens += len(active)
+            for i in active:
+                req = self._slots[i]
+                tok = int(next_np[i])
+                req.generated.append(tok)
+                req.log_probs.append(float(logp_np[i]))
+                req._step += 1
+                self._positions[i] += 1
+                self._tokens[i] = tok
+                self._steps[i] += 1
+                done = (self._stopped_by_token(req, tok)
+                        or len(req.generated) >= req.max_new_tokens
+                        or len(req.prompt) + len(req.generated) >= self.max_seq)
+                if done:
+                    self._retire(i)
+        return len(active)
+
+    def run_until_idle(self) -> None:
+        """Drive ticks on the calling thread until queue and slots drain.
+        Safe under concurrent callers: one drives at a time, the rest take
+        over as the lock frees (their requests are served either way)."""
+        while True:
+            with self._drive_lock:
+                n = self.step()
+            if n == 0:
+                with self._lock:
+                    if not self._queue and all(
+                            r is None for r in self._slots):
+                        return
+
+    # -- background scheduler ---------------------------------------------
+
+    def start(self) -> None:
+        """Run the scheduler loop in a daemon thread (server mode)."""
+        if self._thread is not None:
+            return
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._work:
+            self._stopping = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while (not self._stopping and not self._queue
+                       and all(r is None for r in self._slots)):
+                    self._work.wait()
+                if self._stopping:
+                    return
+            with self._drive_lock:
+                self.step()
+
+    # -- server-facing API (api.InferenceEngine surface) -------------------
+
+    def generate_and_post_process(
+        self,
+        prompts: Sequence[str],
+        tokens_to_generate: int = 0,
+        return_output_log_probs: bool = False,
+        top_k_sampling: int = 0,
+        top_p_sampling: float = 0.0,
+        temperature: float = 1.0,
+        add_BOS: bool = False,
+        use_eod_token_for_early_termination: bool = True,
+        stop_on_double_eol: bool = False,
+        stop_on_eol: bool = False,
+        random_seed: int = -1,
+    ):
+        """Drop-in for api.generate_and_post_process: tokenize, submit each
+        prompt as its own request (all of them share decode ticks), wait,
+        detokenize.  ``tokens_to_generate == 0`` (scoring mode) delegates to
+        the dense-path scorer."""
+        tok = self.tokenizer
+        if tokens_to_generate == 0:
+            return self._legacy().generate_and_post_process(
+                prompts, 0, return_output_log_probs=True, add_BOS=add_BOS)
+
+        termination_id = getattr(self.cfg.model, "eos_id", None) or tok.eod
+        bos = getattr(tok, "bos_token_id", None) or getattr(tok, "bos", None)
+        reqs = []
+        for i, prompt in enumerate(prompts):
+            ids = tok.tokenize(prompt)
+            if add_BOS:
+                ids = [bos if bos is not None else tok.eod] + ids
+            reqs.append(self.submit(
+                ids, tokens_to_generate,
+                temperature=temperature, top_k=top_k_sampling,
+                top_p=top_p_sampling, termination_id=termination_id,
+                use_eod_for_termination=use_eod_token_for_early_termination,
+                stop_on_double_eol=stop_on_double_eol,
+                stop_on_eol=stop_on_eol,
+                seed=None if random_seed == -1 else random_seed + i,
+                return_log_probs=return_output_log_probs,
+            ))
+        if self._thread is None:
+            self.run_until_idle()
+        rows = [r.result(timeout=600) for r in reqs]
+
+        lengths = [len(t) for t, _ in rows]
+        width = max(lengths)
+        tokens = np.zeros((len(rows), width), np.int32)
+        for i, (t, _) in enumerate(rows):
+            tokens[i, : len(t)] = t
+        tokens, texts, segments = detokenize_generations(
+            tok, tokens, np.asarray(lengths), True)
+        if return_output_log_probs:
+            log_probs = [
+                (r.prompt_log_probs or []) + r.log_probs for r in reqs]
+            log_probs = [
+                lp[: len(seg) - 1] for lp, seg in zip(log_probs, segments)]
+        else:
+            log_probs = None
+        return texts, segments, log_probs, tokens
+
+    def _legacy(self):
+        """A dense-path InferenceEngine view over the SAME (already
+        quantized) params — bypasses __init__ so int8 weights are not
+        re-quantized."""
+        from megatron_llm_tpu.generation.api import InferenceEngine
+
+        legacy = InferenceEngine.__new__(InferenceEngine)
+        legacy.cfg, legacy.params, legacy.tokenizer = (
+            self.cfg, self.params, self.tokenizer)
+        return legacy
+
+    def beam_search_and_post_process(self, *args, **kw):
+        """Beam search stays on the dense single-stream path (api.py)."""
+        return self._legacy().beam_search_and_post_process(*args, **kw)

@@ -43,19 +43,41 @@ BUCKET = 64
 GPT2_EOL = 198
 GPT2_DOUBLE_EOL = 628
 
-# compiled-program cache: (id(cfg), fn name, static arg tuple) -> (cfg, fn).
-# The entry pins ``cfg`` strongly, so its id() can never be reused by a new
-# config while the cached program exists.
-_JIT_CACHE: Dict[Tuple, Tuple[Any, Any]] = {}
+# compiled-program cache: (config fingerprint, fn name, static arg tuple)
+# -> jitted fn.  Keying on the VALUE of the config (not ``id(cfg)``) means
+# (a) a config object rebuilt with identical contents — a fresh server
+# process section, a test building the same toy config twice — reuses the
+# compiled program instead of recompiling, and (b) there is no id-recycling
+# hazard: CPython reuses a freed object's id, so an id-keyed cache can serve
+# a *different* config's program after the original is GC'd.
+_JIT_CACHE: Dict[Tuple, Any] = {}
 
 
-def cached_jit(cfg, name: str, statics: Tuple, build):
-    key = (id(cfg), name, statics)
-    entry = _JIT_CACHE.get(key)
-    if entry is None or entry[0] is not cfg:
-        entry = (cfg, jax.jit(build()))
-        _JIT_CACHE[key] = entry
-    return entry[1]
+def config_fingerprint(cfg) -> str:
+    """Stable content hash of a Config dataclass tree.
+
+    ``asdict`` flattens the nested dataclasses in deterministic field order;
+    repr covers the leaf types configs actually hold (ints, floats, strings,
+    bools, None, lists/tuples).  Two configs with equal contents fingerprint
+    identically across processes and GC cycles.
+    """
+    import dataclasses
+    import hashlib
+
+    if dataclasses.is_dataclass(cfg):
+        payload = repr(dataclasses.asdict(cfg))
+    else:  # duck-typed test doubles
+        payload = repr(sorted(vars(cfg).items()))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def cached_jit(cfg, name: str, statics: Tuple, build, **jit_kwargs):
+    key = (config_fingerprint(cfg), name, statics)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(build(), **jit_kwargs)
+        _JIT_CACHE[key] = fn
+    return fn
 
 
 def clear_jit_cache() -> None:

@@ -78,3 +78,70 @@ def sample(
         assert key is not None, "non-greedy sampling needs a PRNG key"
         samples = jax.random.categorical(key, logits, axis=-1)
     return samples.astype(jnp.int32)
+
+
+def sample_per_slot(
+    keys: jax.Array,         # [b, 2] uint32 — one PRNG key per row
+    logits: jax.Array,       # [b, v]
+    *,
+    top_k: jax.Array,        # [b] int32 (0 = off, 1 = greedy, >1 = filter)
+    top_p: jax.Array,        # [b] fp32  (0 = off; ignored where top_k acts)
+    temperature: jax.Array,  # [b] fp32  (ignored for greedy rows)
+    vocab_size: Optional[int] = None,
+) -> jax.Array:
+    """One batched sampling step with *per-row* sampling params and keys.
+
+    The continuous-batching engine decodes many requests in one tick, each
+    with its own (temperature, top_k, top_p) — so unlike :func:`sample`,
+    where the config is static and baked into the compiled program, here the
+    params are traced arrays and one program serves every mix.  Per-row keys
+    keep each request's sample stream a function of (its seed, its step
+    index) alone — independent of which slot it landed in or which other
+    requests share the tick.  Greedy rows (``top_k == 1``) reproduce
+    :func:`sample`'s greedy branch exactly: argmax over the vocab-masked
+    logits, no temperature.
+
+    Returns [b] int32 token ids.
+    """
+    assert logits.ndim == 2, "expected [b, v] logits"
+    b, v = logits.shape
+    if vocab_size and vocab_size < v:
+        logits = jnp.where(jnp.arange(v)[None, :] >= vocab_size, NEG_INF, logits)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    l32 = logits.astype(jnp.float32)
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0).astype(jnp.float32)
+    l32 = l32 / safe_temp[:, None]
+
+    def apply_filters(x):
+        # one descending sort serves both filters
+        sorted_idx = jnp.argsort(x, axis=-1)[..., ::-1]
+        sorted_logits = jnp.take_along_axis(x, sorted_idx, axis=-1)
+
+        # dynamic top-k: keep values >= the row's k-th largest
+        kth = jnp.take_along_axis(
+            sorted_logits, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)
+        l_topk = jnp.where(x < kth, NEG_INF, x)
+
+        # dynamic top-p with the shift-by-one boundary convention of
+        # modify_logits_for_top_p_filtering
+        cum_probs = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        filter_sorted = cum_probs > top_p[:, None]
+        filter_sorted = jnp.concatenate(
+            [jnp.zeros_like(filter_sorted[..., :1]), filter_sorted[..., :-1]],
+            axis=-1)
+        inv = jnp.argsort(sorted_idx, axis=-1)
+        filter_ = jnp.take_along_axis(filter_sorted, inv, axis=-1)
+        l_topp = jnp.where(filter_, NEG_INF, x)
+
+        use_k = (top_k > 1)[:, None]
+        use_p = (top_p > 0)[:, None] & ~use_k
+        return jnp.where(use_k, l_topk, jnp.where(use_p, l_topp, x))
+
+    # all-greedy / pure-temperature ticks skip the two vocab sorts entirely
+    # (the common serving mix; greedy decode bench ticks hit this branch)
+    filtered = jax.lax.cond(
+        jnp.any((top_k > 1) | (top_p > 0)), apply_filters, lambda x: x, l32)
+    sampled = jax.vmap(lambda k, row: jax.random.categorical(k, row))(
+        keys, filtered)
+    return jnp.where(top_k == 1, greedy, sampled).astype(jnp.int32)

@@ -4,12 +4,19 @@ Same wire contract (PUT /api, identical request fields/validation messages,
 ``{"text", "segments", "logprobs"}`` / ``{"text", "segments", "scores"}``
 responses, GET / serves the static UI).  Differences by design:
 
-* stdlib ``http.server`` instead of Flask (not baked into the TPU image).
+* stdlib ``http.server`` (ThreadingHTTPServer) instead of Flask (not baked
+  into the TPU image).
 * No ``send_do_generate``/``send_do_beam_search`` rank broadcasts
   (text_generation_server.py:21-27): SPMD has one controller process, so
   the server just calls the engine.
-* The request lock is kept (:14, :181): generation programs are
-  single-stream on the chip.
+* Errors are structured JSON (``{"error": msg}``) with proper status codes
+  — a malformed payload can never surface as a bare-traceback 500.
+* With the legacy dense engine the request lock serializes generations
+  (programs are single-stream on the chip).  With the continuous-batching
+  engine (generation/engine.py) the lock is NOT taken on the generate
+  path: each handler thread enqueues its request and blocks on its future,
+  so concurrent HTTP requests share decode ticks — the whole point of the
+  engine.  Beam search stays behind the lock on either engine.
 """
 
 from __future__ import annotations
@@ -116,22 +123,37 @@ def _validate(payload: dict):
     return p, None
 
 
+class _NullLock:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
 class MegatronServer:
     """text_generation_server.MegatronServer analog (:234-241)."""
 
     def __init__(self, engine):
         self.engine = engine
         self.lock = threading.Lock()
+        # continuous-batching engines serialize device access internally
+        # (enqueue + future); a server-level lock would undo the batching
+        self.batching = hasattr(engine, "submit")
         self._httpd: Optional[ThreadingHTTPServer] = None
 
-    def handle_request(self, payload: dict):
-        """Core PUT /api logic; returns (status_code, response dict-or-str)."""
+    def handle_request(self, payload):
+        """Core PUT /api logic; returns (status_code, response dict)."""
+        if not isinstance(payload, dict):
+            return 400, {"error": "request body must be a JSON object"}
         params, err = _validate(payload)
         if err:
-            return 400, err
-        with self.lock:
+            return 400, {"error": err}
+        beam = params["beam_width"] is not None
+        lock = self.lock if (beam or not self.batching) else _NullLock()
+        with lock:
             try:
-                if params["beam_width"] is not None:
+                if beam:
                     texts, segments, scores = self.engine.beam_search_and_post_process(
                         params["prompts"],
                         tokens_to_generate=params["tokens_to_generate"],
@@ -158,12 +180,12 @@ class MegatronServer:
                 return 200, {"text": texts, "segments": segments,
                              "logprobs": logprobs}
             except (ValueError, AssertionError) as ve:
-                return 400, str(ve.args[0] if ve.args else ve)
+                return 400, {"error": str(ve.args[0] if ve.args else ve)}
             except Exception as e:  # engine failure must still answer the client
                 import traceback
 
                 traceback.print_exc()
-                return 500, f"internal error: {type(e).__name__}: {e}"
+                return 500, {"error": f"internal error: {type(e).__name__}: {e}"}
 
     def _make_handler(server):  # noqa: N805 — `server` is the enclosing object
         class Handler(BaseHTTPRequestHandler):
@@ -178,36 +200,64 @@ class MegatronServer:
 
             def do_PUT(self):
                 if self.path.rstrip("/") != "/api":
-                    return self._send(404, "not found", "text/plain")
+                    return self._send(404, {"error": "not found"})
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
-                    return self._send(400, "invalid JSON", "text/plain")
-                code, body = server.handle_request(payload)
-                if isinstance(body, str):
+                    return self._send(400, {"error": "invalid JSON"})
+                try:
+                    code, body = server.handle_request(payload)
+                except Exception as e:  # last-resort: still a JSON answer
+                    code, body = 500, {
+                        "error": f"internal error: {type(e).__name__}: {e}"}
+                if isinstance(body, str):  # legacy engines may return text
                     return self._send(code, body, "text/plain")
                 return self._send(code, body)
 
             do_POST = do_PUT  # convenience; reference is PUT-only
 
             def do_GET(self):
+                if self.path.rstrip("/") == "/health":
+                    return self._send(200, server.health())
                 index = _STATIC_DIR / "index.html"
                 if self.path in ("/", "/index.html") and index.exists():
                     return self._send(200, index.read_text(), "text/html")
-                return self._send(404, "not found", "text/plain")
+                return self._send(404, {"error": "not found"})
 
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
         return Handler
 
+    def health(self) -> dict:
+        """Liveness + engine occupancy (continuous-batching engines only)."""
+        info = {"status": "ok", "batching": self.batching}
+        eng = self.engine
+        if self.batching:
+            with eng._lock:
+                info.update(
+                    active_slots=sum(r is not None for r in eng._slots),
+                    max_slots=eng.max_slots,
+                    queued=len(eng._queue),
+                    free_pages=eng.pool.num_free,
+                    total_pages=eng.pool.num_pages - 1,
+                    ticks=eng.ticks,
+                )
+        return info
+
+    def _start_engine(self):
+        if self.batching and hasattr(self.engine, "start"):
+            self.engine.start()  # background scheduler drives shared ticks
+
     def run(self, host: str = "0.0.0.0", port: int = 5000):
+        self._start_engine()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.serve_forever()
 
     def start_background(self, host: str = "127.0.0.1", port: int = 5000):
         """Run in a daemon thread (used by tests); returns the bound port."""
+        self._start_engine()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -217,3 +267,5 @@ class MegatronServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        if self.batching and hasattr(self.engine, "stop"):
+            self.engine.stop()

@@ -242,11 +242,17 @@ def model_forward(
     rope_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
     kv_caches=None,
     cache_index=None,
+    paged=None,
     sp_constraint=None,
     logits_postprocess=True,
     return_aux=False,
 ):
     """GPTModel.forward analog (gpt_model.py:45-124).
+
+    ``paged`` (ops/paged_attention.PagedState): ``kv_caches`` is the stacked
+    [L, num_pages, page_size, nkv, d] page pool instead of a dense cache, and
+    every batch row decodes one token at its own ``paged.positions`` entry
+    (the serving engine's fused tick, generation/engine.py).
 
     With ``labels``: returns per-token fp32 loss [b, s] (masked mean is the
     caller's job, matching the reference loss_func split). Without: logits.
@@ -268,7 +274,7 @@ def model_forward(
         rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
         token_idx=token_idx,
         dropout_key=dropout_key, deterministic=deterministic,
-        kv_caches=kv_caches, cache_index=cache_index,
+        kv_caches=kv_caches, cache_index=cache_index, paged=paged,
         sp_constraint=sp_constraint,
     )
 

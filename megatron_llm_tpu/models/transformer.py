@@ -192,8 +192,14 @@ def attention_sublayer(
     cache_index: Optional[jax.Array] = None,
     token_idx: Optional[jax.Array] = None,
     attn_bias: Optional[jax.Array] = None,
+    paged=None,
 ):
     """ParallelAttention analog (transformer.py:280-657).
+
+    ``paged`` (ops/paged_attention.PagedState) switches the incremental-decode
+    branch to the block-table page pool: ``kv_cache`` is then the per-layer
+    [num_pages, page_size, nkv, d] pair and each row writes/attends at its own
+    position — the continuous-batching engine's fused tick.
 
     Returns (output [b, s, h], new_kv_cache).
     """
@@ -217,7 +223,35 @@ def attention_sublayer(
     scale = 1.0 / (d ** 0.5)
 
     new_cache = None
-    if kv_cache is not None:
+    if paged is not None:
+        # Continuous-batching decode tick: one token per row, each at its own
+        # position. Write k/v into the row's current page, then attend over
+        # the row's block table (ops/paged_attention.py). Inactive slots'
+        # block tables point at the reserved null page 0, so their writes
+        # land in garbage that is never attended.
+        from megatron_llm_tpu.ops.paged_attention import paged_attention_decode
+
+        assert s == 1, "paged attention is a single-position decode path"
+        pk, pv = kv_cache
+        page_size = pk.shape[1]
+        pos = paged.positions
+        rows = jnp.arange(b)
+        # clip: idle slots' device-side positions keep advancing between
+        # engine re-uploads; their (null-page) block-table lookups must stay
+        # in bounds
+        page_slot = jnp.clip(pos // page_size, 0,
+                             paged.block_tables.shape[1] - 1)
+        page_ids = paged.block_tables[rows, page_slot]
+        offs = pos % page_size
+        pk = pk.at[page_ids, offs].set(k[:, 0].astype(pk.dtype))
+        pv = pv.at[page_ids, offs].set(v[:, 0].astype(pv.dtype))
+        new_cache = (pk, pv)
+        ctx = paged_attention_decode(
+            q, pk, pv, paged.block_tables, pos, scale=scale,
+            sliding_window=m.sliding_window_size,
+            use_kernel=cfg.training.use_flash_attn,
+        )
+    elif kv_cache is not None:
         # Incremental decode: write current k/v at cache_index, attend to the
         # full cache prefix (InferenceParams semantics, text_generation/
         # forward_step.py:17 + transformer.py:413-506).
@@ -336,6 +370,7 @@ def block_forward(
     hidden_dropout_rate: Optional[float] = None,
     kv_cache=None,
     cache_index=None,
+    paged=None,
     sp_constraint=None,
 ):
     """One transformer layer (ParallelTransformerLayer, transformer.py:659-894).
@@ -358,7 +393,7 @@ def block_forward(
     attn_out, new_cache = attention_sublayer(
         cfg, p["attention"], ln1, rope, position_ids, segment_ids,
         dk_attn, deterministic, kv_cache, cache_index, token_idx=token_idx,
-        attn_bias=attn_bias,
+        attn_bias=attn_bias, paged=paged,
     )
 
     if m.parallel_attn:
@@ -442,6 +477,7 @@ def transformer_forward(
     deterministic: bool = True,
     kv_caches=None,        # stacked [L, ...] pair, or None
     cache_index=None,
+    paged=None,
     sp_constraint=None,
     layer_offset: int = 0,
 ):
@@ -468,7 +504,7 @@ def transformer_forward(
             encoder_hidden=encoder_hidden, enc_bias=enc_bias,
             dropout_key=dk, deterministic=deterministic,
             hidden_dropout_rate=rate,
-            kv_cache=cache, cache_index=cache_index,
+            kv_cache=cache, cache_index=cache_index, paged=paged,
             sp_constraint=sp_constraint,
         )
         return out, (new_cache, aux)

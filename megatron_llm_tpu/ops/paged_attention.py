@@ -1,0 +1,119 @@
+"""Paged KV-cache decode attention: block-table gather + masked softmax.
+
+The serving engine (generation/engine.py) stores the KV cache as a pool of
+fixed-size pages ``[num_pages, page_size, n_kv_heads, head_dim]`` shared by
+all in-flight sequences; each sequence owns an ordered list of page ids (its
+*block table*).  This module computes one decode step of attention for a
+batch of sequences at heterogeneous positions — the Ragged-Paged-Attention
+decomposition (PAPERS.md): a single fused program per tick regardless of the
+per-sequence context lengths.
+
+Two implementations with identical numerics:
+
+* ``ops/pallas/paged_attention.py`` — the TPU kernel: the block table is a
+  scalar-prefetch operand, so each grid step DMAs exactly one page from the
+  HBM pool into VMEM (no [b, max_seq] gather ever materializes) and the
+  online-softmax accumulator carries across pages.
+* the jnp fallback below — gathers the block-tabled pages into a dense
+  [b, max_seq] view and reuses :func:`ops.attention.xla_attention`.  It is
+  bitwise-identical to the dense-cache decode path on the same context (the
+  parity contract tier-1 enforces on CPU, tests/test_paged_engine.py).
+
+Page 0 of the pool is reserved as the *null page*: the engine never
+allocates it, inactive slots' block tables point at it, and writes routed
+there are garbage by design (they are never attended to).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.ops import attention as attn_ops
+
+
+class PagedState(NamedTuple):
+    """Per-tick addressing state threaded through model_forward.
+
+    Both leaves are traced arrays, so one compiled tick program serves any
+    block-table/position contents (fixed engine shapes, variable routing).
+    """
+
+    block_tables: jax.Array  # [b, max_pages_per_seq] int32 page ids
+    positions: jax.Array     # [b] int32 — position being decoded per row
+
+
+def paged_gather_kv(k_pool: jax.Array, v_pool: jax.Array,
+                    block_tables: jax.Array):
+    """Dense [b, max_pages*page_size, nkv, d] view of each row's pages.
+
+    The fallback's materialized gather — the tensor the Pallas kernel
+    exists to avoid."""
+    b = block_tables.shape[0]
+    nkv, d = k_pool.shape[-2], k_pool.shape[-1]
+    k_all = k_pool[block_tables].reshape(b, -1, nkv, d)
+    v_all = v_pool[block_tables].reshape(b, -1, nkv, d)
+    return k_all, v_all
+
+
+def paged_attention_decode(
+    q: jax.Array,             # [b, 1, n_heads, d] — queries at `positions`
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    block_tables: jax.Array,  # [b, max_pages_per_seq] int32 page ids
+    positions: jax.Array,     # [b] int32 — q's position; attends to <= it
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    use_kernel: bool = True,
+) -> jax.Array:
+    """One decode step of paged attention; returns [b, 1, n_heads, d].
+
+    Row ``i`` attends to cache positions ``[max(0, pos-W+1), pos]`` of its
+    own block table (the current token's K/V must already be written to its
+    page — the engine writes-then-attends, matching the dense decode path
+    in models/transformer.attention_sublayer).
+    """
+    assert q.ndim == 4 and q.shape[1] == 1, "decode expects [b, 1, n, d]"
+    b, _, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    if use_kernel and _kernel_ok(q, k_pool):
+        from megatron_llm_tpu.ops.pallas.paged_attention import (
+            paged_decode_kernel,
+        )
+
+        return paged_decode_kernel(
+            q, k_pool, v_pool, block_tables, positions,
+            scale=scale, sliding_window=sliding_window,
+        )
+
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables)
+    kv_len = k_all.shape[1]
+    kv_pos = jnp.arange(kv_len)[None, :]
+    allowed = kv_pos <= positions[:, None]
+    if sliding_window is not None:
+        allowed &= positions[:, None] - kv_pos < sliding_window
+    bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)
+    return attn_ops.xla_attention(
+        q, k_all, v_all, bias=bias[:, None, None, :], scale=scale)
+
+
+def _kernel_ok(q: jax.Array, k_pool: jax.Array) -> bool:
+    """Kernel dispatch predicate — mirrors ops/attention.attention: TPU
+    compile target, supported head_dim, lane-aligned page."""
+    from megatron_llm_tpu.core.parallel_state import target_platform
+
+    d = q.shape[-1]
+    page_size = k_pool.shape[1]
+    try:
+        from megatron_llm_tpu.ops.pallas import paged_attention  # noqa: F401
+    except ImportError:
+        return False
+    return (
+        target_platform() == "tpu"
+        and d in (64, 128, 256)
+        and page_size % 8 == 0
+    )

@@ -1,0 +1,157 @@
+"""Pallas TPU paged-attention decode kernel (Ragged Paged Attention style).
+
+One decode step for a batch of sequences whose KV lives in a shared page
+pool, addressed through per-sequence block tables.  The dense-cache decode
+attention reads a contiguous [b, max_seq] cache; here the block table is a
+*scalar-prefetch* operand (pltpu.PrefetchScalarGridSpec), so the BlockSpec
+index map resolves ``page_id = block_table[seq, j]`` before the grid step
+runs and the pipeline DMAs exactly that page from the HBM pool into VMEM —
+the [b, max_pages*page_size] gather of the jnp fallback
+(ops/paged_attention.py) never materializes.
+
+Grid ``(b, n_kv_heads, max_pages_per_seq)``, pages innermost: on TPU the
+grid is a sequential loop, so the online-softmax state (running max m,
+normalizer l, fp32 accumulator) lives in VMEM scratch and carries across
+page iterations of one (sequence, kv-head) pair — the same blockwise
+scheme as ops/pallas/flash_attention.py, with pages playing the role of KV
+blocks.  Pages past a row's context (``j*page_size > pos``) are skipped
+with @pl.when; GQA is native (q grouped [b, nkv, group, d], no K/V
+expansion).
+
+Numerics match the fallback: fp32 logits/softmax/accumulator, outputs cast
+to the query dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    bt_ref,      # [b, max_pages] int32 block tables
+    pos_ref,     # [b] int32 query positions
+    # tensor refs
+    q_ref,       # block [1, 1, g, d]
+    k_ref,       # block [1, page, 1, d]
+    v_ref,       # block [1, page, 1, d]
+    o_ref,       # block [1, 1, g, d]
+    # scratch
+    m_s,         # [g, 1] fp32 running max
+    l_s,         # [g, 1] fp32 normalizer
+    acc_s,       # [g, d] fp32 accumulator
+    *,
+    scale: float,
+    page_size: int,
+    sliding_window: Optional[int],
+):
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    first = j * page_size
+    pos = pos_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    run = first <= pos
+    if sliding_window is not None:
+        # page entirely below the window -> nothing to accumulate
+        run = jnp.logical_and(run, first + page_size > pos - sliding_window + 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # [g, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [g, page]
+        kv_pos = first + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = kv_pos <= pos
+        if sliding_window is not None:
+            mask = jnp.logical_and(mask, pos - kv_pos < sliding_window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_s[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        # fully-masked-so-far guard (flash_attention.py:_fwd_kernel): without
+        # it exp(NEG_INF - NEG_INF) = 1 would poison the accumulator
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - m_cur[:, None]))
+        l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
+        m_s[:, 0] = m_cur
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_s[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_kernel(
+    q: jax.Array,             # [b, 1, n_heads, d]
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    block_tables: jax.Array,  # [b, max_pages_per_seq] int32
+    positions: jax.Array,     # [b] int32
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatch wrapper; returns [b, 1, n_heads, d] in q's dtype."""
+    b, _, n, d = q.shape
+    num_pages, page_size, nkv, _ = k_pool.shape
+    assert n % nkv == 0
+    g = n // nkv
+    max_pages = block_tables.shape[1]
+
+    qg = q.reshape(b, nkv, g, d)
+    grid = (b, nkv, max_pages)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, page_size=page_size,
+        sliding_window=sliding_window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda i, h, j, bt, pos: (i, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda i, h, j, bt, pos: (i, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, 1, n, d)

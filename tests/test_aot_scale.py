@@ -11,6 +11,8 @@ schedule's nested shard_map composing with the manual pp axis.
 from __future__ import annotations
 
 import functools
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -19,13 +21,51 @@ import pytest
 
 topologies = pytest.importorskip("jax.experimental.topologies")
 
+# get_topology_desc initializes the TPU PJRT plugin, which can HANG
+# INDEFINITELY (not raise) when a libtpu tunnel env is present but wedged —
+# that hang turned whole-suite runs into multi-hundred-second stalls (and a
+# hung in-process init thread would poison jax's plugin lock through exit).
+# So the init is probed in a SUBPROCESS with a hard timeout (the bench.py
+# probe_backend pattern); only a healthy probe lets the real in-process
+# init run.  The verdict is cached per topology: one bounded probe per
+# process, shared by every test using that topology.
+_TOPO_CACHE: dict = {}
+_TOPO_TIMEOUT_S = 20.0
+
+
+def _probe_topology(name: str) -> str | None:
+    """None if the topology initializes cleanly in a subprocess; else the
+    reason to skip."""
+    code = ("import jax.experimental.topologies as t; "
+            f"t.get_topology_desc({name!r}, 'tpu')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=_TOPO_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return (f"PJRT topology init exceeded {_TOPO_TIMEOUT_S:.0f}s "
+                "(wedged libtpu tunnel?)")
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        return tail[-1] if tail else f"probe exited {r.returncode}"
+    return None
+
 
 def _topo_devices(name):
-    try:
-        topo = topologies.get_topology_desc(name, "tpu")
-    except Exception as e:  # no libtpu in this environment
-        pytest.skip(f"TPU topology unavailable: {e}")
-    return list(np.array(topo.devices).ravel())
+    if name not in _TOPO_CACHE:
+        reason = _probe_topology(name)
+        if reason is None:
+            try:
+                topo = topologies.get_topology_desc(name, "tpu")
+                _TOPO_CACHE[name] = ("ok", topo)
+            except Exception as e:
+                _TOPO_CACHE[name] = ("err", f"{type(e).__name__}: {e}")
+        else:
+            _TOPO_CACHE[name] = ("err", reason)
+    status, val = _TOPO_CACHE[name]
+    if status != "ok":
+        pytest.skip(f"TPU topology unavailable: {val}")
+    return list(np.array(val.devices).ravel())
 
 
 def _lower_and_compile(cfg, mesh, gbs, seq, extra_batch=None):

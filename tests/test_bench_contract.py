@@ -124,6 +124,38 @@ def test_decode_bench_cpu_contract(evidence_dir):
     assert bench.load_last_tpu() is None  # headline untouched
 
 
+def test_engine_decode_bench_cpu_contract(evidence_dir):
+    """bench_decode.py (ISSUE 1) reuses bench.py's off-TPU contract:
+    headline 0, the occupancy sweep + speedup ride under cpu_sanity, TPU
+    evidence goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_decode_tok_s_llama470m_c8_1chip",
+        "value": 2285.1, "unit": "tok/s", "backend": "cpu",
+        "speedup_vs_sequential": 5.48,
+        "rows": [{"concurrency": 8, "engine_tok_s": 2285.1,
+                  "tick_ms": 3.5, "speedup_vs_sequential": 5.48}],
+    }, tag="engine_decode")
+    assert line["value"] == 0.0 and line["unit"] == "tok/s"
+    assert line["cpu_sanity"]["speedup_vs_sequential"] == 5.48
+    assert line["cpu_sanity"]["rows"][0]["tick_ms"] == 3.5
+    bench.persist_tpu_result({"metric": "engine_decode", "value": 9000.0,
+                              "backend": "tpu"}, {}, tag="engine_decode")
+    assert bench.load_last_tpu(tag="engine_decode")["value"] == 9000.0
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_engine_decode_bench_in_watch_jobs():
+    """The engine decode bench is in the tunnel-up capture list with the
+    bench-style contract (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "engine_decode_bench" in by_name
+    cmd, bounded, pred = by_name["engine_decode_bench"]
+    assert cmd[-1].endswith("bench_decode.py")
+    assert bounded is False and pred is _bench_on_tpu
+
+
 def test_e2e_470m_contract_line():
     """tools/e2e_470m.py off-TPU: headline 0, and the watcher predicate
     must NOT count that line as captured evidence."""

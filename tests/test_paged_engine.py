@@ -1,0 +1,396 @@
+"""Continuous-batching engine + paged KV cache tests (ISSUE 1).
+
+Gates: (1) the paged decode path is numerically IDENTICAL to the dense-cache
+decode path — bitwise for greedy tokens/logits on CPU; (2) the block-table
+allocator never leaks or double-books pages under churn; (3) per-slot
+sampling is a function of (request seed, step) alone, not slot placement;
+(4) the compiled-program cache keys on config CONTENT, not object identity.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.generation import (
+    ContinuousBatchingEngine,
+    generate_tokens,
+)
+from megatron_llm_tpu.generation.generation import (
+    _JIT_CACHE,
+    cached_jit,
+    clear_jit_cache,
+    config_fingerprint,
+    init_kv_caches,
+)
+from megatron_llm_tpu.generation.sampling import (
+    modify_logits_for_top_k_filtering,
+    modify_logits_for_top_p_filtering,
+    sample,
+    sample_per_slot,
+)
+from megatron_llm_tpu.models import init_model_params, make_config
+from megatron_llm_tpu.models.language_model import (
+    _compute_dtype,
+    make_rope_cache,
+    model_forward,
+)
+from megatron_llm_tpu.ops.paged_attention import (
+    PagedState,
+    paged_attention_decode,
+)
+
+VOCAB = 67
+
+
+class ToyTokenizer:
+    eod = 0
+    bos = 1
+    vocab_size = VOCAB
+
+    def tokenize(self, text):
+        return [2 + (ord(c) % (VOCAB - 2)) for c in text]
+
+    def detokenize(self, ids):
+        return "".join(chr(97 + (i % 26)) for i in ids if i >= 2)
+
+
+@pytest.fixture(scope="module")
+def toy_model():
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=128,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False,
+    )
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Kernel / op level
+# ---------------------------------------------------------------------------
+
+
+def test_paged_kernel_interpret_matches_fallback():
+    """The Pallas decode kernel (interpret mode) == the jnp gather fallback,
+    with and without a sliding window."""
+    from megatron_llm_tpu.ops.pallas.paged_attention import paged_decode_kernel
+
+    rng = np.random.default_rng(0)
+    b, n, nkv, d = 3, 4, 2, 64
+    P, page, maxp = 9, 8, 4
+    q = jnp.asarray(rng.normal(size=(b, 1, n, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, page, nkv, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, page, nkv, d)), jnp.float32)
+    bt = jnp.asarray(rng.integers(1, P, size=(b, maxp)), jnp.int32)
+    pos = jnp.asarray([5, 17, 30], jnp.int32)
+
+    for sw in (None, 9):
+        ref = paged_attention_decode(q, kp, vp, bt, pos,
+                                     sliding_window=sw, use_kernel=False)
+        ker = paged_decode_kernel(q, kp, vp, bt, pos, scale=1.0 / d ** 0.5,
+                                  sliding_window=sw, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(ker),
+                                   atol=2e-6, rtol=2e-6)
+
+
+def test_dense_vs_paged_model_forward_bitwise(toy_model):
+    """Dense-cache decode and paged-cache decode produce BITWISE identical
+    logits at every step (greedy), pool pages deliberately non-contiguous."""
+    cfg, params = toy_model
+    rope = make_rope_cache(cfg)
+    b, S, page = 2, 32, 8
+    maxp = S // page
+    L = cfg.model.num_layers
+    nkv, d = cfg.model.num_attention_heads_kv, cfg.model.kv_channels
+    tokens = np.random.RandomState(0).randint(2, VOCAB, (b, S)).astype(np.int32)
+    prompt_len = 7
+
+    caches = init_kv_caches(cfg, b, S, _compute_dtype(cfg))
+    logits_d, caches = model_forward(
+        cfg, params, jnp.asarray(tokens[:, :prompt_len]),
+        position_ids=jnp.arange(prompt_len)[None, :].repeat(b, 0),
+        rope_cache=rope, kv_caches=caches, cache_index=jnp.int32(0))
+
+    # interleave the two rows' pages so the block tables are non-trivial
+    P = 1 + b * maxp
+    pool_k = jnp.zeros((L, P, page, nkv, d), jnp.float32)
+    pool_v = jnp.zeros((L, P, page, nkv, d), jnp.float32)
+    bt = np.asarray([[1 + 2 * j for j in range(maxp)],
+                     [2 + 2 * j for j in range(maxp)]], np.int32)
+    ck, cv = caches
+    pool_k = pool_k.at[:, bt.reshape(-1)].set(
+        ck.reshape(L, b, maxp, page, nkv, d).reshape(L, -1, page, nkv, d))
+    pool_v = pool_v.at[:, bt.reshape(-1)].set(
+        cv.reshape(L, b, maxp, page, nkv, d).reshape(L, -1, page, nkv, d))
+    bt = jnp.asarray(bt)
+
+    tok = jnp.argmax(logits_d[:, -1, :VOCAB], -1).astype(jnp.int32)
+    pos = prompt_len
+    for _ in range(12):
+        ld, caches = model_forward(
+            cfg, params, tok[:, None],
+            position_ids=jnp.full((b, 1), pos, jnp.int32),
+            rope_cache=rope, kv_caches=caches, cache_index=jnp.int32(pos))
+        lp, (pool_k, pool_v) = model_forward(
+            cfg, params, tok[:, None],
+            position_ids=jnp.full((b, 1), pos, jnp.int32),
+            rope_cache=rope, kv_caches=(pool_k, pool_v),
+            paged=PagedState(bt, jnp.full((b,), pos, jnp.int32)))
+        assert bool(jnp.all(ld == lp)), f"logits diverged at position {pos}"
+        tok = jnp.argmax(ld[:, -1, :VOCAB], -1).astype(jnp.int32)
+        pos += 1
+
+
+# ---------------------------------------------------------------------------
+# Engine level
+# ---------------------------------------------------------------------------
+
+
+def test_engine_greedy_matches_generate_tokens(toy_model):
+    """Engine greedy decode == the sequential dense generate_tokens path."""
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=4, max_seq=128)
+    prompt = [2 + i % 60 for i in range(10)]
+    req = eng.submit(prompt, 8, top_k=1, termination_id=10 ** 9)
+    eng.run_until_idle()
+    toks, _ = req.result(timeout=5)
+
+    S = 64
+    tokens = np.zeros((1, S), np.int32)
+    tokens[0, :10] = prompt
+    res = generate_tokens(
+        cfg, params, tokens, np.array([10], np.int32), 18,
+        prefill_len=8, termination_id=10 ** 9,
+        sample_key=jax.random.PRNGKey(0), top_k=1)
+    np.testing.assert_array_equal(
+        np.asarray(toks[10:]), np.asarray(res.tokens)[0, 10:18])
+
+
+def test_engine_logprobs_match_dense_score(toy_model):
+    """Engine per-token log-probs == teacher-forced rescoring of the final
+    sequence (the dense path's own consistency contract)."""
+    from megatron_llm_tpu.generation.generation import score_tokens
+
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=2, max_seq=128)
+    prompt = [3, 4, 5, 6, 7, 8]
+    req = eng.submit(prompt, 10, top_k=1, termination_id=10 ** 9,
+                     return_log_probs=True)
+    eng.run_until_idle()
+    toks, gen_lp = req.result(timeout=5)
+    full = np.asarray(toks, np.int32)[None, :]
+    lp_score = np.asarray(score_tokens(cfg, params, jnp.asarray(full)))[0]
+    lp_engine = np.asarray(req.prompt_log_probs + gen_lp)
+    np.testing.assert_allclose(lp_engine, lp_score[: len(lp_engine)],
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_engine_sampling_slot_invariant(toy_model):
+    """A seeded sampled request generates the SAME tokens whether it runs
+    alone or alongside other requests in different slots — per-slot keys are
+    (seed, step) functions, not (slot, tick)."""
+    cfg, params = toy_model
+    prompt = [5, 9, 13, 17]
+    kw = dict(temperature=0.8, top_p=0.9, seed=123, termination_id=10 ** 9)
+
+    eng1 = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                    max_slots=1, max_seq=128)
+    r1 = eng1.submit(prompt, 12, **kw)
+    eng1.run_until_idle()
+
+    eng2 = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                    max_slots=4, max_seq=128)
+    # fill other slots with competing greedy traffic first so the seeded
+    # request lands in a later slot
+    others = [eng2.submit([7 + i] * 3, 15, top_k=1, termination_id=10 ** 9)
+              for i in range(3)]
+    r2 = eng2.submit(prompt, 12, **kw)
+    eng2.run_until_idle()
+    for o in others:
+        o.result(timeout=5)
+
+    t1, _ = r1.result(timeout=5)
+    t2, _ = r2.result(timeout=5)
+    assert t1 == t2
+
+
+def test_engine_early_termination_and_page_return(toy_model):
+    """Termination id stops a row early; its pages return to the pool while
+    other rows keep decoding."""
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=2, max_seq=128)
+    # find the first greedy token, then use it as the termination id
+    probe = eng.submit([3, 3, 3, 3], 1, top_k=1, termination_id=10 ** 9)
+    eng.run_until_idle()
+    first_tok = probe.result(timeout=5)[0][-1]
+
+    short = eng.submit([3, 3, 3, 3], 50, top_k=1, termination_id=first_tok)
+    long_ = eng.submit([9, 9, 9, 9], 30, top_k=1, termination_id=10 ** 9)
+    eng.run_until_idle()
+    t_short, _ = short.result(timeout=5)
+    t_long, _ = long_.result(timeout=5)
+    assert len(t_short) == 5  # stopped on the first generated token
+    assert len(t_long) == 34  # ran to its budget
+    assert eng.pool.num_free == eng.pool.num_pages - 1
+
+
+def test_block_table_alloc_free_stress(toy_model):
+    """Churn a deliberately tiny pool: requests queue behind page pressure,
+    pages are never double-booked across active slots, and the pool is whole
+    when the queue drains."""
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=3, page_size=16, num_pages=13,
+                                   max_seq=128)
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(17):
+        plen = int(rng.integers(1, 40))
+        gen_len = int(rng.integers(1, 30))
+        reqs.append(eng.submit([2 + int(x) for x in rng.integers(0, 60, plen)],
+                               gen_len, top_k=1, termination_id=10 ** 9))
+
+    total = eng.pool.num_pages - 1
+    steps = 0
+    while True:
+        n = eng.step()
+        steps += 1
+        held = [p for r in eng._slots if r is not None for p in r._pages]
+        assert len(held) == len(set(held)), "page double-booked"
+        assert all(p != 0 for p in held), "null page allocated"
+        assert len(held) + eng.pool.num_free == total, "pages leaked"
+        if n == 0 and not eng._queue:
+            break
+        assert steps < 5000
+    for r in reqs:
+        toks, _ = r.result(timeout=5)
+        assert len(toks) == len(r.prompt) + len(r.generated)
+        assert 1 <= len(r.generated) <= r.max_new_tokens
+    assert eng.pool.num_free == total
+
+
+def test_engine_rejects_oversized_request(toy_model):
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=2, max_seq=64)
+    with pytest.raises(ValueError, match="longer than allowed"):
+        eng.submit(list(range(2, 60)), 32)
+
+
+def test_engine_concurrent_submitters_share_ticks(toy_model):
+    """Requests submitted from many threads share decode ticks: total ticks
+    is far below the serialized tick count (the >= 3x batching claim the
+    decode bench quantifies)."""
+    cfg, params = toy_model
+    eng = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                   max_slots=8, max_seq=128)
+    reqs = [None] * 8
+
+    def submit(i):
+        reqs[i] = eng.submit([2 + i, 3 + i, 4 + i], 12, top_k=1,
+                             termination_id=10 ** 9)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.run_until_idle()
+    total_generated = 0
+    for r in reqs:
+        toks, _ = r.result(timeout=5)
+        total_generated += len(r.generated)
+    assert total_generated == 8 * 12
+    # serialized decoding would need one tick per generated token
+    assert eng.ticks <= 2 * 12 < total_generated
+
+
+# ---------------------------------------------------------------------------
+# Per-slot sampler
+# ---------------------------------------------------------------------------
+
+
+def test_sample_per_slot_matches_static_filters():
+    """Row-wise dynamic top-k/top-p filtering == the static single-config
+    filters sample() uses, and greedy rows == sample()'s greedy branch."""
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, 32)) * 3, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+    top_k = jnp.asarray([1, 5, 0, 0], jnp.int32)
+    top_p = jnp.asarray([0.0, 0.0, 0.7, 0.0], jnp.float32)
+    temp = jnp.ones((4,), jnp.float32)
+
+    out = sample_per_slot(keys, logits, top_k=top_k, top_p=top_p,
+                          temperature=temp)
+    # row 0 greedy == sample() greedy
+    assert int(out[0]) == int(sample(None, logits[:1], top_k=1)[0])
+    # row 1: token must survive the static top-5 filter
+    filt_k = modify_logits_for_top_k_filtering(logits[1:2], 5)
+    assert float(filt_k[0, int(out[1])]) > -1e9
+    # row 2: token must survive the static top-p filter
+    filt_p = modify_logits_for_top_p_filtering(logits[2:3], 0.7)
+    assert float(filt_p[0, int(out[2])]) > -1e9
+    # per-row keys: same row inputs + same key -> same sample regardless of
+    # the rest of the batch
+    solo = sample_per_slot(keys[1:2], logits[1:2], top_k=top_k[1:2],
+                           top_p=top_p[1:2], temperature=temp[1:2])
+    assert int(solo[0]) == int(out[1])
+
+
+def test_sample_per_slot_temperature_is_ignored_for_greedy():
+    logits = jnp.asarray([[0.1, 0.9, 0.5]])
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(1))
+    out = sample_per_slot(keys, logits,
+                          top_k=jnp.asarray([1]), top_p=jnp.asarray([0.0]),
+                          temperature=jnp.asarray([0.01]))
+    assert int(out[0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# cached_jit regression (satellite: id(cfg) keying)
+# ---------------------------------------------------------------------------
+
+
+def test_cached_jit_keys_on_config_content():
+    """Two configs with EQUAL contents share one compiled entry (no id
+    dependence — the id-recycling hazard of the old key); different contents
+    get different entries."""
+    clear_jit_cache()
+    def mk(hidden_size=32):
+        return make_config(
+            "llama2", num_layers=1, hidden_size=hidden_size,
+            num_attention_heads=2, num_attention_heads_kv=2,
+            ffn_hidden_size=64, seq_length=64,
+            max_position_embeddings=64, vocab_size=VOCAB)
+    cfg_a, cfg_b = mk(), mk()
+    assert cfg_a is not cfg_b
+    assert config_fingerprint(cfg_a) == config_fingerprint(cfg_b)
+
+    calls = []
+    fn_a = cached_jit(cfg_a, "t", (1,), lambda: calls.append(1) or (lambda x: x))
+    fn_b = cached_jit(cfg_b, "t", (1,), lambda: calls.append(1) or (lambda x: x))
+    assert fn_a is fn_b and len(calls) == 1, "equal configs must share"
+
+    cfg_c = mk(hidden_size=64)
+    assert config_fingerprint(cfg_c) != config_fingerprint(cfg_a)
+    fn_c = cached_jit(cfg_c, "t", (1,), lambda: calls.append(1) or (lambda x: x))
+    assert fn_c is not fn_a and len(calls) == 2
+
+    # GC'd configs cannot alias: the key survives the object, by value
+    key_count = len(_JIT_CACHE)
+    del cfg_a, cfg_b
+    import gc
+
+    gc.collect()
+    cfg_d = mk()
+    fn_d = cached_jit(cfg_d, "t", (1,), lambda: calls.append(1) or (lambda x: x))
+    assert fn_d is fn_b and len(calls) == 2 and len(_JIT_CACHE) == key_count
+    clear_jit_cache()

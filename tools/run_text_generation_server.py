@@ -3,9 +3,15 @@
 tools/run_text_generation_server.py analog (:24-90).
 
 Loads a model from a checkpoint (or random-inits a tiny one with
-``--random_init`` for smoke testing), builds the InferenceEngine, and
-serves PUT /api.  Single process: no torchrun, no rank loop (ranks >0 in
-the reference spin on broadcast — SPMD needs none of that).
+``--random_init`` for smoke testing) and serves PUT /api.  Single process:
+no torchrun, no rank loop (ranks >0 in the reference spin on broadcast —
+SPMD needs none of that).
+
+Default engine is the continuous-batching paged-KV engine
+(generation/engine.py): concurrent requests share fused decode ticks.
+``--legacy_engine`` serves the dense one-request-at-a-time path instead.
+Engine geometry (slots, page size, pool) comes from ``cfg.inference``
+(--max_batch_slots, --page_size, ...).
 """
 
 from __future__ import annotations
@@ -27,12 +33,18 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--random_init", action="store_true",
                     help="serve a random tiny model (smoke test)")
+    ap.add_argument("--legacy_engine", action="store_true",
+                    help="serve the dense single-stream InferenceEngine "
+                         "instead of the continuous-batching engine")
     args, extra = ap.parse_known_args()
 
     import jax
 
     from megatron_llm_tpu.config.arguments import parse_args
-    from megatron_llm_tpu.generation import InferenceEngine
+    from megatron_llm_tpu.generation import (
+        ContinuousBatchingEngine,
+        InferenceEngine,
+    )
     from megatron_llm_tpu.generation.server import MegatronServer
     from megatron_llm_tpu.models import init_model_params
     from megatron_llm_tpu.tokenizer import build_tokenizer
@@ -58,9 +70,14 @@ def main():
             lambda k: init_model_params(cfg, k), key)
         params, _, _, _, _ = load_checkpoint(cfg, args.load, template)
 
-    engine = InferenceEngine(cfg, params, tokenizer)
+    if args.legacy_engine:
+        engine = InferenceEngine(cfg, params, tokenizer)
+    else:
+        engine = ContinuousBatchingEngine(cfg, params, tokenizer)
     server = MegatronServer(engine)
-    print(f"serving on http://{args.host}:{args.port}/api", flush=True)
+    kind = "legacy" if args.legacy_engine else "continuous-batching"
+    print(f"serving ({kind}) on http://{args.host}:{args.port}/api",
+          flush=True)
     server.run(args.host, args.port)
 
 

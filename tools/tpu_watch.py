@@ -105,6 +105,10 @@ JOBS = [
     ("decode_bench_int8",
      [sys.executable, "tools/decode_bench.py", "--int8"],
      False, _bench_on_tpu),
+    # ISSUE 1: continuous-batching engine vs sequential decode — the
+    # serving-throughput headline (bench_decode.py, engine_decode evidence)
+    ("engine_decode_bench", [sys.executable, "bench_decode.py"],
+     False, _bench_on_tpu),
     # VERDICT round-4 item 8: the 470M language-quality e2e, now a FULL
     # epoch (~2M tokens = 500 iters at gbs 16) in resume-exercising stages
     # of 100 iters with a WIKITEXT eval + E2E_470M.json rewrite per stage —
