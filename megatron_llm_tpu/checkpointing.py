@@ -20,6 +20,8 @@ import dataclasses
 import json
 import os
 import shutil
+import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -111,6 +113,75 @@ def _prune_old(cfg, save_dir: str, latest: int) -> None:
     )
     for it in iters[:-keep]:
         shutil.rmtree(checkpoint_dir(save_dir, it), ignore_errors=True)
+
+
+class AsyncCheckpointSaver:
+    """Non-blocking ``save_checkpoint`` (--async_save): the device→host
+    snapshot happens synchronously on the caller's thread — so the bytes
+    are one consistent iteration even though the write is deferred — and
+    the orbax write + meta + tracker update run on a background thread
+    via the normal :func:`save_checkpoint` path (identical on-disk layout,
+    asserted by tests/test_async_loop.py).
+
+    At most ONE save is in flight: a new ``save`` first joins the previous
+    write (the barrier the training loop relies on before the next save,
+    the final save, and process exit).  The writer thread is non-daemon,
+    so even an unexpected interpreter exit waits for the in-flight write —
+    and since the tracker file is only advanced after a complete write
+    (save_checkpoint ordering), the latest tracked checkpoint on disk is
+    always whole.  Single-host only: snapshotting multi-host sharded
+    arrays requires every process's participation in the orbax save, which
+    would reintroduce the blocking collective this class exists to hide.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    @property
+    def pending(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def save(self, cfg, save_dir: str, iteration: int, params: Any,
+             opt_state: Any = None, consumed_samples: int = 0,
+             extra_state: Optional[Dict] = None) -> float:
+        """Snapshot to host and hand off to the writer thread.
+
+        Returns the seconds spent waiting for the previous write (the
+        flush-wait the loop reports as a gauge)."""
+        import jax
+
+        t0 = time.perf_counter()
+        self.wait()  # barrier: one in-flight save
+        waited = time.perf_counter() - t0
+        host_params = jax.device_get(params)
+        host_opt = None
+        if opt_state is not None and not cfg.checkpoint.no_save_optim:
+            host_opt = jax.device_get(opt_state)
+        self._thread = threading.Thread(
+            target=self._write, name="ckpt-writer",
+            args=(cfg, save_dir, iteration, host_params, host_opt,
+                  consumed_samples, extra_state),
+        )
+        self._thread.start()
+        return waited
+
+    def _write(self, cfg, save_dir, iteration, params, opt_state,
+               consumed_samples, extra_state) -> None:
+        try:
+            save_checkpoint(cfg, save_dir, iteration, params, opt_state,
+                            consumed_samples, extra_state)
+        except BaseException as e:
+            self._error = e
+
+    def wait(self) -> None:
+        """Join any pending write; re-raise its error on the caller."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
 
 def load_checkpoint(

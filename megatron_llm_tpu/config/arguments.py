@@ -219,6 +219,18 @@ class TrainingConfig:
     remat_policy: str = "save_dots_except_logits"
     skip_train: bool = False
     skip_iters: List[int] = field(default_factory=list)
+    # --- host/device overlap (training.py async loop) ---
+    # How many dispatched-but-unfetched steps may be in flight before the
+    # host blocks on the oldest (bounds device memory for queued programs
+    # and error latency). 0 = the fully synchronous legacy loop; metrics
+    # are fetched in one batched device_get at log_interval boundaries
+    # either way.
+    async_dispatch_depth: int = 2
+    # Background data pipeline stage (data/prefetch.py): batches are pulled
+    # from the loader, collated (incl. ramp-up chunk concatenation) and
+    # placed on device up to this many steps ahead of the consuming step.
+    # 0 = pull + place inline on the critical path (legacy behavior).
+    prefetch_depth: int = 2
 
 
 @dataclass

@@ -1,1 +1,3 @@
+from megatron_llm_tpu.data.prefetch import BatchPrefetcher, concat_chunks
 
+__all__ = ["BatchPrefetcher", "concat_chunks"]

@@ -1,0 +1,185 @@
+"""Pipelined data prefetch: a background stage between loader and step.
+
+The synchronous driver loop pays the whole host-side data path — loader
+pull, collate, ramp-up chunk concatenation, ``place_batch``/``device_put``
+— between device steps, while the accelerator idles.  This stage runs that
+path on a background thread with a bounded queue so batch N+1 is collated
+and already resident on device while step N executes (double buffering at
+``depth=2``) — the single-controller analog of the reference's
+pin-memory + worker DataLoader pipeline, and of the compute/communication
+overlap Megatron-LM reports as decisive for step time (PAPERS.md).
+
+Contract (tests/test_async_loop.py):
+  * deterministic order — one worker thread, FIFO queue: the stream of
+    ``(gbs, batch)`` items is exactly what the synchronous loop would have
+    produced, including the batch-size ramp-up chunked path and the
+    post-ramp switch to full-global-batch loading;
+  * clean shutdown — ``StopIteration`` from the source ends the stream
+    (consumer sees ``StopIteration``, repeatedly); worker exceptions are
+    re-raised at the consumer; ``close()`` unblocks and joins the worker.
+
+The loader feeding this stage (data/samplers.DataIterator) already
+prefetches raw sample assembly; this stage covers the remaining host work
+— chunk concatenation and device placement — which the loader cannot do
+because batch composition depends on the ramp-up schedule.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def concat_chunks(chunks) -> Dict[str, np.ndarray]:
+    """Ramp-up chunk concatenation (the training loop's contract):
+    ``token_idx`` is the batch-invariant [s] zigzag index vector and is
+    never concatenated."""
+    return {
+        k: (chunks[0][k] if k == "token_idx"
+            else np.concatenate([c[k] for c in chunks]))
+        for k in chunks[0]
+    }
+
+
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_END = object()
+
+
+class BatchPrefetcher:
+    """Iterator of ``(gbs, batch)`` produced ahead-of-time by a worker thread.
+
+    Args:
+      source: the loader iterator (yields collated host batches).
+      depth: bounded queue size — how many batches may be staged ahead.
+      place_fn: optional device placement (``shardings["place_batch"]``);
+        when given, queued batches are already on device.
+      gbs_fn: ``consumed_samples -> global batch size`` for this step — a
+        shadow of the driver's num-microbatches calculator.  The schedule is
+        a pure function of consumed samples, so worker and driver stay in
+        lockstep without communicating.  None => ``gbs`` yielded as None.
+      chunk_size: when set, the source yields ``chunk_size``-row chunks and
+        the worker pulls ``gbs // chunk_size`` of them per step (the
+        batch-size ramp-up path).
+      consumed_samples: starting point for the shadow schedule (resume).
+      max_steps: stop after this many batches (None = until exhaustion).
+      switch_source: called once with the current consumed_samples when the
+        ramp reaches ``full_gbs``; returns the full-global-batch loader
+        (mirrors the driver's rebuild_full_loader switch).
+    """
+
+    def __init__(
+        self,
+        source: Iterator,
+        *,
+        depth: int = 2,
+        place_fn: Optional[Callable[[Dict], Any]] = None,
+        gbs_fn: Optional[Callable[[int], int]] = None,
+        chunk_size: Optional[int] = None,
+        consumed_samples: int = 0,
+        max_steps: Optional[int] = None,
+        switch_source: Optional[Callable[[int], Iterator]] = None,
+        full_gbs: Optional[int] = None,
+    ):
+        self.place_fn = place_fn
+        self._source = source
+        self._gbs_fn = gbs_fn
+        self._chunk_size = chunk_size
+        self._consumed = consumed_samples
+        self._max_steps = max_steps
+        self._switch_source = switch_source
+        self._full_gbs = full_gbs
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop = threading.Event()
+        self._done = False
+        self.batches_out = 0  # consumer-side count (observability)
+        self.switched_full = False
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="batch-prefetch"
+        )
+        self._thread.start()
+
+    # ---- worker side ----
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close()."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self) -> None:
+        src = self._source
+        consumed = self._consumed
+        chunking = self._chunk_size is not None
+        steps = 0
+        try:
+            while self._max_steps is None or steps < self._max_steps:
+                if self._stop.is_set():
+                    return
+                gbs = self._gbs_fn(consumed) if self._gbs_fn else None
+                if (chunking and self._full_gbs and gbs == self._full_gbs
+                        and self._switch_source is not None):
+                    # ramp finished: the same switch the synchronous loop
+                    # makes — steady state pays no per-step concatenation
+                    src = self._switch_source(consumed)
+                    chunking = False
+                    self.switched_full = True
+                if chunking:
+                    chunks = [next(src)
+                              for _ in range(gbs // self._chunk_size)]
+                    batch = concat_chunks(chunks)
+                else:
+                    batch = next(src)
+                if self.place_fn is not None:
+                    batch = self.place_fn(batch)
+                if not self._put((gbs, batch)):
+                    return
+                consumed += gbs or 0
+                steps += 1
+        except StopIteration:
+            pass
+        except BaseException as e:  # surfaced at the consumer
+            self._put(_WorkerError(e))
+            return
+        self._put(_END)
+
+    # ---- consumer side ----
+
+    def __iter__(self) -> "BatchPrefetcher":
+        return self
+
+    def __next__(self) -> Tuple[Optional[int], Any]:
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self._done = True
+            raise item.exc
+        self.batches_out += 1
+        return item
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the worker (it may be blocked on a full queue) and join."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout)

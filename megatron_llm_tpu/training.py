@@ -8,18 +8,34 @@ signal-exit :731, save :739, time/iter exits :746-767), ``evaluate``:773,
 Single-controller redesign: no rank gymnastics (is-last-rank printing, TP-rank
 data broadcast, all-reduced exit flags) — one process drives the mesh; exit
 decisions are plain Python.
+
+Async loop (ISSUE 2): the hot loop rides JAX's async dispatch so the host
+never sits between device steps — metrics stay on device in a bounded
+in-flight deque (--async_dispatch_depth) and are fetched in ONE batched
+``jax.device_get`` at log_interval boundaries; batches are collated and
+placed ahead of time on a background thread (data/prefetch.py,
+--prefetch_depth); checkpoint writes are deferred to a writer thread behind
+a host snapshot (--async_save, checkpointing.AsyncCheckpointSaver).  The
+numerical trajectory is bitwise-identical to the synchronous loop
+(tests/test_async_loop.py) — only WHEN the host observes results changes,
+never what the device computes.
 """
 
 from __future__ import annotations
 
 import os
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
 
-from megatron_llm_tpu.checkpointing import load_checkpoint, save_checkpoint
+from megatron_llm_tpu.checkpointing import (
+    AsyncCheckpointSaver,
+    load_checkpoint,
+    save_checkpoint,
+)
 from megatron_llm_tpu.core.parallel_state import build_mesh_from_config, global_mesh
 from megatron_llm_tpu.core import rng as rng_mod
 from megatron_llm_tpu.data.batch_utils import get_ltor_batch
@@ -39,6 +55,11 @@ from megatron_llm_tpu.utils.logging_utils import (
     set_global,
 )
 from megatron_llm_tpu.utils.timers import Timers
+
+
+# window of fetched (iteration, lm loss) pairs the loop keeps for the
+# result dict — bounded, like every other per-step record in the driver
+_LOSS_SERIES_MAXLEN = 512
 
 
 def model_flops_per_token(cfg) -> float:
@@ -226,6 +247,13 @@ def make_eval_step(cfg, loss_fn=None):
     return jax.jit(eval_step)
 
 
+# eval steps dispatch back-to-back and their metric dicts drain in one
+# batched device_get per this many iterations (bounds device memory for
+# pending eval programs) — instead of a blocking float(v) per metric per
+# iteration, which serialized host and device every eval step
+_EVAL_DRAIN_EVERY = 32
+
+
 def evaluate(cfg, params, eval_step, data_iterator,
              max_iters: Optional[int] = None, place_batch=None):
     """evaluate analog (training.py:773-860): mean loss over eval_iters.
@@ -235,7 +263,15 @@ def evaluate(cfg, params, eval_step, data_iterator,
     so the local rows need the same global-array assembly."""
     totals: Dict[str, float] = {}
     n = 0
+    pending: list = []
     max_iters = max_iters or cfg.training.eval_iters
+
+    def drain():
+        for host in jax.device_get(pending):
+            for k, v in host.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        pending.clear()
+
     for _ in range(max_iters):
         try:
             batch = next(data_iterator)
@@ -243,10 +279,11 @@ def evaluate(cfg, params, eval_step, data_iterator,
             break
         if place_batch is not None:
             batch = place_batch(batch)
-        metrics = eval_step(params, batch)
-        for k, v in metrics.items():
-            totals[k] = totals.get(k, 0.0) + float(v)
+        pending.append(eval_step(params, batch))
         n += 1
+        if len(pending) >= _EVAL_DRAIN_EVERY:
+            drain()
+    drain()
     return {k: v / max(n, 1) for k, v in totals.items()}
 
 
@@ -429,6 +466,11 @@ def pretrain(
         eval_step = make_eval_step(cfg, loss_fn=loss_fn)
 
         # ---- train loop (_train analog, training.py:654-770) ----
+        # Overlapped: dispatch runs ahead of completion (bounded by
+        # --async_dispatch_depth), data is staged by a prefetch thread
+        # (--prefetch_depth), checkpoint writes go to a writer thread
+        # (--async_save). Dispatch order — and so the numerical
+        # trajectory — is identical to the synchronous loop.
         from megatron_llm_tpu.microbatches import build_num_microbatches_calculator
 
         t = cfg.training
@@ -440,7 +482,83 @@ def pretrain(
         train_iters = t.train_iters or 0
         exit_reason = "train_iters reached"
         metrics: Dict[str, Any] = {}
-        step_times = []
+        log_interval = max(cfg.logging.log_interval, 1)
+        depth = max(int(t.async_dispatch_depth or 0), 0)
+        # bounded (the old list grew for the whole run): host-side
+        # dispatch-to-dispatch deltas, kept for the last interval only
+        step_times: deque = deque(maxlen=log_interval)
+        loss_series: deque = deque(maxlen=_LOSS_SERIES_MAXLEN)
+        in_flight: deque = deque()  # (iteration, metrics-on-device)
+        warmup_time = None  # first dispatched step = compile + warmup
+        interval_t0 = time.perf_counter()
+        interval_steps = 0
+        steady_t0 = None
+        steady_steps = 0
+        last_dispatch = None
+        placed = None
+
+        def _retire(n: Optional[int] = None):
+            """Completion probe: fetch the oldest ``n`` in-flight metric
+            dicts (all when None) in ONE batched device_get — this is the
+            only place the host waits on the device."""
+            nonlocal metrics
+            take = len(in_flight) if n is None else min(n, len(in_flight))
+            if take == 0:
+                return metrics
+            entries = [in_flight.popleft() for _ in range(take)]
+            for (it, _), host in zip(
+                    entries, jax.device_get([m for _, m in entries])):
+                loss_series.append((it, float(host.get("lm loss", np.nan))))
+                metrics = host
+            return metrics
+
+        prefetcher = None
+        if (t.prefetch_depth and int(t.prefetch_depth) > 0
+                and not t.skip_train and iteration < train_iters):
+            from megatron_llm_tpu.data.prefetch import BatchPrefetcher
+
+            shadow = build_num_microbatches_calculator(cfg)
+
+            def _gbs_fn(consumed):
+                # shadow of the driver's ramp schedule: a pure function of
+                # consumed samples, so worker and driver stay in lockstep
+                shadow.update(consumed, False)
+                return shadow.get_current_global_batch_size()
+
+            prefetcher = BatchPrefetcher(
+                train_iter,
+                depth=int(t.prefetch_depth),
+                # multi-host placement assembles global arrays from every
+                # process — keep it on the driver thread there
+                place_fn=(shardings["place_batch"]
+                          if jax.process_count() == 1 else None),
+                gbs_fn=_gbs_fn,
+                chunk_size=chunk if rampup else None,
+                consumed_samples=consumed_samples,
+                max_steps=train_iters - iteration,
+                switch_source=rebuild_full_loader,
+                full_gbs=t.global_batch_size,
+            )
+
+        saver = None
+        if cfg.checkpoint.async_save:
+            if jax.process_count() == 1:
+                saver = AsyncCheckpointSaver()
+            else:
+                print0("WARNING: --async_save is single-host only (the "
+                       "snapshot of multi-host sharded arrays needs every "
+                       "process in the orbax save); saving synchronously")
+
+        def _save(it):
+            timers("save-checkpoint", 0).start()
+            if saver is not None:
+                waited = saver.save(cfg, cfg.checkpoint.save, it, params,
+                                    opt_state, consumed_samples)
+                timers.gauge("ckpt-flush-wait-ms", waited * 1e3)
+            else:
+                save_checkpoint(cfg, cfg.checkpoint.save, it, params,
+                                opt_state, consumed_samples)
+            timers("save-checkpoint").stop()
 
         profiling = False
         profile_stop_at = None  # set when the trace starts
@@ -449,123 +567,184 @@ def pretrain(
             cfg.logging.tensorboard_dir or ".", "profile"
         )
 
-        while iteration < train_iters:
-            if t.skip_train:
-                break
-            # xplane tracing over [profile_step_start, profile_step_end)
-            # (SURVEY §5: jax-profiler analog of the reference's span timers)
-            # >= not ==: a resumed run past the start step still gets a trace
-            # (of at least one step, even past the configured window)
-            if (cfg.logging.profile and profile_stop_at is None
-                    and iteration >= cfg.logging.profile_step_start):
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-                profile_stop_at = max(cfg.logging.profile_step_end,
-                                      iteration + 1)
-            calc.update(consumed_samples)
-            gbs = calc.get_current_global_batch_size()
-            num_micro = calc.get()
-            if rampup and gbs == t.global_batch_size and rebuild_full_loader:
-                # ramp finished: switch to full-global-batch loading so the
-                # steady state pays no per-iteration chunk concatenation
-                train_iter = rebuild_full_loader(consumed_samples)
-                rampup = False
-            if num_micro not in step_cache:
-                step_cache[num_micro] = make_jitted_train_step(
-                    cfg, mesh, params, num_micro=num_micro,
-                    optimizer=optimizer, opt_state=opt_state, loss_fn=loss_fn,
-                    pipeline_hooks=pipeline_hooks, pipeline_loss=pipeline_loss,
-                )[0]
-            cur_step_fn = step_cache[num_micro]
-            try:
-                timers("batch-generator", 1).start()
-                if rampup:
-                    chunks = [next(train_iter) for _ in range(gbs // chunk)]
-                    # token_idx is batch-invariant [s] — never concatenated
-                    batch = {
-                        k: (chunks[0][k] if k == "token_idx"
-                            else np.concatenate([c[k] for c in chunks]))
-                        for k in chunks[0]
-                    }
+        try:
+            while iteration < train_iters:
+                if t.skip_train:
+                    break
+                # xplane tracing over [profile_step_start, profile_step_end)
+                # (SURVEY §5: jax-profiler analog of the reference's span
+                # timers). >= not ==: a resumed run past the start step still
+                # gets a trace (of at least one step, even past the window)
+                if (cfg.logging.profile and profile_stop_at is None
+                        and iteration >= cfg.logging.profile_step_start):
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                    profile_stop_at = max(cfg.logging.profile_step_end,
+                                          iteration + 1)
+                calc.update(consumed_samples)
+                gbs = calc.get_current_global_batch_size()
+                num_micro = calc.get()
+                if (prefetcher is None and rampup
+                        and gbs == t.global_batch_size and rebuild_full_loader):
+                    # ramp finished: switch to full-global-batch loading so
+                    # steady state pays no per-iteration chunk concatenation
+                    # (the prefetch worker makes this same switch itself)
+                    train_iter = rebuild_full_loader(consumed_samples)
+                    rampup = False
+                if num_micro not in step_cache:
+                    step_cache[num_micro] = make_jitted_train_step(
+                        cfg, mesh, params, num_micro=num_micro,
+                        optimizer=optimizer, opt_state=opt_state,
+                        loss_fn=loss_fn, pipeline_hooks=pipeline_hooks,
+                        pipeline_loss=pipeline_loss,
+                    )[0]
+                cur_step_fn = step_cache[num_micro]
+                try:
+                    timers("batch-generator", 1).start()
+                    wait_t0 = time.perf_counter()
+                    if prefetcher is not None:
+                        pre_gbs, placed = next(prefetcher)
+                        if pre_gbs is not None and pre_gbs != gbs:
+                            raise RuntimeError(
+                                f"prefetch schedule diverged: worker staged "
+                                f"gbs {pre_gbs}, driver expects {gbs}")
+                        if prefetcher.place_fn is None:  # multi-host
+                            placed = shardings["place_batch"](placed)
+                    else:
+                        if rampup:
+                            chunks = [next(train_iter)
+                                      for _ in range(gbs // chunk)]
+                            # token_idx is batch-invariant [s] — never
+                            # concatenated
+                            batch = {
+                                k: (chunks[0][k] if k == "token_idx"
+                                    else np.concatenate([c[k] for c in chunks]))
+                                for k in chunks[0]
+                            }
+                        else:
+                            batch = next(train_iter)
+                        placed = shardings["place_batch"](batch)
+                    timers.gauge("data-wait-ms",
+                                 (time.perf_counter() - wait_t0) * 1e3)
+                    timers("batch-generator").stop()
+                except StopIteration:
+                    exit_reason = "data exhausted"
+                    break
+
+                timers("train-step", 0).start()
+                dispatch_t0 = time.perf_counter()
+                if last_dispatch is not None:
+                    step_times.append(dispatch_t0 - last_dispatch)
+                last_dispatch = dispatch_t0
+                first_step = False
+                if iteration not in (t.skip_iters or []):
+                    # --skip_iters skips the update (training.py:397-399)
+                    params, opt_state, metrics_dev = cur_step_fn(
+                        params, opt_state, placed, iteration,
+                    )
+                    in_flight.append((iteration + 1, metrics_dev))
+                    timers.gauge("in-flight-depth", len(in_flight))
+                    if warmup_time is None:
+                        # fence the compile step out of throughput so the
+                        # first training_log line is honest
+                        _retire()
+                        warmup_time = time.perf_counter() - dispatch_t0
+                        first_step = True
+                        print0(f"first step (compile + warmup): "
+                               f"{warmup_time:.2f}s — excluded from "
+                               f"throughput averages", flush=True)
+                    else:
+                        while len(in_flight) > depth:
+                            _retire(1)
+                timers("train-step").stop()
+                iteration += 1
+                consumed_samples += gbs
+                if first_step:
+                    interval_t0 = steady_t0 = time.perf_counter()
+                    interval_steps = 0
                 else:
-                    batch = next(train_iter)
-                timers("batch-generator").stop()
-            except StopIteration:
-                exit_reason = "data exhausted"
-                break
+                    interval_steps += 1
+                    steady_steps += 1
 
-            timers("train-step", 0).start()
-            step_start = time.time()
-            if iteration not in (t.skip_iters or []):
-                # --skip_iters skips the update (training.py:397-399)
-                params, opt_state, metrics = cur_step_fn(
-                    params, opt_state, shardings["place_batch"](batch),
-                    iteration,
-                )
-                jax.block_until_ready(metrics["lm loss"])
-            step_time = time.time() - step_start
-            timers("train-step").stop()
-            step_times.append(step_time)
-            iteration += 1
-            consumed_samples += gbs
+                if profiling and iteration >= profile_stop_at:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    print0(f"profiler: xplane trace written to {profile_dir}",
+                           flush=True)
 
-            if profiling and iteration >= profile_stop_at:
+                if iteration % log_interval == 0:
+                    # drain: one batched fetch for the whole interval
+                    _retire()
+                    now = time.perf_counter()
+                    avg = ((now - interval_t0) / interval_steps
+                           if interval_steps > 0 else (warmup_time or 0.0))
+                    training_log(cfg, metrics, iteration, avg, writer, timers,
+                                 consumed_samples, global_batch_size=gbs)
+                    if cfg.logging.timing_log_level >= 2 and not spans_printed:
+                        spans_printed = True  # once per run, incl. resumed
+                        spans = measure_span_breakdown(
+                            cfg, params, placed, avg, loss_fn=loss_fn,
+                        )
+                        if spans:
+                            print0("    span breakdown (ms): " + " | ".join(
+                                f"{k}: {v * 1e3:.1f}"
+                                for k, v in spans.items()), flush=True)
+                    interval_t0 = time.perf_counter()
+                    interval_steps = 0
+
+                if (cfg.training.eval_interval and valid_iter_factory
+                        and iteration % cfg.training.eval_interval == 0):
+                    ev = evaluate(cfg, params, eval_step, valid_iter_factory(),
+                                  place_batch=shardings["place_batch"])
+                    print0(f" validation loss at iteration {iteration}: "
+                           + " | ".join(f"{k}: {v:.6E}" for k, v in ev.items()),
+                           flush=True)
+                    if writer:
+                        for k, v in ev.items():
+                            writer.add_scalar(f"lm-loss-validation/{k}", v,
+                                              iteration)
+
+                if (cfg.checkpoint.save and cfg.checkpoint.save_interval
+                        and iteration % cfg.checkpoint.save_interval == 0):
+                    _save(iteration)
+
+                # exit conditions (training.py:731-767) — checked on the
+                # deferred state: breaking with steps still in flight is
+                # fine, the drain below lands their metrics
+                if sig is not None and sig.signals_received():
+                    exit_reason = "signal"
+                    break
+                if t.exit_interval and iteration % t.exit_interval == 0:
+                    exit_reason = "exit_interval"
+                    break
+                if t.exit_duration_in_mins and (
+                    (time.time() - t0) / 60.0 > t.exit_duration_in_mins
+                ):
+                    exit_reason = "exit_duration"
+                    break
+
+            # land any still-deferred metrics before leaving the loop
+            _retire()
+            steady_end = time.perf_counter()
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+            if profiling:  # early exit mid-window: don't leak an open trace
                 jax.profiler.stop_trace()
                 profiling = False
-                print0(f"profiler: xplane trace written to {profile_dir}",
-                      flush=True)
+            if saver is not None:
+                # exit barrier: never leave the loop (even on an exception
+                # or a signal) with checkpoint bytes half-written
+                saver.wait()
 
-            if iteration % cfg.logging.log_interval == 0:
-                avg = float(np.mean(step_times[-cfg.logging.log_interval:]))
-                training_log(cfg, metrics, iteration, avg, writer, timers,
-                             consumed_samples, global_batch_size=gbs)
-                if cfg.logging.timing_log_level >= 2 and not spans_printed:
-                    spans_printed = True  # once per run, incl. resumed runs
-                    spans = measure_span_breakdown(
-                        cfg, params, shardings["place_batch"](batch), avg,
-                        loss_fn=loss_fn,
-                    )
-                    if spans:
-                        print0("    span breakdown (ms): " + " | ".join(
-                            f"{k}: {v * 1e3:.1f}" for k, v in spans.items()),
-                            flush=True)
+        steady_sps = None
+        if steady_t0 is not None and steady_steps > 0:
+            steady_sps = steady_steps / max(steady_end - steady_t0, 1e-9)
 
-            if (cfg.training.eval_interval and valid_iter_factory
-                    and iteration % cfg.training.eval_interval == 0):
-                ev = evaluate(cfg, params, eval_step, valid_iter_factory(),
-                              place_batch=shardings["place_batch"])
-                print0(f" validation loss at iteration {iteration}: "
-                      + " | ".join(f"{k}: {v:.6E}" for k, v in ev.items()),
-                      flush=True)
-                if writer:
-                    for k, v in ev.items():
-                        writer.add_scalar(f"lm-loss-validation/{k}", v, iteration)
-
-            if (cfg.checkpoint.save and cfg.checkpoint.save_interval
-                    and iteration % cfg.checkpoint.save_interval == 0):
-                timers("save-checkpoint", 0).start()
-                save_checkpoint(cfg, cfg.checkpoint.save, iteration, params,
-                                opt_state, consumed_samples)
-                timers("save-checkpoint").stop()
-
-            # exit conditions (training.py:731-767)
-            if sig is not None and sig.signals_received():
-                exit_reason = "signal"
-                break
-            if t.exit_interval and iteration % t.exit_interval == 0:
-                exit_reason = "exit_interval"
-                break
-            if t.exit_duration_in_mins and (
-                (time.time() - t0) / 60.0 > t.exit_duration_in_mins
-            ):
-                exit_reason = "exit_duration"
-                break
-
-        if profiling:  # early exit mid-window: don't leak an open trace
-            jax.profiler.stop_trace()
         if cfg.checkpoint.save and exit_reason != "train_iters reached":
-            save_checkpoint(cfg, cfg.checkpoint.save, iteration, params,
-                            opt_state, consumed_samples)
+            _save(iteration)
+            if saver is not None:
+                saver.wait()
         if writer is not None and hasattr(writer, "flush"):
             writer.flush()
 
@@ -577,4 +756,10 @@ def pretrain(
             "exit_reason": exit_reason,
             "last_metrics": metrics,
             "mesh": mesh,
+            # async-loop observability (bench_train_loop.py evidence):
+            # compile+warmup wall time, post-warmup steps/sec, and the
+            # fetched (iteration, lm loss) trajectory (bounded window)
+            "warmup_time": warmup_time,
+            "steady_steps_per_sec": steady_sps,
+            "loss_series": list(loss_series),
         }

@@ -356,8 +356,19 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
         donate_argnums=(0, 1),
     )
 
+    # sharding specs depend only on (key, ndim), so cache them: placement
+    # runs once per step on the data path's critical thread (inline in the
+    # blocking loop, on the prefetch worker in the overlapped loop —
+    # data/prefetch.py) and must stay a dict lookup, not a spec rebuild
+    shard_cache: Dict[tuple, Any] = {}
+
     def place_batch(batch):
-        sh = batch_shardings(cfg, mesh, batch)
+        import numpy as np
+
+        key = tuple(sorted((k, int(np.ndim(v))) for k, v in batch.items()))
+        sh = shard_cache.get(key)
+        if sh is None:
+            sh = shard_cache[key] = batch_shardings(cfg, mesh, batch)
         if jax.process_count() > 1:
             # multi-host: hosts hold only their rows of the global batch
             # (core/distributed.process_batch_slice); assemble global arrays

@@ -60,11 +60,50 @@ def _device_sync():
     (jax.device_put(0.0) + 0).block_until_ready()
 
 
+class Gauge:
+    """Per-interval statistic over instantaneous values (queue depth, wait
+    milliseconds). Unlike :class:`Timer` there is no start/stop pairing, and
+    recording NEVER touches the device — the async training loop
+    (training.py) depends on observability being sync-free."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.reset()
+
+    def record(self, value: float) -> None:
+        self._sum += value
+        if value > self._max:
+            self._max = value
+        self._count += 1
+
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._max = float("-inf")
+        self._count = 0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
+
 class Timers:
-    """Timer registry with log levels 0-2 (timers.py:122-304 semantics)."""
+    """Timer + gauge registry with log levels 0-2 (timers.py:122-304
+    semantics).
+
+    None of the bookkeeping here implicitly syncs the device: Timer
+    start/stop only call :func:`_device_sync` when ``barrier=True`` is
+    explicitly passed, and gauges are pure host arithmetic — the overlapped
+    training loop would serialize on anything else."""
 
     def __init__(self, log_level: int = 0, log_option: str = "minmax"):
         self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._log_levels: Dict[str, int] = {}
         self._max_level = log_level
         self._option = log_option
@@ -74,6 +113,16 @@ class Timers:
             self._timers[name] = Timer(name)
             self._log_levels[name] = log_level
         return self._timers[name]
+
+    def gauge(self, name: str, value: float, log_level: int = 1) -> None:
+        """Record an instantaneous value under ``name`` (mean + max per
+        logging interval). Used by the async loop for queue-wait and
+        in-flight-depth observability."""
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(name)
+            self._log_levels.setdefault(name, log_level)
+        g.record(float(value))
 
     def active(self, name: str) -> bool:
         return self._log_levels.get(name, 0) <= self._max_level
@@ -88,6 +137,11 @@ class Timers:
             if n in self._timers and self._timers[n]._count > 0:
                 e = self._timers[n].elapsed(reset=reset) * 1000.0 / normalizer
                 parts.append(f"{n}: {e:.2f}")
+        for n, g in self._gauges.items():
+            if g.count > 0 and self._log_levels.get(n, 1) <= self._max_level:
+                parts.append(f"{n}: {g.mean():.2f} (max {g.max():.2f})")
+                if reset:
+                    g.reset()
         return " | ".join(parts)
 
     def write(self, writer, iteration: int, names=None, normalizer: float = 1.0):
@@ -101,3 +155,6 @@ class Timers:
                     self._timers[n].elapsed(reset=False) * 1000.0 / normalizer,
                     iteration,
                 )
+        for n, g in self._gauges.items():
+            if g.count > 0:
+                writer.add_scalar(f"gauges/{n}", g.mean(), iteration)

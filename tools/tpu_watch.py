@@ -109,6 +109,11 @@ JOBS = [
     # serving-throughput headline (bench_decode.py, engine_decode evidence)
     ("engine_decode_bench", [sys.executable, "bench_decode.py"],
      False, _bench_on_tpu),
+    # ISSUE 2: host/device overlap in the training driver — overlapped vs
+    # blocking loop steps/sec with simulated data latency (own watchdog,
+    # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
+    ("bench_train_loop", [sys.executable, "bench_train_loop.py"],
+     False, _bench_on_tpu),
     # VERDICT round-4 item 8: the 470M language-quality e2e, now a FULL
     # epoch (~2M tokens = 500 iters at gbs 16) in resume-exercising stages
     # of 100 iters with a WIKITEXT eval + E2E_470M.json rewrite per stage —
