@@ -12,6 +12,14 @@ tp/pp/dp mesh is just restoring with different shardings, which makes the
 reference's resharding tool (tools/checkpoint_util.py) a trivial
 load+save (see tools/checkpoint_util.py here). The tracker file name/format
 is kept verbatim for workflow compatibility.
+
+Commit protocol (resilience subsystem, docs/guide/resilience.md): saves land
+in ``iter_NNNNNNN.tmp``, are fsynced + manifested (per-file size/sha256,
+resilience/integrity.py), atomically renamed to ``iter_NNNNNNN``, then
+re-verified — and only a verified checkpoint advances the tracker.  A crash
+anywhere in the sequence leaves the tracker pointing at the previous whole
+checkpoint; corruption found later (verify_on_load) quarantines the dir to
+``*.corrupt`` and load falls back to the newest checkpoint that verifies.
 """
 
 from __future__ import annotations
@@ -28,7 +36,13 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from megatron_llm_tpu.resilience import integrity as _integ
+
 TRACKER_FILENAME = "latest_checkpointed_iteration.txt"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed manifest verification at its commit point."""
 
 
 def checkpoint_dir(save_dir: str, iteration: int, release: bool = False) -> str:
@@ -49,8 +63,16 @@ def read_tracker(load_dir: str) -> Tuple[Optional[int], bool]:
 
 
 def _write_tracker(save_dir: str, iteration: int) -> None:
-    with open(os.path.join(save_dir, TRACKER_FILENAME), "w") as f:
+    """Atomically advance the tracker (tmp + fsync + rename): a crash
+    mid-write must not leave a torn tracker naming garbage."""
+    path = os.path.join(save_dir, TRACKER_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         f.write(str(iteration))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _integ.fsync_dir(save_dir)
 
 
 def save_checkpoint(
@@ -67,15 +89,24 @@ def save_checkpoint(
     Multi-host: every process participates in the orbax saves (each writes
     its addressable shards — the analog of the reference's per-DP-rank
     distributed-optimizer writes, checkpointing.py:144-155); the small
-    meta/tracker files and pruning are process-0-only.
+    meta/manifest/tracker files and pruning are process-0-only.
+
+    Commit protocol (module docstring): tmp dir -> fsync + manifest ->
+    rename -> verify -> tracker.  The tracker NEVER advances to a
+    checkpoint that has not verified against its manifest — this is the
+    fix for the referenced-torn-checkpoint window the pre-resilience code
+    had (tracker written while orbax bytes were not yet durable).
     """
     import jax
 
     main = jax.process_index() == 0
     path = os.path.abspath(checkpoint_dir(save_dir, iteration))
+    tmp = path + _integ.TMP_SUFFIX
     os.makedirs(save_dir, exist_ok=True)
-    if main and os.path.exists(path):
-        shutil.rmtree(path)
+    if main:
+        for stale in (path, tmp):
+            if os.path.exists(stale):
+                shutil.rmtree(stale)
     if jax.process_count() > 1:
         # barrier: no host may enter the save while process 0 is still
         # deleting a stale directory on the shared filesystem
@@ -83,10 +114,16 @@ def save_checkpoint(
 
         multihost_utils.sync_global_devices("ckpt_rmtree")
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "params"), params)
+    ckptr.save(os.path.join(tmp, "params"), params)
     if opt_state is not None and not cfg.checkpoint.no_save_optim:
-        ckptr.save(os.path.join(path, "opt_state"), opt_state)
+        ckptr.save(os.path.join(tmp, "opt_state"), opt_state)
     ckptr.wait_until_finished()
+    if jax.process_count() > 1:
+        # every process's shards must be on the shared fs before process 0
+        # hashes and commits the directory
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_written")
     if not main:
         return
     meta = {
@@ -97,21 +134,42 @@ def save_checkpoint(
     }
     if extra_state:
         meta.update(extra_state)
-    with open(os.path.join(path, "meta.json"), "w") as f:
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1, default=str)
+    _integ.write_manifest(tmp, iteration, _integ.config_fingerprint(cfg))
+    os.rename(tmp, path)
+    _integ.fsync_dir(save_dir)
+    ok, problems = _integ.verify_checkpoint(path)
+    if not ok:
+        bad = _integ.quarantine(path)
+        raise CheckpointIntegrityError(
+            f"checkpoint iter {iteration} failed verification at commit "
+            f"({problems[:3]}); quarantined to {bad}; tracker NOT advanced"
+        )
     _write_tracker(save_dir, iteration)
     _prune_old(cfg, save_dir, iteration)
 
 
 def _prune_old(cfg, save_dir: str, latest: int) -> None:
+    """Delete old checkpoints beyond --keep_last_n_checkpoints.
+
+    Two safety properties (tests/test_resilience.py): quarantined
+    ``.corrupt`` and in-flight ``.tmp`` dirs are never touched (and never
+    crash the iteration parse, as the old ``split("_")`` did), and the
+    newest *verified* checkpoint is never deleted even when it falls
+    outside the keep window — pruning must not destroy the only good
+    resume point."""
     keep = cfg.checkpoint.keep_last_n_checkpoints
     if not keep:
         return
-    iters = sorted(
-        int(d.split("_")[1]) for d in os.listdir(save_dir)
-        if d.startswith("iter_") and os.path.isdir(os.path.join(save_dir, d))
-    )
-    for it in iters[:-keep]:
+    iters = _integ.list_checkpoint_iterations(save_dir)
+    doomed = iters[:-keep]
+    if not doomed:
+        return
+    protected = _integ.newest_verified_iteration(save_dir, checkpoint_dir)
+    for it in doomed:
+        if it == protected:
+            continue
         shutil.rmtree(checkpoint_dir(save_dir, it), ignore_errors=True)
 
 
@@ -184,6 +242,76 @@ class AsyncCheckpointSaver:
             raise err
 
 
+def _print0(msg: str) -> None:
+    if jax.process_index() == 0:
+        print(msg, flush=True)
+
+
+def _resolve_load_path(cfg, load_dir: str) -> Tuple[str, Optional[int], bool]:
+    """Pick the checkpoint dir to restore from: (path, iteration, release).
+
+    With --verify_on_load (default), the tracker-named checkpoint is
+    verified against its manifest first; a corrupt one is quarantined to
+    ``*.corrupt`` and the walk falls back to the newest checkpoint that
+    still verifies — a torn or bit-rotted latest checkpoint degrades to a
+    slightly older resume point instead of crashing the run.  Pre-manifest
+    legacy checkpoints are accepted as-is when the tracker names one
+    (upgrade path) and as a last resort during the walk."""
+    iteration, release = read_tracker(load_dir)
+    verify = getattr(cfg.checkpoint, "verify_on_load", True)
+    if release:
+        return (os.path.abspath(checkpoint_dir(load_dir, 0, True)), None, True)
+    if not verify:
+        if iteration is None:
+            raise FileNotFoundError(
+                f"no checkpoint tracker in {load_dir} ({TRACKER_FILENAME})"
+            )
+        return (os.path.abspath(checkpoint_dir(load_dir, iteration)),
+                iteration, False)
+    candidates = _integ.list_checkpoint_iterations(load_dir)
+    if iteration is None and not candidates:
+        raise FileNotFoundError(
+            f"no checkpoint tracker in {load_dir} ({TRACKER_FILENAME})"
+        )
+    # tracker-named checkpoint first, then the remaining iterations newest
+    # first (a verified checkpoint NEWER than the tracker — crash between
+    # verify and tracker write — is fully committed data and loses less)
+    order = []
+    if iteration is not None and iteration in candidates:
+        order.append(iteration)
+    order += [it for it in sorted(candidates, reverse=True)
+              if it != iteration]
+    legacy_fallback = None
+    for it in order:
+        path = os.path.abspath(checkpoint_dir(load_dir, it))
+        if not _integ.has_manifest(path):
+            if it == iteration:
+                # tracker names a pre-manifest checkpoint: legacy repo
+                # state, accept unverified (nothing to verify against)
+                return path, it, False
+            if legacy_fallback is None:
+                legacy_fallback = (path, it)
+            continue
+        ok, problems = _integ.verify_checkpoint(path)
+        if ok:
+            if it != iteration:
+                _print0(f"WARNING: resuming from verified checkpoint "
+                        f"iter {it} (tracker named {iteration})")
+            return path, it, False
+        bad = _integ.quarantine(path)
+        _print0(f"WARNING: checkpoint iter {it} failed verification "
+                f"({problems[:3]}); quarantined to {bad}")
+    if legacy_fallback is not None:
+        path, it = legacy_fallback
+        _print0(f"WARNING: no verified checkpoint in {load_dir}; falling "
+                f"back to unmanifested legacy checkpoint iter {it}")
+        return path, it, False
+    raise FileNotFoundError(
+        f"no loadable checkpoint in {load_dir}: every candidate failed "
+        f"manifest verification (quarantined to *{_integ.CORRUPT_SUFFIX})"
+    )
+
+
 def load_checkpoint(
     cfg,
     load_dir: str,
@@ -198,12 +326,14 @@ def load_checkpoint(
     restore directly into mesh placement — THIS is the tp/pp resharding path.
     Returns (params, opt_state, iteration, consumed_samples, meta).
     """
-    iteration, release = read_tracker(load_dir)
-    if iteration is None and not release:
-        raise FileNotFoundError(
-            f"no checkpoint tracker in {load_dir} ({TRACKER_FILENAME})"
-        )
-    path = os.path.abspath(checkpoint_dir(load_dir, iteration or 0, release))
+    path, iteration, release = _resolve_load_path(cfg, load_dir)
+    manifest = _integ.read_manifest(path)
+    if manifest is not None and manifest.get("config_fingerprint"):
+        fp = _integ.config_fingerprint(cfg)
+        if fp != manifest["config_fingerprint"]:
+            _print0("WARNING: checkpoint config fingerprint differs from "
+                    "the current model config — resuming across an "
+                    "architecture change is not supported")
     ckptr = ocp.StandardCheckpointer()
 
     def _abstract(tree, shardings):

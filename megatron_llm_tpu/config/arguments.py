@@ -313,6 +313,34 @@ class CheckpointConfig:
     exit_on_missing_checkpoint: bool = False
     async_save: bool = False
     keep_last_n_checkpoints: Optional[int] = None
+    # Verify the manifest (per-file size + sha256) of the checkpoint being
+    # loaded; a corrupt one is quarantined to *.corrupt and load falls back
+    # to the newest checkpoint that verifies (resilience/integrity.py).
+    verify_on_load: bool = True
+
+
+@dataclass
+class ResilienceConfig:
+    """Fault tolerance (megatron_llm_tpu/resilience/): hang watchdog,
+    supervised restarts, goodput accounting — docs/guide/resilience.md."""
+
+    # step-deadline watchdog (resilience/watchdog.py): on a silent hang,
+    # dump all thread stacks, attempt a bounded emergency save, and exit
+    # with code 43 so the supervisor restarts the run
+    watchdog: bool = False
+    # deadline = watchdog_multiplier x EMA(step time), floored
+    watchdog_multiplier: float = 10.0
+    watchdog_min_deadline: float = 60.0
+    # the first armed window covers JIT compilation — generous by design
+    watchdog_first_deadline: float = 1800.0
+    # how long the expiry path waits for the emergency host-snapshot save
+    # before exiting anyway (the snapshot may hang on a wedged device)
+    emergency_save_timeout: float = 120.0
+    # supervisor (tools/run_resilient.py) restart budget + backoff
+    max_restarts: int = 10
+    restart_backoff: float = 2.0
+    restart_backoff_max: float = 300.0
+    restart_reset_after: float = 3600.0
 
 
 @dataclass
@@ -404,6 +432,7 @@ class Config:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
     retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # architecture family: 'gpt' | 'llama' | 'llama2' | 'codellama' | 'falcon' | 'mistral'
     model_name: str = "llama2"
 
@@ -688,6 +717,7 @@ _GROUPS = {
     "logging": LoggingConfig,
     "inference": InferenceConfig,
     "retriever": RetrieverConfig,
+    "resilience": ResilienceConfig,
 }
 
 

@@ -131,7 +131,7 @@ class BatchPrefetcher:
                         and self._switch_source is not None):
                     # ramp finished: the same switch the synchronous loop
                     # makes — steady state pays no per-step concatenation
-                    src = self._switch_source(consumed)
+                    src = self._source = self._switch_source(consumed)
                     chunking = False
                     self.switched_full = True
                 if chunking:
@@ -175,11 +175,31 @@ class BatchPrefetcher:
         return self._q.qsize()
 
     def close(self, timeout: float = 5.0) -> None:
-        """Stop the worker (it may be blocked on a full queue) and join."""
+        """Stop the worker and join — promptly, on every exit path.
+
+        The worker may be blocked on a full queue (drained here) or inside
+        ``next(source)`` (a loader stalled on a dead filesystem — the hang
+        the watchdog exists for).  For the latter, closing is *propagated*
+        to the source when it supports it (data/samplers.DataIterator
+        does), which unblocks the worker's pull; the join stays bounded
+        either way so a driver exception or watchdog abort never wedges
+        process teardown behind a stuck thread (the PR-1 PJRT lesson
+        applied to our own threads).  Idempotent."""
+        self._done = True
         self._stop.set()
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        src_close = getattr(self._source, "close", None)
+        if callable(src_close):
+            try:
+                src_close()
+            except Exception:
+                pass  # teardown must not raise over the original error
         self._thread.join(timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set()

@@ -30,10 +30,18 @@ class MegatronPretrainingSampler:
         self.global_batch_size = global_batch_size
         self.drop_last = drop_last
         assert self.total_samples > 0
-        assert self.consumed_samples < self.total_samples
+        # consumed == total is a VALID resume point (a run restarted at
+        # data exhaustion): the iterator just yields nothing and the driver
+        # exits "data exhausted" instead of the old assert crash-looping
+        # the supervisor
+        assert self.consumed_samples <= self.total_samples
 
     def __len__(self):
-        return (self.total_samples - self.consumed_samples) // self.global_batch_size
+        return max(
+            0,
+            (self.total_samples - self.consumed_samples)
+            // self.global_batch_size,
+        )
 
     def __iter__(self):
         batch = []
@@ -91,28 +99,67 @@ class DataIterator:
         self.sampler = sampler
         self.collate_fn = collate_fn
         self._q: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close() — the worker must
+        never be wedged on a full queue whose consumer is gone."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self):
         try:
             for batch_indices in self.sampler:
+                if self._stop.is_set():
+                    return
                 batch = self.collate_fn([self.dataset[i] for i in batch_indices])
-                self._q.put(batch)
+                if not self._put(batch):
+                    return
         except Exception as e:  # surface worker errors to the consumer
-            self._q.put(e)
-        self._q.put(None)
+            self._put(e)
+            return
+        self._put(None)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        # bounded get: if the iterator is closed (or the worker died
+        # without its sentinel) the consumer must not block forever — the
+        # resilience layer's prompt-shutdown contract (data/prefetch.py
+        # propagates close() here on driver teardown)
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise StopIteration from None
         if item is None:
             raise StopIteration
         if isinstance(item, Exception):
             raise item
         return item
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the worker promptly and join (idempotent): drains the
+        queue so a put-blocked worker unblocks."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout)
 
 
 class _ProcessSlicedSampler:

@@ -1,0 +1,36 @@
+"""Fault tolerance for production pretraining on preemptible TPU pods.
+
+Four pieces (docs/guide/resilience.md):
+
+- :mod:`integrity` — verified checkpoints: per-file manifest + atomic
+  commit protocol (the tracker only advances past a verified manifest),
+  corruption quarantine, newest-verified fallback on load.
+- :mod:`watchdog` — a step-deadline watchdog thread that turns a silent
+  hang into a stack dump, a best-effort emergency snapshot, and a distinct
+  exit code the supervisor can classify.
+- :mod:`supervisor` — a single-host supervised runner (tools/run_resilient.py)
+  that restarts crashed/hung training under a bounded backoff budget and
+  persists ``resilience_state.json`` across restarts.
+- :mod:`goodput` — productive vs. lost wall-clock accounting (restarts,
+  recompiles, replay from the last checkpoint), reported at exit and
+  aggregated by the supervisor.
+
+Exit-code taxonomy (see :mod:`supervisor`):
+
+=====================  ====  ==========================================
+clean                     0  training completed / exited on schedule
+watchdog (hang)          43  step deadline expired (watchdog.EXIT_WATCHDOG)
+crash                  else  uncaught exception / abort
+signal                  < 0  killed by a signal (preemption, OOM-kill)
+=====================  ====  ==========================================
+"""
+
+from megatron_llm_tpu.resilience.integrity import (  # noqa: F401
+    quarantine,
+    verify_checkpoint,
+    write_manifest,
+)
+from megatron_llm_tpu.resilience.watchdog import (  # noqa: F401
+    EXIT_WATCHDOG,
+    StepWatchdog,
+)

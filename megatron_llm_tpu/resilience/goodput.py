@@ -1,0 +1,157 @@
+"""Goodput accounting: productive step time vs. wall-clock lost to failures.
+
+"Goodput" is the fraction of wall-clock a run spends making forward
+progress it gets to KEEP.  Everything else is loss, bucketed by cause so
+the operator knows what to fix:
+
+- ``init``      — process start to first dispatch (imports, mesh, data);
+- ``compile``   — the first step's JIT compile + warmup;
+- ``replay``    — steps re-executed between the resumed checkpoint and the
+  furthest point the previous attempt had reached (measured against the
+  ``progress.json`` high-water mark the driver writes at log boundaries);
+- ``restart``   — supervisor-side downtime between attempts (backoff +
+  relaunch), aggregated in ``resilience_state.json``.
+
+The in-process tracker reports at exit (``pretrain`` result key
+``"goodput"`` and, when a resilience dir is configured, a
+``goodput_last.json`` file); the supervisor sums attempt reports plus its
+own downtime into a run-level aggregate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+PROGRESS_FILENAME = "progress.json"
+REPORT_FILENAME = "goodput_last.json"
+
+
+def _atomic_write_json(path: str, payload: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def write_progress(resil_dir: str, iteration: int) -> None:
+    """High-water mark of observed progress — written at log boundaries
+    (cheap, tiny, atomic), NOT only at checkpoints: the gap between the
+    last checkpoint and this mark is exactly the replay a restart pays."""
+    try:
+        os.makedirs(resil_dir, exist_ok=True)
+        _atomic_write_json(os.path.join(resil_dir, PROGRESS_FILENAME),
+                           {"iteration": int(iteration),
+                            "ts_unix": int(time.time())})
+    except OSError:
+        pass  # observability is never worth crashing training over
+
+
+def read_progress(resil_dir: Optional[str]) -> Optional[int]:
+    if not resil_dir:
+        return None
+    try:
+        with open(os.path.join(resil_dir, PROGRESS_FILENAME)) as f:
+            return int(json.load(f)["iteration"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def write_report(resil_dir: str, report: Dict) -> None:
+    try:
+        os.makedirs(resil_dir, exist_ok=True)
+        _atomic_write_json(os.path.join(resil_dir, REPORT_FILENAME), report)
+    except OSError:
+        pass
+
+
+def read_report(resil_dir: Optional[str]) -> Optional[Dict]:
+    if not resil_dir:
+        return None
+    try:
+        with open(os.path.join(resil_dir, REPORT_FILENAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class GoodputTracker:
+    """Per-attempt accounting; the driver feeds it and reads the report.
+
+    All inputs are host wall-clock spans the driver already measures — the
+    tracker never touches the device (the async-loop rule)."""
+
+    def __init__(self, start_time: Optional[float] = None):
+        self._t0 = time.time() if start_time is None else start_time
+        self.resumed_iteration = 0
+        self.prev_progress_iteration: Optional[int] = None
+        self.compile_seconds = 0.0
+        self.productive_steps = 0
+        self.productive_seconds = 0.0
+        self.replayed_steps = 0
+
+    def run_started(self, resumed_iteration: int,
+                    prev_progress_iteration: Optional[int] = None) -> None:
+        self.resumed_iteration = int(resumed_iteration)
+        self.prev_progress_iteration = prev_progress_iteration
+        if prev_progress_iteration is not None:
+            self.replayed_steps = max(
+                0, int(prev_progress_iteration) - int(resumed_iteration))
+
+    def record_compile(self, seconds: float) -> None:
+        self.compile_seconds = float(seconds)
+
+    def record_productive(self, steps: int, seconds: float) -> None:
+        """Post-warmup stepping span (steady_t0 .. last step observed)."""
+        self.productive_steps = int(steps)
+        self.productive_seconds = max(float(seconds), 0.0)
+
+    def report(self, now: Optional[float] = None) -> Dict:
+        now = time.time() if now is None else now
+        total = max(now - self._t0, 1e-9)
+        mean_step = (self.productive_seconds / self.productive_steps
+                     if self.productive_steps else 0.0)
+        replay_seconds = self.replayed_steps * mean_step
+        # replayed steps executed inside the productive span but produce
+        # nothing new — they move from the productive bucket to loss
+        kept = max(self.productive_seconds - replay_seconds, 0.0)
+        other = max(total - self.productive_seconds - self.compile_seconds,
+                    0.0)
+        return {
+            "wall_seconds": round(total, 3),
+            "productive_seconds": round(kept, 3),
+            "productive_steps": self.productive_steps - self.replayed_steps,
+            "lost_compile_seconds": round(self.compile_seconds, 3),
+            "lost_replay_seconds": round(replay_seconds, 3),
+            "replayed_steps": self.replayed_steps,
+            "other_seconds": round(other, 3),  # init, data, eval, saves
+            "goodput_fraction": round(kept / total, 4),
+            "resumed_iteration": self.resumed_iteration,
+        }
+
+
+def aggregate_reports(reports, downtime_seconds: float = 0.0) -> Dict:
+    """Supervisor-side sum over attempt reports + inter-attempt downtime."""
+    total = downtime_seconds
+    productive = compile_s = replay_s = 0.0
+    steps = 0
+    for r in reports:
+        if not r:
+            continue
+        total += r.get("wall_seconds", 0.0)
+        productive += r.get("productive_seconds", 0.0)
+        compile_s += r.get("lost_compile_seconds", 0.0)
+        replay_s += r.get("lost_replay_seconds", 0.0)
+        steps += r.get("productive_steps", 0)
+    return {
+        "wall_seconds": round(total, 3),
+        "productive_seconds": round(productive, 3),
+        "productive_steps": steps,
+        "lost_compile_seconds": round(compile_s, 3),
+        "lost_replay_seconds": round(replay_s, 3),
+        "lost_restart_seconds": round(downtime_seconds, 3),
+        "goodput_fraction": round(productive / total, 4) if total > 0 else 0.0,
+    }

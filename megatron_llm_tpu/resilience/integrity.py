@@ -1,0 +1,225 @@
+"""Checkpoint integrity: per-checkpoint manifests + the atomic commit protocol.
+
+A production run on preemptible TPU pods dies mid-write; the failure mode
+that actually loses runs is not the crash itself but a *referenced torn
+checkpoint* — a tracker file naming bytes that never became durable.  This
+module makes the manifest the commit point:
+
+  1. orbax writes into ``iter_NNNNNNN.tmp``;
+  2. every file is fsynced, then ``MANIFEST.json`` (per-file size + sha256,
+     iteration, config fingerprint) is written and fsynced;
+  3. the tmp dir is atomically renamed to ``iter_NNNNNNN`` (same fs);
+  4. the committed dir is re-verified against its manifest, and only then
+     does the tracker advance (checkpointing._write_tracker — itself an
+     atomic replace).
+
+A crash at any point leaves either a ``.tmp`` dir (ignored and reclaimed by
+the next save) or a fully manifested checkpoint; the tracker can only ever
+name the latter.  ``verify_checkpoint`` + ``quarantine`` + the newest-first
+fallback walk in ``checkpointing.load_checkpoint`` handle the remaining
+case — bytes rotting *after* commit (bit flips, truncation, partial fs
+loss): the corrupt dir is renamed ``*.corrupt`` and resume falls back to
+the newest checkpoint that still verifies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST_FILENAME = "MANIFEST.json"
+CORRUPT_SUFFIX = ".corrupt"
+TMP_SUFFIX = ".tmp"
+MANIFEST_VERSION = 1
+
+_HASH_CHUNK = 4 * 1024 * 1024
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable digest of the architecture-defining config (model group +
+    family name): two checkpoints with different fingerprints are not
+    resume-compatible, and load warns on mismatch."""
+    import dataclasses
+
+    payload = {
+        "model": dataclasses.asdict(cfg.model),
+        "model_name": cfg.model_name,
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def file_digest(path: str) -> Tuple[int, str]:
+    """(size, sha256 hex) of a file, streamed in bounded chunks."""
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_HASH_CHUNK)
+            if not chunk:
+                break
+            size += len(chunk)
+            h.update(chunk)
+    return size, h.hexdigest()
+
+
+def _walk_files(root: str) -> List[str]:
+    """Sorted relpaths of every regular file under root, minus the manifest
+    itself (it cannot self-hash)."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            if rel != MANIFEST_FILENAME:
+                out.append(rel)
+    return sorted(out)
+
+
+def fsync_dir(path: str) -> None:
+    """Make a directory entry (rename/create) durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def manifest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_FILENAME)
+
+
+def has_manifest(ckpt_dir: str) -> bool:
+    return os.path.isfile(manifest_path(ckpt_dir))
+
+
+def write_manifest(ckpt_dir: str, iteration: int,
+                   config_fp: Optional[str] = None,
+                   fsync: bool = True) -> Dict:
+    """Hash (and fsync) every file under ``ckpt_dir``, then atomically write
+    MANIFEST.json.  This is step 2 of the commit protocol: after it returns,
+    the checkpoint's bytes are durable and self-describing."""
+    files: Dict[str, Dict] = {}
+    for rel in _walk_files(ckpt_dir):
+        full = os.path.join(ckpt_dir, rel)
+        if fsync:
+            _fsync_file(full)
+        size, digest = file_digest(full)
+        files[rel] = {"size": size, "sha256": digest}
+    manifest = {
+        "format_version": MANIFEST_VERSION,
+        "iteration": iteration,
+        "config_fingerprint": config_fp,
+        "num_files": len(files),
+        "total_bytes": sum(f["size"] for f in files.values()),
+        "files": files,
+    }
+    tmp = manifest_path(ckpt_dir) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, manifest_path(ckpt_dir))
+    if fsync:
+        fsync_dir(ckpt_dir)
+    return manifest
+
+
+def read_manifest(ckpt_dir: str) -> Optional[Dict]:
+    try:
+        with open(manifest_path(ckpt_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_checkpoint(ckpt_dir: str) -> Tuple[bool, List[str]]:
+    """Check every manifested file's presence, size, and sha256.
+
+    Returns ``(ok, problems)``.  A missing or unparseable manifest is itself
+    a problem (``"missing manifest"``) — callers that want to accept
+    pre-manifest legacy checkpoints should gate on :func:`has_manifest`.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return False, [f"not a directory: {ckpt_dir}"]
+    manifest = read_manifest(ckpt_dir)
+    if manifest is None:
+        return False, ["missing manifest"]
+    problems: List[str] = []
+    files = manifest.get("files", {})
+    for rel, expect in files.items():
+        full = os.path.join(ckpt_dir, rel)
+        if not os.path.isfile(full):
+            problems.append(f"missing file: {rel}")
+            continue
+        size = os.path.getsize(full)
+        if size != expect["size"]:
+            problems.append(
+                f"size mismatch: {rel} ({size} != {expect['size']})")
+            continue
+        _, digest = file_digest(full)
+        if digest != expect["sha256"]:
+            problems.append(f"sha256 mismatch: {rel}")
+    # files that appeared after commit are suspicious but not fatal;
+    # files that vanished are covered above
+    return (not problems), problems
+
+
+def quarantine(ckpt_dir: str) -> str:
+    """Rename a corrupt checkpoint dir out of the resume path
+    (``iter_NNNNNNN`` -> ``iter_NNNNNNN.corrupt``), keeping the bytes for
+    post-mortem.  Returns the new path."""
+    target = ckpt_dir + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(target):
+        n += 1
+        target = f"{ckpt_dir}{CORRUPT_SUFFIX}{n}"
+    os.rename(ckpt_dir, target)
+    fsync_dir(os.path.dirname(ckpt_dir) or ".")
+    return target
+
+
+def list_checkpoint_iterations(save_dir: str) -> List[int]:
+    """Committed checkpoint iterations in ``save_dir``, ascending.  Strictly
+    ``iter_NNNNNNN`` dirs: quarantined ``.corrupt`` and in-flight ``.tmp``
+    dirs never count."""
+    out = []
+    try:
+        entries = os.listdir(save_dir)
+    except OSError:
+        return []
+    for d in entries:
+        if not d.startswith("iter_"):
+            continue
+        suffix = d[len("iter_"):]
+        if not suffix.isdigit():
+            continue  # iter_0000003.corrupt / .tmp / strays
+        if os.path.isdir(os.path.join(save_dir, d)):
+            out.append(int(suffix))
+    return sorted(out)
+
+
+def newest_verified_iteration(save_dir: str,
+                              checkpoint_dir_fn) -> Optional[int]:
+    """Newest iteration whose checkpoint verifies against its manifest
+    (newest-first walk, stops at the first good one).  Legacy dirs without
+    a manifest do not count as *verified*."""
+    for it in reversed(list_checkpoint_iterations(save_dir)):
+        path = checkpoint_dir_fn(save_dir, it)
+        if has_manifest(path) and verify_checkpoint(path)[0]:
+            return it
+    return None

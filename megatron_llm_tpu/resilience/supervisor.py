@@ -1,0 +1,252 @@
+"""Single-host supervised runner: launch, classify, back off, restart.
+
+The Megatron-scale operational posture (PAPERS.md, arXiv:2104.04473) is
+that restart/resume is a subsystem, not an ops runbook: a crashed or hung
+training process should come back by itself, resume from the newest
+verified checkpoint, and the time lost should be *measured*.  This module
+is the driver for that loop on one host (the TPU-pod generalization is one
+supervisor per host under the same state dir):
+
+- launches the training command as a subprocess;
+- classifies its exit (``clean`` / ``hang`` (watchdog code 43) /
+  ``signal`` / ``crash``);
+- restarts with exponential backoff under a bounded restart budget
+  (consecutive-failure based; a long productive run resets the streak);
+- forwards SIGTERM/SIGINT for graceful preemption (child saves + exits,
+  supervisor does NOT restart);
+- persists ``resilience_state.json`` (attempt history + aggregate
+  goodput) across its own restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from megatron_llm_tpu.resilience import goodput as gp
+from megatron_llm_tpu.resilience.watchdog import EXIT_WATCHDOG
+
+STATE_FILENAME = "resilience_state.json"
+
+# exit classes (see package docstring for the taxonomy)
+CLEAN = "clean"
+HANG = "hang"
+SIGNAL = "signal"
+CRASH = "crash"
+
+# env var the supervisor sets so the child's driver finds the shared
+# resilience dir (progress/goodput files) without extra flags
+RESIL_DIR_ENV = "MLT_RESIL_DIR"
+
+
+def classify_exit(returncode: int) -> str:
+    if returncode == 0:
+        return CLEAN
+    if returncode == EXIT_WATCHDOG:
+        return HANG
+    if returncode < 0:
+        return SIGNAL  # killed by signal -returncode (SIGKILL preemption &c)
+    return CRASH
+
+
+class RestartPolicy:
+    """Bounded exponential backoff over *consecutive* failures.
+
+    ``max_restarts`` caps total restarts for the supervisor's lifetime (a
+    hard budget against crash loops); a child that ran productively for at
+    least ``reset_after`` seconds resets the consecutive-failure streak, so
+    one flaky preemption a day never exhausts the budget's backoff curve.
+    """
+
+    def __init__(self, max_restarts: int = 10, backoff_base: float = 2.0,
+                 backoff_max: float = 300.0, reset_after: float = 3600.0):
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.reset_after = float(reset_after)
+
+    def next_delay(self, consecutive_failures: int) -> float:
+        n = max(int(consecutive_failures), 1)
+        return min(self.backoff_max, self.backoff_base * (2.0 ** (n - 1)))
+
+
+class Supervisor:
+    """Run ``cmd`` under the restart policy; returns the final exit code.
+
+    ``state_dir`` holds ``resilience_state.json`` plus the goodput/progress
+    files the child writes (the supervisor exports it as ``MLT_RESIL_DIR``).
+    """
+
+    def __init__(self, cmd: List[str], state_dir: str,
+                 policy: Optional[RestartPolicy] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 term_grace: float = 30.0,
+                 install_signal_handlers: Optional[bool] = None):
+        self.cmd = list(cmd)
+        self.state_dir = state_dir
+        self.policy = policy or RestartPolicy()
+        self.term_grace = float(term_grace)
+        self._env = env
+        self._proc: Optional[subprocess.Popen] = None
+        self._terminate = threading.Event()
+        if install_signal_handlers is None:
+            install_signal_handlers = (
+                threading.current_thread() is threading.main_thread())
+        self._install_handlers = install_signal_handlers
+
+    # ---- state persistence ----
+
+    @property
+    def state_path(self) -> str:
+        return os.path.join(self.state_dir, STATE_FILENAME)
+
+    def load_state(self) -> Dict:
+        try:
+            with open(self.state_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"attempts": [], "restarts_used": 0,
+                    "downtime_seconds": 0.0}
+
+    def _save_state(self, state: Dict) -> None:
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, self.state_path)
+
+    # ---- signal forwarding ----
+
+    def _forward_signal(self, signum, _frame) -> None:
+        """Graceful preemption: pass the signal to the child (which saves
+        and exits) and stop restarting."""
+        self._terminate.set()
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    @property
+    def child_pid(self) -> Optional[int]:
+        proc = self._proc
+        return proc.pid if proc is not None and proc.poll() is None else None
+
+    def request_stop(self) -> None:
+        """Programmatic SIGTERM path (tests / embedding)."""
+        self._forward_signal(signal.SIGTERM, None)
+
+    # ---- main loop ----
+
+    def run(self) -> int:
+        if self._install_handlers:
+            signal.signal(signal.SIGTERM, self._forward_signal)
+            signal.signal(signal.SIGINT, self._forward_signal)
+        state = self.load_state()
+        consecutive = 0
+        env = dict(self._env if self._env is not None else os.environ)
+        env[RESIL_DIR_ENV] = os.path.abspath(self.state_dir)
+        rc = 1
+        while True:
+            launch_t = time.time()
+            self._log(f"launching attempt {len(state['attempts']) + 1}: "
+                      f"{' '.join(self.cmd)}")
+            self._proc = subprocess.Popen(self.cmd, env=env)
+            rc = self._wait_child()
+            duration = time.time() - launch_t
+            cls = classify_exit(rc)
+            report = gp.read_report(self.state_dir)
+            if report is not None and report.get("_consumed"):
+                report = None  # stale file from a previous attempt
+            if report is not None:
+                # mark consumed so a SIGKILLed next attempt (which writes
+                # nothing) is not credited with this attempt's goodput
+                gp.write_report(self.state_dir, dict(report, _consumed=True))
+            state["attempts"].append({
+                "ts_unix": int(launch_t),
+                "rc": rc,
+                "class": cls,
+                "duration_seconds": round(duration, 3),
+                "goodput": report,
+            })
+            if cls == CLEAN:
+                self._finish(state, "clean exit")
+                return 0
+            if self._terminate.is_set():
+                self._finish(state, f"terminated (child rc {rc})")
+                return rc if rc >= 0 else 128 + (-rc)
+            if duration >= self.policy.reset_after:
+                consecutive = 0
+            consecutive += 1
+            state["restarts_used"] = state.get("restarts_used", 0) + 1
+            if state["restarts_used"] > self.policy.max_restarts:
+                self._finish(
+                    state,
+                    f"restart budget exhausted "
+                    f"({self.policy.max_restarts}); last class {cls}")
+                return rc if rc > 0 else 1
+            delay = self.policy.next_delay(consecutive)
+            self._log(f"child exited rc={rc} ({cls}) after {duration:.1f}s; "
+                      f"restart {state['restarts_used']}/"
+                      f"{self.policy.max_restarts} in {delay:.1f}s")
+            self._save_state(state)
+            downtime_t0 = time.time()
+            if self._terminate.wait(timeout=delay):
+                self._finish(state, "terminated during backoff")
+                return 128 + signal.SIGTERM
+            state["downtime_seconds"] = round(
+                state.get("downtime_seconds", 0.0)
+                + (time.time() - downtime_t0), 3)
+
+    def _wait_child(self) -> int:
+        """Wait for the child, staying responsive to termination requests
+        (the handler forwards SIGTERM; here we enforce the grace window)."""
+        proc = self._proc
+        term_sent_at = None
+        while True:
+            try:
+                return proc.wait(timeout=0.2)
+            except subprocess.TimeoutExpired:
+                pass
+            if self._terminate.is_set():
+                if term_sent_at is None:
+                    term_sent_at = time.time()
+                    try:  # idempotent with the handler's forward
+                        proc.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+                elif time.time() - term_sent_at > self.term_grace:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+                    return proc.wait()
+
+    def _finish(self, state: Dict, reason: str) -> None:
+        """Final bookkeeping: aggregate goodput across attempts.  Attempts
+        that died without writing a report (SIGKILL) contribute their whole
+        duration as loss."""
+        reports = [a["goodput"] for a in state["attempts"] if a["goodput"]]
+        unreported = sum(a["duration_seconds"] for a in state["attempts"]
+                         if not a["goodput"])
+        downtime = state.get("downtime_seconds", 0.0) + unreported
+        state["aggregate_goodput"] = gp.aggregate_reports(reports, downtime)
+        state["final"] = reason
+        self._save_state(state)
+        agg = state["aggregate_goodput"]
+        self._log(f"{reason} | attempts {len(state['attempts'])} | goodput "
+                  f"{agg['goodput_fraction'] * 100:.1f}% "
+                  f"({agg['productive_seconds']:.1f}s productive / "
+                  f"{agg['wall_seconds']:.1f}s wall)")
+
+    @staticmethod
+    def _log(msg: str) -> None:
+        print(f"[run_resilient] {msg}", file=sys.stderr, flush=True)

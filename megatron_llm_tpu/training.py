@@ -434,6 +434,51 @@ def pretrain(
                     raise
                 print0(f"WARNING: {e}; training from scratch")
 
+        # ---- resilience: goodput accounting + hang watchdog ----
+        # (docs/guide/resilience.md) The supervisor (tools/run_resilient.py)
+        # exports MLT_RESIL_DIR; standalone runs fall back to a subdir of
+        # the save dir so goodput/progress records always have a home when
+        # checkpoints do.
+        from megatron_llm_tpu.resilience import goodput as gp_mod
+        from megatron_llm_tpu.resilience.watchdog import StepWatchdog
+
+        resil_dir = os.environ.get("MLT_RESIL_DIR") or (
+            os.path.join(cfg.checkpoint.save, "resilience")
+            if cfg.checkpoint.save else None
+        )
+        goodput = gp_mod.GoodputTracker(t0)
+        goodput.run_started(iteration, gp_mod.read_progress(resil_dir))
+        if goodput.replayed_steps:
+            print0(f"resilience: replaying {goodput.replayed_steps} steps "
+                   f"(progress high-water {goodput.prev_progress_iteration}, "
+                   f"resumed at {iteration})")
+
+        watchdog = None
+        if cfg.resilience.watchdog:
+            def _emergency_snapshot():
+                # host snapshot of the last COMPLETED state the driver
+                # holds; bounded by the watchdog (a wedged device hangs
+                # device_get too), and safe: the tracker only advances
+                # past a verified manifest, so a torn write is never
+                # referenced
+                if cfg.checkpoint.save:
+                    save_checkpoint(cfg, cfg.checkpoint.save, iteration,
+                                    params, opt_state, consumed_samples)
+
+            r = cfg.resilience
+            watchdog = StepWatchdog(
+                multiplier=r.watchdog_multiplier,
+                min_deadline=r.watchdog_min_deadline,
+                first_deadline=r.watchdog_first_deadline,
+                snapshot_fn=_emergency_snapshot,
+                snapshot_timeout=r.emergency_save_timeout,
+                gauge_fn=lambda: timers.gauge("watchdog-expired", 1.0),
+            ).start()
+            print0(f"resilience: watchdog armed per step "
+                   f"(deadline {r.watchdog_multiplier}x EMA, floor "
+                   f"{r.watchdog_min_deadline:.0f}s, first step "
+                   f"{r.watchdog_first_deadline:.0f}s)")
+
         # ---- data ----
         rebuild_full_loader = None
         if data_iterators_provider is not None:
@@ -571,6 +616,13 @@ def pretrain(
             while iteration < train_iters:
                 if t.skip_train:
                     break
+                # watchdog window covers the loop body (data wait, dispatch,
+                # completion probe, log drain) — the places a wedged device
+                # or dead loader silently blocks the host.  Eval and
+                # checkpoint saves run disarmed: legitimately slow.
+                if watchdog is not None:
+                    watchdog.arm(first=warmup_time is None)
+                iter_t0 = time.perf_counter()
                 # xplane tracing over [profile_step_start, profile_step_end)
                 # (SURVEY §5: jax-profiler analog of the reference's span
                 # timers). >= not ==: a resumed run past the start step still
@@ -691,6 +743,14 @@ def pretrain(
                                 for k, v in spans.items()), flush=True)
                     interval_t0 = time.perf_counter()
                     interval_steps = 0
+                    if resil_dir:
+                        # progress high-water mark: what a restart would
+                        # have to replay from the last checkpoint
+                        gp_mod.write_progress(resil_dir, iteration)
+
+                if watchdog is not None:
+                    watchdog.disarm(None if first_step
+                                    else time.perf_counter() - iter_t0)
 
                 if (cfg.training.eval_interval and valid_iter_factory
                         and iteration % cfg.training.eval_interval == 0):
@@ -724,9 +784,15 @@ def pretrain(
                     break
 
             # land any still-deferred metrics before leaving the loop
+            if watchdog is not None:
+                watchdog.disarm()  # StopIteration breaks exit armed
             _retire()
             steady_end = time.perf_counter()
         finally:
+            # watchdog first: cleanup below (close/join/flush) is
+            # legitimately slow and must not trip a stale deadline
+            if watchdog is not None:
+                watchdog.stop()
             if prefetcher is not None:
                 prefetcher.close()
             if profiling:  # early exit mid-window: don't leak an open trace
@@ -736,6 +802,23 @@ def pretrain(
                 # exit barrier: never leave the loop (even on an exception
                 # or a signal) with checkpoint bytes half-written
                 saver.wait()
+            # goodput report on EVERY exit path (normal, exception,
+            # signal-break) so the supervisor can aggregate what this
+            # attempt kept vs. lost
+            goodput.record_compile(warmup_time or 0.0)
+            if steady_t0 is not None:
+                goodput.record_productive(
+                    steady_steps, time.perf_counter() - steady_t0)
+            goodput_report = goodput.report()
+            if resil_dir:
+                gp_mod.write_report(resil_dir, goodput_report)
+            print0("goodput: "
+                   f"{goodput_report['goodput_fraction'] * 100:.1f}% "
+                   f"({goodput_report['productive_seconds']:.1f}s productive"
+                   f" / {goodput_report['wall_seconds']:.1f}s wall, "
+                   f"compile {goodput_report['lost_compile_seconds']:.1f}s, "
+                   f"replay {goodput_report['replayed_steps']} steps)",
+                   flush=True)
 
         steady_sps = None
         if steady_t0 is not None and steady_steps > 0:
@@ -762,4 +845,8 @@ def pretrain(
             "warmup_time": warmup_time,
             "steady_steps_per_sec": steady_sps,
             "loss_series": list(loss_series),
+            # resilience observability (docs/guide/resilience.md): what this
+            # run kept vs. lost to compile/replay — also persisted to
+            # <resil_dir>/goodput_last.json for the supervisor
+            "goodput": goodput_report,
         }

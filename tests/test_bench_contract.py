@@ -156,6 +156,42 @@ def test_engine_decode_bench_in_watch_jobs():
     assert bounded is False and pred is _bench_on_tpu
 
 
+def test_resilience_smoke_in_watch_jobs():
+    """ISSUE 3: the resilience chaos smoke is in the tunnel-up capture
+    list.  Unlike the bench jobs it IS bounded by --job_timeout: its
+    orchestrator has no internal watchdog, and its chaos children run on
+    CPU (mid-step TPU kills wedge the tunnel), so a last-resort kill of
+    the orchestrator cannot wedge anything."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "resilience_chaos" in by_name
+    cmd, bounded, pred = by_name["resilience_chaos"]
+    assert cmd[-1].endswith("resilience_smoke.py")
+    assert bounded is True and pred is _bench_on_tpu
+
+
+def test_resilience_smoke_cpu_contract(evidence_dir):
+    """Off-TPU the smoke reports headline 0 under the bench contract, with
+    the chaos measurements riding in cpu_sanity; TPU evidence goes to its
+    own tagged file and never clobbers the headline record."""
+    line = bench.cpu_contract_line({
+        "metric": "resilience_chaos_goodput_1chip",
+        "value": 87.5, "unit": "%goodput", "backend": "cpu",
+        "passed": True,
+        "chaos": {"bitwise_identical": True, "attempt_classes":
+                  ["signal", "clean"]},
+    }, tag="resilience")
+    assert line["value"] == 0.0 and line["unit"] == "%goodput"
+    assert line["cpu_sanity"]["chaos"]["bitwise_identical"] is True
+    assert not _bench_on_tpu(json.dumps(line))
+    bench.persist_tpu_result({"metric": "resilience_chaos_goodput_1chip",
+                              "value": 91.0, "backend": "tpu"}, {},
+                             tag="resilience")
+    assert bench.load_last_tpu(tag="resilience")["value"] == 91.0
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
 def test_e2e_470m_contract_line():
     """tools/e2e_470m.py off-TPU: headline 0, and the watcher predicate
     must NOT count that line as captured evidence."""

@@ -1,0 +1,96 @@
+"""Supervised training launcher: auto-restart under a bounded budget.
+
+Wraps any training command (default: ``finetune.py`` with the forwarded
+flags) in the resilience supervisor (megatron_llm_tpu/resilience/):
+
+    # explicit command after --
+    python tools/run_resilient.py --state_dir ckpts/resil \\
+        --max_restarts 20 -- python finetune.py --model_name llama2 ... \\
+        --save ckpts --save_interval 200 --watchdog true
+
+    # or let it build the finetune.py command from the leftover flags
+    python tools/run_resilient.py --state_dir ckpts/resil \\
+        --model_name llama2 --data_path ... --save ckpts --watchdog true
+
+Behavior (docs/guide/resilience.md):
+  * crash / watchdog-hang (exit 43) / signal-kill exits are restarted with
+    exponential backoff, up to ``--max_restarts`` total;
+  * SIGTERM/SIGINT to the supervisor forwards to the child (graceful
+    preemption: the driver saves and exits) and disables restarting;
+  * attempt history + aggregate goodput persist in
+    ``<state_dir>/resilience_state.json``;
+  * the child finds the shared state dir via ``MLT_RESIL_DIR`` and writes
+    its per-attempt goodput report + progress high-water mark there.
+
+Resume correctness is the checkpoint layer's job: the child always
+restarts from the newest *verified* checkpoint (tracker + manifest
+fallback walk), and the data samplers replay the identical batch sequence
+from the restored consumed_samples (tests/test_resilience.py asserts the
+loss trajectory is bitwise-identical to an uninterrupted run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from megatron_llm_tpu.resilience.supervisor import (  # noqa: E402
+    RestartPolicy,
+    Supervisor,
+)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--state_dir", default="resilience_state",
+                    help="dir for resilience_state.json + goodput/progress "
+                         "files (exported to the child as MLT_RESIL_DIR)")
+    ap.add_argument("--max_restarts", type=int, default=10)
+    ap.add_argument("--restart_backoff", type=float, default=2.0,
+                    help="base seconds; doubles per consecutive failure")
+    ap.add_argument("--restart_backoff_max", type=float, default=300.0)
+    ap.add_argument("--restart_reset_after", type=float, default=3600.0,
+                    help="a child that ran at least this long resets the "
+                         "consecutive-failure backoff streak")
+    ap.add_argument("--term_grace", type=float, default=30.0,
+                    help="seconds after SIGTERM before the child is killed")
+    return ap
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        sup_args, cmd = argv[:split], argv[split + 1:]
+        if not cmd:
+            print("run_resilient: empty command after --", file=sys.stderr)
+            return 2
+    else:
+        sup_args, cmd = argv, None
+    ap = build_arg_parser()
+    ns, leftover = ap.parse_known_args(sup_args)
+    if cmd is None:
+        # leftover flags are the training config; run finetune.py
+        cmd = [sys.executable, os.path.join(REPO, "finetune.py")] + leftover
+    elif leftover:
+        print(f"run_resilient: unknown flags {leftover} (training flags go "
+              f"after --)", file=sys.stderr)
+        return 2
+    policy = RestartPolicy(
+        max_restarts=ns.max_restarts,
+        backoff_base=ns.restart_backoff,
+        backoff_max=ns.restart_backoff_max,
+        reset_after=ns.restart_reset_after,
+    )
+    sup = Supervisor(cmd, ns.state_dir, policy=policy,
+                     term_grace=ns.term_grace)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,13 @@ JOBS = [
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
     ("bench_train_loop", [sys.executable, "bench_train_loop.py"],
      False, _bench_on_tpu),
+    # ISSUE 3: resilience chaos smoke — kill-9/corrupt/hang round-trips on
+    # CPU (mid-step kills would wedge the tunnel) + an integrity/resume
+    # round-trip on TPU for the evidence line. Its children carry their own
+    # subprocess timeouts, but the orchestrator has no watchdog of its own,
+    # so it gets the last-resort --job_timeout.
+    ("resilience_chaos", [sys.executable, "tools/resilience_smoke.py"],
+     True, _bench_on_tpu),
     # VERDICT round-4 item 8: the 470M language-quality e2e, now a FULL
     # epoch (~2M tokens = 500 iters at gbs 16) in resume-exercising stages
     # of 100 iters with a WIKITEXT eval + E2E_470M.json rewrite per stage —
