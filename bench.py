@@ -45,26 +45,19 @@ import sys
 import threading
 import time
 
-PEAK_BF16_FLOPS_BY_KIND = {
-    # per-chip peak dense bf16 FLOP/s, by EXACT device_kind string — the
-    # single source of truth (tools/aot_scale_check.py estimates divide by
-    # the same numbers the measured MFU divides by)
-    "TPU v5 lite": 197e12,
-    "TPU v5": 459e12,     # v5p
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,  # Trillium
-    "TPU v6e": 918e12,
-}
-PEAK_BF16_FLOPS = {
-    # substring fallback on normalized device_kind (live-device probing)
-    "v5litepod": 197e12,
-    "v5lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "v6e": 918e12,
-    "cpu": 1e12,  # nominal, so the script still produces a line off-TPU
-}
+# peak tables live with the rest of the flops accounting
+# (megatron_llm_tpu/observability/flops.py — the registry's MFU gauge and
+# this bench's measured MFU divide by the same numbers); re-exported here
+# under the historical names (tools/aot_scale_check.py imports them)
+from megatron_llm_tpu.observability.flops import (  # noqa: E402
+    PEAK_BF16_FLOPS_BY_KIND,
+    PEAK_BF16_FLOPS_SUBSTR,
+)
+
+PEAK_BF16_FLOPS = dict(
+    PEAK_BF16_FLOPS_SUBSTR,
+    cpu=1e12,  # nominal, so the script still produces a line off-TPU
+)
 BASELINE_MFU = 0.117  # reference 8xA100 node, see module docstring
 METRIC = "train_mfu_llama_470m_seq1024_1chip"
 LAST_TPU_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

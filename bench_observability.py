@@ -1,0 +1,280 @@
+"""Observability overhead benchmark — prints ONE JSON line for the driver.
+
+Metric: steady-state steps/sec of the real ``pretrain`` loop with FULL
+instrumentation on (span tracing + window dumps, registry publishing from
+timers/gauges/goodput, live /metrics endpoint) versus the same loop with
+all of it off (tracer disabled, registry publishing switched off, no
+exporter).  Zero simulated data latency: the hot-loop regime where
+per-step host work is smallest and instrumentation overhead is therefore
+proportionally LARGEST — the honest worst case.
+
+Gate (ISSUE 4 acceptance): overhead < 3% steps/sec (``overhead_pct`` in
+the line; the slow-lane test in tests/test_observability.py asserts it).
+The bitwise loss-trajectory equality of the two modes is asserted in the
+tier-1 lane of the same test file.
+
+Same tunnel-hardening contract as bench.py / bench_train_loop.py: backend
+probed in a bounded subprocess; off-TPU the headline is 0 with the run
+riding under ``cpu_sanity``; TPU measurements persist to
+``BENCH_LAST_TPU_observability.json``; a watchdog turns hangs into
+structured error lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import (  # noqa: E402
+    cpu_contract_line,
+    persist_tpu_result,
+    probe_backend,
+)
+from bench_train_loop import make_provider  # noqa: E402
+
+METRIC = "train_loop_observed_steps_s_1chip"
+GATE_OVERHEAD_PCT = 3.0
+
+
+def run_mode(make_cfg, vocab: int, seq: int, iters: int,
+             instrumented: bool, trace_dir: str | None = None) -> dict:
+    """One full pretrain() run; returns steady-state timing fields."""
+    from megatron_llm_tpu.observability import registry as registry_mod
+    from megatron_llm_tpu.observability import trace as trace_mod
+    from megatron_llm_tpu.training import pretrain
+
+    cfg = make_cfg(iters)
+    registry_mod.set_publishing(instrumented)
+    if instrumented:
+        cfg.logging.trace_dir = trace_dir
+        cfg.logging.trace_steps = 10
+        cfg.logging.metrics_port = 0  # live endpoint, ephemeral port
+    else:
+        trace_mod.disable()
+    try:
+        result = pretrain(
+            cfg, data_iterators_provider=make_provider(0.0, vocab, seq))
+    finally:
+        registry_mod.set_publishing(True)
+        trace_mod.disable()
+    return {
+        "steps_per_sec": result["steady_steps_per_sec"],
+        "loss_series": result["loss_series"],
+    }
+
+
+def run_pair(make_cfg, vocab: int, seq: int, iters: int,
+             trace_dir: str, rounds: int = 4,
+             warmup_iters: int = 12) -> dict:
+    """Baseline-off vs fully-instrumented comparison; returns the
+    evidence fields (shared by main() and the slow-lane gate test).
+
+    Drift-robust by design: on a single-core host, back-to-back pretrain
+    runs vary by several percent from ambient load alone — far more than
+    the instrument cost being measured.  So after a short instrumented
+    warmup (first-run one-time costs: module imports, exporter thread,
+    first trace-dump path), the two modes run in ``rounds`` adjacent
+    pairs with alternating order (off-on, on-off, ...) and the overhead
+    is the MEDIAN of the per-pair ratios — slow drift hits both members
+    of a pair equally and cancels in the alternation."""
+    run_mode(make_cfg, vocab, seq, warmup_iters, instrumented=True,
+             trace_dir=trace_dir)
+    ratios = []
+    base_sps = []
+    inst_sps = []
+    losses = {}
+    for i in range(rounds):
+        order = [False, True] if i % 2 == 0 else [True, False]
+        sps = {}
+        for instrumented in order:
+            r = run_mode(make_cfg, vocab, seq, iters,
+                         instrumented=instrumented, trace_dir=trace_dir)
+            sps[instrumented] = r["steps_per_sec"] or 1e-9
+            losses.setdefault(instrumented, r["loss_series"])
+        ratios.append(sps[True] / sps[False])
+        base_sps.append(sps[False])
+        inst_sps.append(sps[True])
+    ratios.sort()
+    mid = len(ratios) // 2
+    median_ratio = (ratios[mid] if len(ratios) % 2
+                    else (ratios[mid - 1] + ratios[mid]) / 2.0)
+    overhead_pct = (1.0 - median_ratio) * 100.0
+    return {
+        "steps_per_sec": round(sorted(inst_sps)[len(inst_sps) // 2], 3),
+        "baseline_steps_per_sec": round(
+            sorted(base_sps)[len(base_sps) // 2], 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "rounds": rounds,
+        "passed": overhead_pct < GATE_OVERHEAD_PCT,
+        "loss_bitwise_identical": losses[False] == losses[True],
+    }
+
+
+def measure_instrument_cost(steps: int = 2000,
+                            trace_dir: str | None = None) -> dict:
+    """Direct per-step cost of the full instrumentation sequence.
+
+    Replays exactly what one driver iteration records — the step mark,
+    the data-wait/dispatch/metric-drain spans, the timer stop mirrors and
+    driver gauges, the profiler-trigger checks, and the amortized
+    every-10-steps window dump — and times it in isolation.  This is the
+    deterministic companion to the wall-clock A/B above: steps/sec pairs
+    are the honest end-to-end number but ride a noisy host, while this
+    isolates the instrument bill itself (tests gate on cost vs measured
+    step time; see tests/test_observability.py)."""
+    import tempfile
+    import time as _time
+
+    from megatron_llm_tpu.observability import registry as registry_mod
+    from megatron_llm_tpu.observability import trace as trace_mod
+    from megatron_llm_tpu.observability.profiler import ProfileTrigger
+    from megatron_llm_tpu.utils.timers import Timers
+
+    own_dir = trace_dir is None
+    if own_dir:
+        trace_dir = tempfile.mkdtemp(prefix="obs_cost_")
+    tracer = trace_mod.configure(capacity=65536)
+    registry_mod.set_publishing(True)
+    timers = Timers(1)
+    trigger = ProfileTrigger(trace_dir, start_fn=lambda d: None,
+                             stop_fn=lambda: None)
+    try:
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            trace_mod.instant("step-begin", iteration=i)
+            trigger.maybe_start(i)
+            timers("batch-generator", 1).start()
+            with trace_mod.span("data-wait", iteration=i):
+                pass
+            timers.gauge("data-wait-ms", 1.0)
+            timers("batch-generator").stop()
+            timers("train-step", 0).start()
+            with trace_mod.span("dispatch", iteration=i):
+                pass
+            timers.gauge("in-flight-depth", 2)
+            with trace_mod.span("metric-drain", count=1):
+                pass
+            timers("train-step").stop()
+            trigger.step_done()
+            if i % 10 == 9:  # the driver's N-step window dump, amortized
+                tracer.dump(os.path.join(trace_dir, "w.json"))
+        cost_us = (_time.perf_counter() - t0) / steps * 1e6
+    finally:
+        trace_mod.disable()
+        if own_dir:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+    return {"instrument_cost_us_per_step": round(cost_us, 2),
+            "cost_steps": steps}
+
+
+def _run(args, finished):
+    import jax
+
+    layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
+    seq, mbs = 512, 8
+    if probe_backend(args.probe_timeout) == "cpu":
+        from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform()
+        # CPU sanity shape (bench_train_loop's): steps of tens of ms, so
+        # per-step instrument cost in the tenths-of-ms would register
+        layers, hidden, heads, ffn, vocab = 2, 256, 4, 512, 1024
+        seq, mbs = 128, 4
+
+    from megatron_llm_tpu.models import make_config
+
+    def make_cfg(iters):
+        return make_config(
+            "llama2", num_layers=layers, hidden_size=hidden,
+            num_attention_heads=heads, num_attention_heads_kv=heads,
+            ffn_hidden_size=ffn, vocab_size=vocab, seq_length=seq,
+            max_position_embeddings=seq,
+            params_dtype="bfloat16" if jax.default_backend() != "cpu"
+            else "float32",
+            use_flash_attn=jax.default_backend() != "cpu",
+            micro_batch_size=mbs, global_batch_size=mbs, train_iters=iters,
+            # log at a realistic cadence: the drain + registry publish at
+            # boundaries is part of what the instrumented mode pays
+            log_interval=10,
+            eval_interval=0, tokenizer_type=None,
+        )
+
+    trace_dir = tempfile.mkdtemp(prefix="bench_obs_trace_")
+    try:
+        pair = run_pair(make_cfg, vocab, seq, args.iters, trace_dir,
+                        rounds=args.rounds)
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+    result = {
+        "metric": METRIC,
+        "value": pair["steps_per_sec"],
+        "unit": "steps/s",
+        **{k: pair[k] for k in ("baseline_steps_per_sec", "overhead_pct",
+                                "pair_ratios", "rounds", "passed",
+                                "loss_bitwise_identical")},
+        **measure_instrument_cost(),
+        "gate_overhead_pct": GATE_OVERHEAD_PCT,
+        "iters": args.iters,
+        "model": {"layers": layers, "hidden": hidden, "seq": seq, "mbs": mbs},
+        "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+    if result["backend"] != "cpu":
+        persist_tpu_result(result, vars(args), tag="observability")
+    else:
+        result = cpu_contract_line(result, tag="observability")
+    finished.set()
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40,
+                    help="measured iterations per mode per round (first "
+                         "excluded as compile/warmup)")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="alternating off/on pairs; overhead is the "
+                         "median per-pair ratio (single-core drift "
+                         "robustness)")
+    ap.add_argument("--probe_timeout", type=float, default=120.0)
+    ap.add_argument("--watchdog", type=float, default=1500.0)
+    args = ap.parse_args()
+
+    finished = threading.Event()
+
+    def on_timeout():
+        if finished.is_set():
+            return
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "steps/s",
+            "error": f"watchdog: observability bench exceeded "
+                     f"{args.watchdog}s",
+        }), flush=True)
+        os._exit(3)
+
+    dog = threading.Timer(args.watchdog, on_timeout)
+    dog.daemon = True
+    dog.start()
+
+    try:
+        _run(args, finished)
+    except Exception as e:  # structured error line, never a bare traceback
+        finished.set()
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "steps/s",
+            "error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

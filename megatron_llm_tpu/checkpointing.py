@@ -226,9 +226,15 @@ class AsyncCheckpointSaver:
 
     def _write(self, cfg, save_dir, iteration, params, opt_state,
                consumed_samples, extra_state) -> None:
+        from megatron_llm_tpu.observability import trace as obs_trace
+
         try:
-            save_checkpoint(cfg, save_dir, iteration, params, opt_state,
-                            consumed_samples, extra_state)
+            # traced on the writer thread (observability/trace.py): the
+            # Perfetto view shows the disk write overlapping device steps
+            # — the whole point of --async_save
+            with obs_trace.span("ckpt-write", iteration=iteration):
+                save_checkpoint(cfg, save_dir, iteration, params, opt_state,
+                                consumed_samples, extra_state)
         except BaseException as e:
             self._error = e
 

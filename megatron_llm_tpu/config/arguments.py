@@ -358,6 +358,22 @@ class LoggingConfig:
     profile_step_start: int = 10
     profile_step_end: int = 12
     profile_dir: Optional[str] = None  # default: <tensorboard_dir or .>/profile
+    # --- observability subsystem (megatron_llm_tpu/observability/,
+    # docs/guide/observability.md) ---
+    # host-side span tracing of the async loop's phases (data-wait,
+    # dispatch, metric-drain, ckpt-flush): Chrome-trace/Perfetto JSON
+    # windows written here; None disables tracing entirely
+    trace_dir: Optional[str] = None
+    # dump one trace file per this many steps (0 = only a final dump)
+    trace_steps: int = 50
+    # span ring-buffer capacity (oldest events drop beyond it)
+    trace_buffer_events: int = 65536
+    # serve Prometheus /metrics (+ /profile on-demand capture trigger)
+    # on this port; 0 binds an ephemeral port; None disables
+    metrics_port: Optional[int] = None
+    # bound on on-demand jax.profiler windows per process (SIGUSR2 or
+    # GET /profile?steps=N; output under <profile_dir>/ondemand/)
+    profile_max_captures: int = 8
     tensorboard_dir: Optional[str] = None
     tensorboard_log_interval: int = 1
     tensorboard_queue_size: int = 1000

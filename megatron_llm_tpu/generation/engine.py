@@ -59,6 +59,8 @@ import numpy as np
 
 from megatron_llm_tpu.generation import generation as gen
 from megatron_llm_tpu.generation.sampling import sample_per_slot
+from megatron_llm_tpu.observability import registry as obs_registry
+from megatron_llm_tpu.observability import trace as obs_trace
 from megatron_llm_tpu.generation.tokenization import detokenize_generations
 from megatron_llm_tpu.models.language_model import (
     _compute_dtype,
@@ -207,6 +209,27 @@ class ContinuousBatchingEngine:
         # tick telemetry for the decode bench
         self.ticks = 0
         self.ticked_tokens = 0
+        # registry instruments, resolved once (observability/registry.py):
+        # per-tick updates must stay dict-free on the scheduler thread
+        reg = obs_registry.get_registry()
+        self._m_requests = reg.counter(
+            "mlt_engine_requests_total", help="generations submitted")
+        self._m_ticks = reg.counter(
+            "mlt_engine_ticks_total", help="fused decode ticks run")
+        self._m_tokens = reg.counter(
+            "mlt_engine_ticked_tokens_total",
+            help="slot-steps advanced (tokens sampled) across ticks")
+        self._m_active = reg.gauge(
+            "mlt_engine_active_slots", help="decode slots occupied")
+        self._m_queued = reg.gauge(
+            "mlt_engine_queued_requests", help="requests awaiting a slot")
+        self._m_free_pages = reg.gauge(
+            "mlt_engine_free_pages", help="KV pool pages free")
+        reg.gauge("mlt_engine_max_slots",
+                  help="decode slots in the tick program").set(self.max_slots)
+        reg.gauge("mlt_engine_pool_pages",
+                  help="allocatable KV pool pages (null page excluded)"
+                  ).set(self.pool.num_pages - 1)
 
     # -- compiled programs -------------------------------------------------
 
@@ -301,9 +324,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "Length of prompt + tokens_to_generate longer than allowed")
         req = EngineRequest(prompt=prompt, max_new_tokens=max_new_tokens, **kw)
-        with self._work:
-            self._queue.append(req)
-            self._work.notify()
+        with obs_trace.span("engine-enqueue", prompt_len=len(prompt)):
+            with self._work:
+                self._queue.append(req)
+                if obs_registry.publishing():
+                    self._m_requests.inc()
+                    self._m_queued.set(len(self._queue))
+                self._work.notify()
         return req
 
     def _pages_needed(self, req: EngineRequest) -> int:
@@ -416,10 +443,15 @@ class ContinuousBatchingEngine:
         tick advanced (0 = idle, nothing ran).  Call from one driver at a
         time (:meth:`run_until_idle` / the background loop serialize via
         ``_drive_lock``)."""
-        self._admit()
+        with obs_trace.span("engine-admit"):
+            self._admit()
         with self._lock:
             active = [i for i, r in enumerate(self._slots) if r is not None]
             if not active:
+                if obs_registry.publishing():
+                    self._m_active.set(0)
+                    self._m_queued.set(len(self._queue))
+                    self._m_free_pages.set(self.pool.num_free)
                 return 0
             if self._dirty:
                 self._dev_state = (jnp.asarray(self._block_tables),
@@ -433,12 +465,13 @@ class ContinuousBatchingEngine:
                 self._dirty = False
             bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
 
-        (self.pool.k, self.pool.v, next_tok, logp,
-         new_pos, new_steps) = self._tick()(
-            self.params, self.pool.k, self.pool.v,
-            bt, pos, toks, keys, steps, temp, tk, tp)
-        next_np = np.asarray(next_tok)
-        logp_np = np.asarray(logp)
+        with obs_trace.span("engine-tick", active=len(active)):
+            (self.pool.k, self.pool.v, next_tok, logp,
+             new_pos, new_steps) = self._tick()(
+                self.params, self.pool.k, self.pool.v,
+                bt, pos, toks, keys, steps, temp, tk, tp)
+            next_np = np.asarray(next_tok)
+            logp_np = np.asarray(logp)
 
         with self._lock:
             if not self._dirty:
@@ -447,6 +480,9 @@ class ContinuousBatchingEngine:
                                    temp, tk, tp)
             self.ticks += 1
             self.ticked_tokens += len(active)
+            if obs_registry.publishing():
+                self._m_ticks.inc()
+                self._m_tokens.inc(len(active))
             for i in active:
                 req = self._slots[i]
                 tok = int(next_np[i])
@@ -461,6 +497,11 @@ class ContinuousBatchingEngine:
                         or len(req.prompt) + len(req.generated) >= self.max_seq)
                 if done:
                     self._retire(i)
+            if obs_registry.publishing():
+                self._m_active.set(
+                    sum(r is not None for r in self._slots))
+                self._m_queued.set(len(self._queue))
+                self._m_free_pages.set(self.pool.num_free)
         return len(active)
 
     def run_until_idle(self) -> None:

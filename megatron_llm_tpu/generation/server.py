@@ -220,6 +220,13 @@ class MegatronServer:
             def do_GET(self):
                 if self.path.rstrip("/") == "/health":
                     return self._send(200, server.health())
+                if self.path.split("?", 1)[0].rstrip("/") == "/metrics":
+                    # Prometheus exposition (observability/registry.py),
+                    # alongside /health on the same port — the serving
+                    # analog of pretrain's --metrics_port endpoint
+                    return self._send(
+                        200, server.metrics_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 index = _STATIC_DIR / "index.html"
                 if self.path in ("/", "/index.html") and index.exists():
                     return self._send(200, index.read_text(), "text/html")
@@ -245,6 +252,24 @@ class MegatronServer:
                     ticks=eng.ticks,
                 )
         return info
+
+    def metrics_text(self) -> str:
+        """Prometheus text for GET /metrics: refresh the engine-occupancy
+        gauges from live engine state (scrape-time pull — the engine also
+        pushes them per tick), then render the process-wide registry."""
+        from megatron_llm_tpu.observability.registry import get_registry
+
+        reg = get_registry()
+        eng = self.engine
+        if self.batching:
+            with eng._lock:
+                reg.gauge("mlt_engine_active_slots").set(
+                    sum(r is not None for r in eng._slots))
+                reg.gauge("mlt_engine_queued_requests").set(len(eng._queue))
+                reg.gauge("mlt_engine_free_pages").set(eng.pool.num_free)
+                reg.gauge("mlt_engine_max_slots").set(eng.max_slots)
+                reg.gauge("mlt_engine_pool_pages").set(eng.pool.num_pages - 1)
+        return reg.render()
 
     def _start_engine(self):
         if self.batching and hasattr(self.engine, "start"):

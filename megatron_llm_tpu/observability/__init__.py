@@ -1,0 +1,26 @@
+"""Observability: structured step tracing, unified metrics, profiling.
+
+The cross-cutting layer (docs/guide/observability.md) that makes the
+async training loop (training.py), the continuous-batching engine
+(generation/engine.py) and the resilience subsystem visible while they
+run:
+
+* ``trace``    — sync-free host span tracer -> Chrome/Perfetto JSON;
+* ``registry`` — process-wide counters/gauges/histograms -> Prometheus
+  text;
+* ``exporter`` — HTTP ``/metrics`` + ``/profile`` endpoint
+  (``--metrics_port``);
+* ``profiler`` — on-demand ``jax.profiler`` windows (SIGUSR2 or
+  ``/profile?steps=N``);
+* ``flops``    — config-derived flops/MFU math shared by driver, bench
+  and registry.
+
+Package-wide contract, enforced by tools/linter.py: nothing in here may
+sync the device — observability must never perturb the overlap it
+measures (the PR-2 bitwise-identical-loss guarantee includes running
+with every instrument on).
+"""
+
+from megatron_llm_tpu.observability import flops, registry, trace
+
+__all__ = ["flops", "registry", "trace"]

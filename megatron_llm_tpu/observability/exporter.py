@@ -1,0 +1,137 @@
+"""Lightweight HTTP exposition endpoint: ``/metrics`` + ``/profile``.
+
+Stdlib ``ThreadingHTTPServer`` (same choice as generation/server.py —
+Flask is not baked into the TPU image) on a daemon thread, so scraping
+never rides the training loop's thread.  Routes:
+
+* ``GET /metrics``   — Prometheus text (registry.render()), version 0.0.4;
+* ``GET /healthz``   — liveness JSON;
+* ``GET|POST /profile?steps=N`` — arm an on-demand ``jax.profiler`` window
+  (observability/profiler.py); the driver starts the capture at its next
+  step boundary.  409 when a capture is already pending/active or the
+  bounded capture budget is spent; 503 when no trigger is wired (e.g. the
+  generation server, which exposes ``/metrics`` on its own port instead).
+
+``pretrain`` starts one when ``--metrics_port`` is set (port 0 binds an
+ephemeral port — tests and multi-job hosts) and stops it on every exit
+path.  The generation server does NOT use this class: it serves
+``/metrics`` from its existing handler alongside ``/health``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from megatron_llm_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+)
+
+__all__ = ["MetricsExporter", "PROM_CONTENT_TYPE", "active_exporter"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_ACTIVE: Optional["MetricsExporter"] = None
+
+
+def active_exporter() -> Optional["MetricsExporter"]:
+    """The most recently started exporter (None when stopped) — lets
+    in-process probes find the bound port without plumbing it around."""
+    return _ACTIVE
+
+
+class MetricsExporter:
+    """Serve a metrics registry (and optionally a profile trigger)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 profile_trigger=None, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.registry = registry or get_registry()
+        self.profile_trigger = profile_trigger
+        self.host = host
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- handler ----
+
+    def _make_handler(exporter):  # noqa: N805 — enclosing-object idiom
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: str, content_type: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_json(self, code: int, obj) -> None:
+                self._send(code, json.dumps(obj), "application/json")
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                route = url.path.rstrip("/") or "/"
+                if route == "/metrics":
+                    return self._send(200, exporter.registry.render(),
+                                      PROM_CONTENT_TYPE)
+                if route == "/healthz":
+                    return self._send_json(200, {"status": "ok"})
+                if route == "/profile":
+                    return self._profile(url)
+                return self._send_json(404, {"error": "not found"})
+
+            do_POST = do_GET  # /profile is natural as POST too
+
+            def _profile(self, url) -> None:
+                trig = exporter.profile_trigger
+                if trig is None:
+                    return self._send_json(
+                        503, {"error": "no profiler wired on this endpoint"})
+                qs = parse_qs(url.query)
+                steps = None
+                if "steps" in qs:
+                    try:
+                        steps = int(qs["steps"][0])
+                    except ValueError:
+                        return self._send_json(
+                            400, {"error": "steps must be an integer"})
+                res = trig.request(steps)
+                return self._send_json(200 if res.get("accepted") else 409,
+                                       res)
+
+            def log_message(self, fmt, *args):  # scrapes are chatty
+                pass
+
+        return Handler
+
+    # ---- lifecycle ----
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        global _ACTIVE
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-exporter")
+        self._thread.start()
+        _ACTIVE = self
+        return self.port
+
+    def stop(self) -> None:
+        global _ACTIVE
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if _ACTIVE is self:
+            _ACTIVE = None

@@ -120,7 +120,7 @@ class GoodputTracker:
         kept = max(self.productive_seconds - replay_seconds, 0.0)
         other = max(total - self.productive_seconds - self.compile_seconds,
                     0.0)
-        return {
+        report = {
             "wall_seconds": round(total, 3),
             "productive_seconds": round(kept, 3),
             "productive_steps": self.productive_steps - self.replayed_steps,
@@ -131,6 +131,34 @@ class GoodputTracker:
             "goodput_fraction": round(kept / total, 4),
             "resumed_iteration": self.resumed_iteration,
         }
+        _publish_to_registry(report)
+        return report
+
+
+def _publish_to_registry(report: Dict) -> None:
+    """Mirror a goodput report into the process-wide metrics registry
+    (observability/registry.py) so /metrics serves the goodput fraction
+    live.  Never raises — observability must not crash training."""
+    try:
+        from megatron_llm_tpu.observability import registry as obs
+
+        if not obs.publishing():
+            return
+        reg = obs.get_registry()
+        reg.gauge("mlt_goodput_fraction",
+                  help="fraction of wall-clock kept as forward progress"
+                  ).set(report["goodput_fraction"])
+        reg.gauge("mlt_goodput_productive_seconds",
+                  help="post-warmup stepping seconds kept"
+                  ).set(report["productive_seconds"])
+        reg.gauge("mlt_goodput_lost_compile_seconds",
+                  help="seconds lost to JIT compile + warmup"
+                  ).set(report["lost_compile_seconds"])
+        reg.gauge("mlt_goodput_replayed_steps",
+                  help="steps re-executed after the last resume"
+                  ).set(report["replayed_steps"])
+    except Exception:
+        pass
 
 
 def aggregate_reports(reports, downtime_seconds: float = 0.0) -> Dict:

@@ -65,6 +65,11 @@ class StepWatchdog:
       snapshot_timeout: seconds to wait for snapshot_fn before exiting
         anyway (it may itself hang on a wedged device).
       gauge_fn: sync-free observability hook called once on expiry.
+      trace_dump_fn: dumps the span-tracer ring buffer on expiry (returns
+        the written path, printed alongside the stack dump) — a hang
+        report should come with a timeline.  When None, falls back to a
+        text tail of the process-wide tracer (observability/trace.py) on
+        the stream, if one is configured.
       exit_fn: defaults to ``os._exit`` — tests inject a recorder.
     """
 
@@ -77,6 +82,7 @@ class StepWatchdog:
         snapshot_fn: Optional[Callable[[], None]] = None,
         snapshot_timeout: float = 120.0,
         gauge_fn: Optional[Callable[[], None]] = None,
+        trace_dump_fn: Optional[Callable[[], Optional[str]]] = None,
         exit_fn: Callable[[int], None] = os._exit,
         exit_code: int = EXIT_WATCHDOG,
         stream=None,
@@ -88,6 +94,7 @@ class StepWatchdog:
         self._snapshot_fn = snapshot_fn
         self._snapshot_timeout = float(snapshot_timeout)
         self._gauge_fn = gauge_fn
+        self._trace_dump_fn = trace_dump_fn
         self._exit_fn = exit_fn
         self._exit_code = exit_code
         self._stream = stream
@@ -163,6 +170,7 @@ class StepWatchdog:
             dump_all_stacks(self._stream)
         except Exception:
             pass
+        self._dump_trace()
         if self._gauge_fn is not None:
             try:
                 self._gauge_fn()
@@ -171,6 +179,26 @@ class StepWatchdog:
         if self._snapshot_fn is not None:
             self._emergency_snapshot()
         self._exit_fn(self._exit_code)
+
+    def _dump_trace(self) -> None:
+        """Land the span-timeline next to the stack dump (the timeline
+        says WHAT the loop was doing when it stopped; the stacks say
+        where it is stuck).  Best-effort on every path."""
+        stream = self._stream or sys.stderr
+        try:
+            if self._trace_dump_fn is not None:
+                path = self._trace_dump_fn()
+                if path:
+                    print(f"WATCHDOG: span trace dumped to {path}",
+                          file=stream, flush=True)
+                return
+            from megatron_llm_tpu.observability import trace as obs_trace
+
+            tracer = obs_trace.get_tracer()
+            if tracer is not None and tracer.enabled:
+                tracer.write_text(stream)
+        except Exception:
+            pass
 
     def _emergency_snapshot(self) -> None:
         """Run the snapshot bounded: it is best-effort by definition — a

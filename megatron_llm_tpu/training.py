@@ -43,6 +43,9 @@ from megatron_llm_tpu.models import init_model_params
 from megatron_llm_tpu.models.language_model import loss_from_batch, make_rope_cache
 from megatron_llm_tpu.optimizer.optimizer import opt_state_shardings
 from megatron_llm_tpu.parallel.tp import make_sp_constraint, param_shardings
+from megatron_llm_tpu.observability import flops as flops_mod
+from megatron_llm_tpu.observability import registry as registry_mod
+from megatron_llm_tpu.observability import trace as trace_mod
 from megatron_llm_tpu.tokenizer.tokenizer import build_tokenizer
 from megatron_llm_tpu.training_step import (
     make_jitted_train_step,
@@ -63,25 +66,17 @@ _LOSS_SERIES_MAXLEN = 512
 
 
 def model_flops_per_token(cfg) -> float:
-    """Matmul FLOPs/token for fwd+bwd (reference FLOP estimate family,
-    language_model.py:370-384): 6*N plus causal attention term."""
-    m = cfg.model
-    n_params = _approx_param_count(cfg)
-    attn = 6 * m.num_layers * m.hidden_size * cfg.data.seq_length  # causal half
-    return 6 * n_params + attn
+    """Matmul FLOPs/token for fwd+bwd — now delegated to the shared
+    accounting in observability/flops.py (kept here for the tools that
+    import it from the driver)."""
+    return flops_mod.flops_per_token(cfg)
 
 
-def _approx_param_count(cfg) -> int:
-    m = cfg.model
-    h, L = m.hidden_size, m.num_layers
-    d = m.kv_channels or h // m.num_attention_heads
-    n, nkv = m.num_attention_heads, m.num_attention_heads_kv or n
-    ffn = m.ffn_hidden_size
-    glu = 2 if m.glu_activation else 1
-    per_layer = h * (n + 2 * nkv) * d + n * d * h + h * ffn * glu + ffn * h
-    v = m.vocab_size or 32000
-    emb = v * h * (1 if m.tie_embed_logits else 2)
-    return per_layer * L + emb
+def _device_kind() -> str:
+    try:
+        return getattr(jax.devices()[0], "device_kind", "cpu")
+    except Exception:
+        return "cpu"
 
 
 def _train_valid_test_num_samples(cfg):
@@ -339,6 +334,24 @@ def training_log(cfg, metrics, iteration, step_time, writer, timers,
                     writer.add_scalar(f"memory/{key}", stats[key], iteration)
         if cfg.logging.log_timers_to_tensorboard and timers is not None:
             timers.write(writer, iteration)
+    if registry_mod.publishing():
+        # mirror the log line into the process-wide registry so a live
+        # scrape of /metrics sees what the console sees (sync-free: all
+        # inputs are the host floats computed above)
+        reg = registry_mod.get_registry()
+        reg.gauge("mlt_iteration", help="training iteration").set(iteration)
+        reg.gauge("mlt_consumed_samples",
+                  help="samples consumed").set(consumed_samples)
+        reg.gauge("mlt_lm_loss", help="last fetched lm loss").set(loss)
+        reg.gauge("mlt_learning_rate", help="current learning rate").set(lr)
+        reg.gauge("mlt_tokens_per_sec",
+                  help="training throughput over the last interval").set(tps)
+        reg.gauge("mlt_step_time_seconds",
+                  help="mean step time over the last interval").set(step_time)
+        frac = flops_mod.mfu(cfg, tps, device_kind=_device_kind())
+        reg.gauge("mlt_steady_mfu",
+                  help="model flops utilization over the last interval "
+                       "(0 when no device peak is known)").set(frac or 0.0)
     if timers is not None and cfg.logging.timing_log_level > 0:
         log = timers.log()
         if log:
@@ -371,6 +384,40 @@ def pretrain(
     timers = Timers(cfg.logging.timing_log_level, cfg.logging.timing_log_option)
     writer = build_writer(cfg)
     sig = SignalHandler() if cfg.training.exit_signal_handler else None
+
+    # ---- observability (megatron_llm_tpu/observability/,
+    # docs/guide/observability.md): span tracer, metrics endpoint,
+    # on-demand profiler.  All host-side and sync-free — the async loop's
+    # overlap (and its bitwise loss guarantee) survives instrumentation.
+    obs = cfg.logging
+    profile_dir = obs.profile_dir or os.path.join(
+        obs.tensorboard_dir or ".", "profile"
+    )
+    tracer = None
+    if obs.trace_dir:
+        os.makedirs(obs.trace_dir, exist_ok=True)
+        tracer = trace_mod.configure(capacity=obs.trace_buffer_events)
+        print0(f"observability: span tracing -> {obs.trace_dir} "
+               f"(window {obs.trace_steps} steps, ring "
+               f"{obs.trace_buffer_events} events)")
+    from megatron_llm_tpu.observability.profiler import (
+        ProfileTrigger,
+        install_sigusr2,
+    )
+
+    profile_trigger = ProfileTrigger(
+        os.path.join(profile_dir, "ondemand"),
+        max_captures=obs.profile_max_captures,
+    )
+    prev_usr2 = install_sigusr2(profile_trigger)
+    exporter = None
+    if obs.metrics_port is not None:
+        from megatron_llm_tpu.observability.exporter import MetricsExporter
+
+        exporter = MetricsExporter(registry_mod.get_registry(),
+                                   profile_trigger, port=obs.metrics_port)
+        print0(f"observability: /metrics + /profile on port "
+               f"{exporter.start()}")
 
     with global_mesh(mesh):
         # ---- model + optimizer ----
@@ -473,6 +520,15 @@ def pretrain(
                 snapshot_fn=_emergency_snapshot,
                 snapshot_timeout=r.emergency_save_timeout,
                 gauge_fn=lambda: timers.gauge("watchdog-expired", 1.0),
+                # a hang report should carry a timeline: the span ring
+                # buffer dumps next to the thread-stack dump (satellite;
+                # without --trace_dir the watchdog falls back to a text
+                # tail of the global tracer, if any)
+                trace_dump_fn=(
+                    (lambda: tracer.dump(
+                        os.path.join(obs.trace_dir, "trace_watchdog.json"),
+                        drain=False))
+                    if tracer is not None else None),
             ).start()
             print0(f"resilience: watchdog armed per step "
                    f"(deadline {r.watchdog_multiplier}x EMA, floor "
@@ -551,8 +607,9 @@ def pretrain(
             if take == 0:
                 return metrics
             entries = [in_flight.popleft() for _ in range(take)]
-            for (it, _), host in zip(
-                    entries, jax.device_get([m for _, m in entries])):
+            with trace_mod.span("metric-drain", count=take):
+                hosts = jax.device_get([m for _, m in entries])
+            for (it, _), host in zip(entries, hosts):
                 loss_series.append((it, float(host.get("lm loss", np.nan))))
                 metrics = host
             return metrics
@@ -596,21 +653,23 @@ def pretrain(
 
         def _save(it):
             timers("save-checkpoint", 0).start()
-            if saver is not None:
-                waited = saver.save(cfg, cfg.checkpoint.save, it, params,
+            # "ckpt-flush" = what the DRIVER pays at a save point: under
+            # --async_save the previous write's flush barrier + the host
+            # snapshot; synchronously the whole write (the writer thread's
+            # own span is "ckpt-write", checkpointing.py)
+            with trace_mod.span("ckpt-flush", iteration=it):
+                if saver is not None:
+                    waited = saver.save(cfg, cfg.checkpoint.save, it, params,
+                                        opt_state, consumed_samples)
+                    timers.gauge("ckpt-flush-wait-ms", waited * 1e3)
+                else:
+                    save_checkpoint(cfg, cfg.checkpoint.save, it, params,
                                     opt_state, consumed_samples)
-                timers.gauge("ckpt-flush-wait-ms", waited * 1e3)
-            else:
-                save_checkpoint(cfg, cfg.checkpoint.save, it, params,
-                                opt_state, consumed_samples)
             timers("save-checkpoint").stop()
 
         profiling = False
         profile_stop_at = None  # set when the trace starts
         spans_printed = False
-        profile_dir = cfg.logging.profile_dir or os.path.join(
-            cfg.logging.tensorboard_dir or ".", "profile"
-        )
 
         try:
             while iteration < train_iters:
@@ -623,11 +682,19 @@ def pretrain(
                 if watchdog is not None:
                     watchdog.arm(first=warmup_time is None)
                 iter_t0 = time.perf_counter()
+                trace_mod.instant("step-begin", iteration=iteration)
+                # on-demand capture (SIGUSR2 / GET /profile?steps=N) starts
+                # at a step boundary — never from a handler frame, never
+                # inside the static --profile window
+                if not profiling and profile_trigger.maybe_start(iteration):
+                    print0(f"profiler: on-demand capture started at "
+                           f"iteration {iteration}", flush=True)
                 # xplane tracing over [profile_step_start, profile_step_end)
                 # (SURVEY §5: jax-profiler analog of the reference's span
                 # timers). >= not ==: a resumed run past the start step still
                 # gets a trace (of at least one step, even past the window)
                 if (cfg.logging.profile and profile_stop_at is None
+                        and not profile_trigger.active
                         and iteration >= cfg.logging.profile_step_start):
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
@@ -654,28 +721,31 @@ def pretrain(
                 try:
                     timers("batch-generator", 1).start()
                     wait_t0 = time.perf_counter()
-                    if prefetcher is not None:
-                        pre_gbs, placed = next(prefetcher)
-                        if pre_gbs is not None and pre_gbs != gbs:
-                            raise RuntimeError(
-                                f"prefetch schedule diverged: worker staged "
-                                f"gbs {pre_gbs}, driver expects {gbs}")
-                        if prefetcher.place_fn is None:  # multi-host
-                            placed = shardings["place_batch"](placed)
-                    else:
-                        if rampup:
-                            chunks = [next(train_iter)
-                                      for _ in range(gbs // chunk)]
-                            # token_idx is batch-invariant [s] — never
-                            # concatenated
-                            batch = {
-                                k: (chunks[0][k] if k == "token_idx"
-                                    else np.concatenate([c[k] for c in chunks]))
-                                for k in chunks[0]
-                            }
+                    with trace_mod.span("data-wait", iteration=iteration):
+                        if prefetcher is not None:
+                            pre_gbs, placed = next(prefetcher)
+                            if pre_gbs is not None and pre_gbs != gbs:
+                                raise RuntimeError(
+                                    f"prefetch schedule diverged: worker "
+                                    f"staged gbs {pre_gbs}, driver expects "
+                                    f"{gbs}")
+                            if prefetcher.place_fn is None:  # multi-host
+                                placed = shardings["place_batch"](placed)
                         else:
-                            batch = next(train_iter)
-                        placed = shardings["place_batch"](batch)
+                            if rampup:
+                                chunks = [next(train_iter)
+                                          for _ in range(gbs // chunk)]
+                                # token_idx is batch-invariant [s] — never
+                                # concatenated
+                                batch = {
+                                    k: (chunks[0][k] if k == "token_idx"
+                                        else np.concatenate(
+                                            [c[k] for c in chunks]))
+                                    for k in chunks[0]
+                                }
+                            else:
+                                batch = next(train_iter)
+                            placed = shardings["place_batch"](batch)
                     timers.gauge("data-wait-ms",
                                  (time.perf_counter() - wait_t0) * 1e3)
                     timers("batch-generator").stop()
@@ -691,10 +761,11 @@ def pretrain(
                 first_step = False
                 if iteration not in (t.skip_iters or []):
                     # --skip_iters skips the update (training.py:397-399)
-                    params, opt_state, metrics_dev = cur_step_fn(
-                        params, opt_state, placed, iteration,
-                    )
-                    in_flight.append((iteration + 1, metrics_dev))
+                    with trace_mod.span("dispatch", iteration=iteration):
+                        params, opt_state, metrics_dev = cur_step_fn(
+                            params, opt_state, placed, iteration,
+                        )
+                        in_flight.append((iteration + 1, metrics_dev))
                     timers.gauge("in-flight-depth", len(in_flight))
                     if warmup_time is None:
                         # fence the compile step out of throughput so the
@@ -723,6 +794,15 @@ def pretrain(
                     profiling = False
                     print0(f"profiler: xplane trace written to {profile_dir}",
                            flush=True)
+                if profile_trigger.step_done():
+                    print0(f"profiler: on-demand capture written to "
+                           f"{profile_trigger.capture_dirs[-1]}", flush=True)
+                if (tracer is not None and obs.trace_steps > 0
+                        and iteration % obs.trace_steps == 0):
+                    # one Chrome-trace file per N-step window (drains the
+                    # ring, so windows are disjoint)
+                    tracer.dump(os.path.join(
+                        obs.trace_dir, f"trace_{iteration:08d}.json"))
 
                 if iteration % log_interval == 0:
                     # drain: one batched fetch for the whole interval
@@ -741,6 +821,15 @@ def pretrain(
                             print0("    span breakdown (ms): " + " | ".join(
                                 f"{k}: {v * 1e3:.1f}"
                                 for k, v in spans.items()), flush=True)
+                    if registry_mod.publishing():
+                        # live goodput snapshot for /metrics scrapes (the
+                        # exit path overwrites these with final numbers;
+                        # report() publishes its fields to the registry)
+                        goodput.record_compile(warmup_time or 0.0)
+                        if steady_t0 is not None:
+                            goodput.record_productive(
+                                steady_steps, now - steady_t0)
+                        goodput.report()
                     interval_t0 = time.perf_counter()
                     interval_steps = 0
                     if resil_dir:
@@ -754,8 +843,10 @@ def pretrain(
 
                 if (cfg.training.eval_interval and valid_iter_factory
                         and iteration % cfg.training.eval_interval == 0):
-                    ev = evaluate(cfg, params, eval_step, valid_iter_factory(),
-                                  place_batch=shardings["place_batch"])
+                    with trace_mod.span("eval", iteration=iteration):
+                        ev = evaluate(cfg, params, eval_step,
+                                      valid_iter_factory(),
+                                      place_batch=shardings["place_batch"])
                     print0(f" validation loss at iteration {iteration}: "
                            + " | ".join(f"{k}: {v:.6E}" for k, v in ev.items()),
                            flush=True)
@@ -798,6 +889,7 @@ def pretrain(
             if profiling:  # early exit mid-window: don't leak an open trace
                 jax.profiler.stop_trace()
                 profiling = False
+            profile_trigger.close()  # nor an open on-demand window
             if saver is not None:
                 # exit barrier: never leave the loop (even on an exception
                 # or a signal) with checkpoint bytes half-written
@@ -819,10 +911,34 @@ def pretrain(
                    f"compile {goodput_report['lost_compile_seconds']:.1f}s, "
                    f"replay {goodput_report['replayed_steps']} steps)",
                    flush=True)
+            if tracer is not None:
+                # whatever the exit path, the tail of the timeline lands
+                # on disk (the window dumps drained everything older)
+                print0("observability: final trace window -> " + tracer.dump(
+                    os.path.join(obs.trace_dir,
+                                 f"trace_final_{iteration:08d}.json")))
+            if exporter is not None:
+                exporter.stop()
+            if prev_usr2 is not None:
+                import signal as signal_mod
+
+                signal_mod.signal(signal_mod.SIGUSR2, prev_usr2)
 
         steady_sps = None
         if steady_t0 is not None and steady_steps > 0:
             steady_sps = steady_steps / max(steady_end - steady_t0, 1e-9)
+        steady_tps = steady_mfu_val = None
+        if steady_sps is not None:
+            # config-derived flops (observability/flops.py) feed the result
+            # dict and the registry: the Megatron-style MFU signal
+            steady_tps = (steady_sps * t.global_batch_size
+                          * cfg.data.seq_length)
+            steady_mfu_val = flops_mod.mfu(cfg, steady_tps,
+                                           device_kind=_device_kind())
+            if registry_mod.publishing():
+                reg = registry_mod.get_registry()
+                reg.gauge("mlt_tokens_per_sec").set(steady_tps)
+                reg.gauge("mlt_steady_mfu").set(steady_mfu_val or 0.0)
 
         if cfg.checkpoint.save and exit_reason != "train_iters reached":
             _save(iteration)
@@ -844,6 +960,13 @@ def pretrain(
             # fetched (iteration, lm loss) trajectory (bounded window)
             "warmup_time": warmup_time,
             "steady_steps_per_sec": steady_sps,
+            # observability (docs/guide/observability.md): steady-state
+            # throughput in tokens and model-flops terms (MFU is None on
+            # hosts with no known peak, e.g. CPU), and the bound /metrics
+            # port when --metrics_port was set (0 binds ephemerally)
+            "tokens_per_sec": steady_tps,
+            "steady_mfu": steady_mfu_val,
+            "metrics_port": exporter.port if exporter is not None else None,
             "loss_series": list(loss_series),
             # resilience observability (docs/guide/resilience.md): what this
             # run kept vs. lost to compile/replay — also persisted to

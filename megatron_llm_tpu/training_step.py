@@ -365,19 +365,35 @@ def make_jitted_train_step(cfg, mesh: Mesh, params: Any,
     def place_batch(batch):
         import numpy as np
 
-        key = tuple(sorted((k, int(np.ndim(v))) for k, v in batch.items()))
-        sh = shard_cache.get(key)
-        if sh is None:
-            sh = shard_cache[key] = batch_shardings(cfg, mesh, batch)
-        if jax.process_count() > 1:
-            # multi-host: hosts hold only their rows of the global batch
-            # (core/distributed.process_batch_slice); assemble global arrays
-            from megatron_llm_tpu.core.distributed import (
-                place_host_local_batch,
-            )
+        from megatron_llm_tpu.observability import registry as obs_registry
+        from megatron_llm_tpu.observability import trace as obs_trace
 
-            return place_host_local_batch(batch, sh)
-        return jax.device_put(batch, sh)
+        # traced + counted (observability/): this runs on the prefetch
+        # worker in the overlapped loop, so the span lands on that
+        # thread's track and the counter exercises the registry's
+        # cross-thread path.  device_put is async — still sync-free.
+        with obs_trace.span("place-batch"):
+            key = tuple(sorted(
+                (k, int(np.ndim(v))) for k, v in batch.items()))
+            sh = shard_cache.get(key)
+            if sh is None:
+                sh = shard_cache[key] = batch_shardings(cfg, mesh, batch)
+            if jax.process_count() > 1:
+                # multi-host: hosts hold only their rows of the global
+                # batch (core/distributed.process_batch_slice); assemble
+                # global arrays
+                from megatron_llm_tpu.core.distributed import (
+                    place_host_local_batch,
+                )
+
+                placed = place_host_local_batch(batch, sh)
+            else:
+                placed = jax.device_put(batch, sh)
+        if obs_registry.publishing():
+            obs_registry.get_registry().counter(
+                "mlt_batches_placed_total",
+                help="batches staged on device by place_batch").inc()
+        return placed
 
     return jstep, optimizer, {
         "params": p_shard,

@@ -5,7 +5,14 @@ discipline; log levels 0-2; minmax across ranks; tensorboard write). TPU
 analog: ``jax.block_until_ready`` on a marker array replaces
 ``cuda.synchronize``; there is one host process, so the cross-rank max/minmax
 reductions disappear (single-controller) — per-device skew is visible in the
-profiler traces instead (utils/profiler.py).
+profiler traces instead (megatron_llm_tpu/observability: host-side span
+traces in ``observability.trace``, on-demand device profiles in
+``observability.profiler``).
+
+Every Timer stop and Gauge record also mirrors into the process-wide
+metrics registry (``observability.registry``) so ``/metrics`` serves the
+same numbers the log lines print — sync-free, and switchable off via
+``registry.set_publishing(False)`` (the overhead bench's baseline mode).
 """
 
 from __future__ import annotations
@@ -23,6 +30,9 @@ class Timer:
         self._count = 0
         self._started = False
         self._start_time = 0.0
+        # optional (name, delta_seconds) observer set by Timers — the
+        # registry mirror; None keeps the standalone Timer dependency-free
+        self._on_stop = None
 
     def start(self, barrier: bool = False):
         assert not self._started, f"timer {self.name} already started"
@@ -35,9 +45,12 @@ class Timer:
         assert self._started, f"timer {self.name} not started"
         if barrier:
             _device_sync()
-        self._elapsed += time.perf_counter() - self._start_time
+        delta = time.perf_counter() - self._start_time
+        self._elapsed += delta
         self._count += 1
         self._started = False
+        if self._on_stop is not None:
+            self._on_stop(self.name, delta)
 
     def reset(self):
         self._elapsed = 0.0
@@ -58,6 +71,37 @@ class Timer:
 def _device_sync():
     """Analog of torch.cuda.synchronize: wait for all in-flight work."""
     (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def _publish_timer(name: str, delta_seconds: float) -> None:
+    """Mirror a Timer stop into the process-wide metrics registry
+    (observability.registry): cumulative seconds + stop count, labelled
+    by timer name.  Pure host arithmetic; no-op when publishing is off."""
+    from megatron_llm_tpu.observability import registry as _obs
+
+    if not _obs.publishing():
+        return
+    labels = {"name": name}
+    reg = _obs.get_registry()
+    reg.counter("mlt_timer_seconds_total",
+                help="cumulative seconds per named driver timer",
+                labels=labels).inc(delta_seconds)
+    reg.counter("mlt_timer_stops_total",
+                help="start/stop cycles per named driver timer",
+                labels=labels).inc()
+
+
+def _publish_gauge(name: str, value: float) -> None:
+    """Mirror a Gauge record into the metrics registry (last value)."""
+    from megatron_llm_tpu.observability import registry as _obs
+
+    if not _obs.publishing():
+        return
+    _obs.get_registry().gauge(
+        "mlt_driver_gauge",
+        help="instantaneous driver gauges (data-wait ms, in-flight depth, "
+             "ckpt-flush-wait ms, ...), last recorded value",
+        labels={"name": name}).set(value)
 
 
 class Gauge:
@@ -110,7 +154,8 @@ class Timers:
 
     def __call__(self, name: str, log_level: int = 0) -> Timer:
         if name not in self._timers:
-            self._timers[name] = Timer(name)
+            t = self._timers[name] = Timer(name)
+            t._on_stop = _publish_timer
             self._log_levels[name] = log_level
         return self._timers[name]
 
@@ -123,6 +168,7 @@ class Timers:
             g = self._gauges[name] = Gauge(name)
             self._log_levels.setdefault(name, log_level)
         g.record(float(value))
+        _publish_gauge(name, float(value))
 
     def active(self, name: str) -> bool:
         return self._log_levels.get(name, 0) <= self._max_level

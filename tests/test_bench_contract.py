@@ -192,6 +192,43 @@ def test_resilience_smoke_cpu_contract(evidence_dir):
     assert bench.load_last_tpu() is None  # headline untouched
 
 
+def test_observability_bench_in_watch_jobs():
+    """ISSUE 4: the observability overhead bench is in the tunnel-up
+    capture list with the bench-style contract (own watchdog — no
+    subprocess timeout — and the bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_observability" in by_name
+    cmd, bounded, pred = by_name["bench_observability"]
+    assert cmd[-1].endswith("bench_observability.py")
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_observability_bench_cpu_contract(evidence_dir):
+    """Off-TPU the observability bench reports headline 0 under the bench
+    contract with the off/on comparison riding in cpu_sanity; TPU
+    evidence goes to its own tagged file and never clobbers the
+    headline."""
+    line = bench.cpu_contract_line({
+        "metric": "train_loop_observed_steps_s_1chip",
+        "value": 6.7, "unit": "steps/s", "backend": "cpu",
+        "baseline_steps_per_sec": 6.9, "overhead_pct": 1.9,
+        "pair_ratios": [0.98, 0.99, 1.0, 1.01], "rounds": 4,
+        "passed": True, "loss_bitwise_identical": True,
+        "instrument_cost_us_per_step": 99.7,
+    }, tag="observability")
+    assert line["value"] == 0.0 and line["unit"] == "steps/s"
+    assert line["cpu_sanity"]["overhead_pct"] == 1.9
+    assert line["cpu_sanity"]["loss_bitwise_identical"] is True
+    assert not _bench_on_tpu(json.dumps(line))
+    bench.persist_tpu_result({"metric": "train_loop_observed_steps_s_1chip",
+                              "value": 8.5, "backend": "tpu"}, {},
+                             tag="observability")
+    assert bench.load_last_tpu(tag="observability")["value"] == 8.5
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
 def test_e2e_470m_contract_line():
     """tools/e2e_470m.py off-TPU: headline 0, and the watcher predicate
     must NOT count that line as captured evidence."""

@@ -1,6 +1,12 @@
 """Minimal project linter (reference tools/linter.py analog).
 
-Checks: line length, tabs, trailing whitespace, and TODO-without-owner.
+Checks: line length, tabs, trailing whitespace, TODO-without-owner, and
+the observability no-device-sync rule: files under an ``observability``
+package directory must never call ``jax.device_get`` or
+``block_until_ready`` (nor mention them — a commented-out sync is one
+uncomment away).  Observability instruments the async training loop's
+overlap; an instrument that syncs the device destroys the thing it
+measures, and the PR-2 bitwise-loss guarantee with it.
 
     python tools/linter.py megatron_llm_tpu tools tasks tests
 """
@@ -13,10 +19,19 @@ import sys
 
 MAX_LEN = 100
 TODO_RE = re.compile(r"#\s*TODO(?!\()")
+# matches the attribute names however they are reached (jax.device_get,
+# a bare import, x.block_until_ready(), or a string that smuggles one in)
+DEVICE_SYNC_RE = re.compile(r"device_get|block_until_ready")
+
+
+def _in_observability(path: str) -> bool:
+    return "observability" in os.path.normpath(os.path.abspath(path)).split(
+        os.sep)
 
 
 def lint_file(path: str) -> int:
     issues = 0
+    no_sync = _in_observability(path)
     with open(path, encoding="utf-8", errors="replace") as f:
         for lineno, line in enumerate(f, 1):
             stripped = line.rstrip("\n")
@@ -31,6 +46,11 @@ def lint_file(path: str) -> int:
                 issues += 1
             if TODO_RE.search(stripped):
                 print(f"{path}:{lineno}: TODO without owner — use TODO(name)")
+                issues += 1
+            if no_sync and DEVICE_SYNC_RE.search(stripped):
+                print(f"{path}:{lineno}: device sync in observability/ — "
+                      f"instruments must never sync the device "
+                      f"(megatron_llm_tpu/observability/__init__.py)")
                 issues += 1
     return issues
 

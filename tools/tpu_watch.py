@@ -114,6 +114,12 @@ JOBS = [
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
     ("bench_train_loop", [sys.executable, "bench_train_loop.py"],
      False, _bench_on_tpu),
+    # ISSUE 4: observability overhead — full instrumentation (tracing +
+    # registry + /metrics endpoint) vs none on the real pretrain loop,
+    # gate < 3% steps/sec (own watchdog, bench contract; evidence in
+    # BENCH_LAST_TPU_observability.json)
+    ("bench_observability", [sys.executable, "bench_observability.py"],
+     False, _bench_on_tpu),
     # ISSUE 3: resilience chaos smoke — kill-9/corrupt/hang round-trips on
     # CPU (mid-step kills would wedge the tunnel) + an integrity/resume
     # round-trip on TPU for the evidence line. Its children carry their own
