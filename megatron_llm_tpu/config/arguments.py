@@ -412,6 +412,18 @@ class InferenceConfig:
     page_size: int = 16
     kv_pool_pages: Optional[int] = None
     engine_max_seq: Optional[int] = None
+    # prefix cache + chunked prefill (ISSUE 5): shared refcounted prompt
+    # pages with copy-on-write, prefill split into --prefill_chunk-token
+    # chunks interleaved one per decode tick (0 = monolithic PR-1 prefill,
+    # which also disables the cache — it needs the block-table prefill
+    # path); --page_watermark is extra free+evictable slack admission keeps
+    # beyond the worst-case commitment of in-flight requests;
+    # --max_queued_requests bounds the submit queue (overflow -> 503 with
+    # Retry-After on the server, 0 = unbounded)
+    prefix_cache: bool = True
+    prefill_chunk: int = 64
+    page_watermark: int = 0
+    max_queued_requests: int = 256
 
 
 @dataclass

@@ -4,8 +4,10 @@ continuous-batching serving engine (generation/engine.py)."""
 from megatron_llm_tpu.generation.api import InferenceEngine
 from megatron_llm_tpu.generation.engine import (
     ContinuousBatchingEngine,
+    EngineOverloaded,
     EngineRequest,
     PagedKVPool,
+    PrefixCache,
 )
 from megatron_llm_tpu.generation.generation import (
     beam_search,
@@ -16,9 +18,11 @@ from megatron_llm_tpu.generation.sampling import sample, sample_per_slot
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "EngineOverloaded",
     "EngineRequest",
     "InferenceEngine",
     "PagedKVPool",
+    "PrefixCache",
     "beam_search",
     "generate_tokens",
     "sample",

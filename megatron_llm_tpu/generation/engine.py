@@ -1,36 +1,56 @@
-"""Continuous-batching decode engine on a paged KV cache.
+"""Continuous-batching decode engine on a prefix-cached paged KV cache.
 
 The legacy serving shape (generation/api.InferenceEngine) is the paper's:
 one request at a time, a dense ``[L, b, max_seq, nkv, d]`` cache allocated
 per call, and a program compiled per (batch, max_seq) bucket.  This engine
 is the TPU-serving shape the Ragged-Paged-Attention and Gemma-on-Cloud-TPU
 studies (PAPERS.md) converge on: keep ONE fixed-shape decode program
-resident and keep its batch full.
+resident, keep its batch full, and never compute the same prefix twice.
 
 * **Paged KV pool** (:class:`PagedKVPool`): all in-flight sequences share a
   ``[L, num_pages, page_size, nkv, d]`` pool; a sequence owns an ordered
-  page list (its block table).  Admission allocates the full page budget
-  ``ceil(min(prompt+max_new, max_seq)/page_size)`` up front — no mid-flight
-  preemption — and frees it the moment the request finishes, so short
-  requests return pages while long ones keep decoding.  Page 0 is the
+  page list (its block table).  Pages are REFERENCE-COUNTED: several
+  sequences may share the pages of a common prompt prefix.  Page 0 is the
   reserved *null page*: idle slots' block tables point at it and their
   writes land there, never attended.
+
+* **Prefix cache** (:class:`PrefixCache`): a host-side radix/trie keyed on
+  page-aligned token chunks.  Admission walks the trie, takes a ref on
+  every matched full page, and only prefills the uncovered suffix; when a
+  request's first tick must rewrite a shared page (page-aligned full match)
+  the page is copied first — copy-on-write, shared pages are never mutated.
+  Pages whose refcount drops to zero STAY in the cache until the free list
+  runs dry, then an LRU leaf-first eviction recycles them — pool exhaustion
+  no longer means rejection while reusable pages sit idle.
+
+* **On-demand pages**: admission allocates only the prompt-suffix pages
+  (plus the first decode page); decode grabs one page at each page-boundary
+  crossing.  A commitment ledger keeps ``free + evictable`` at least the
+  worst-case remaining demand of every admitted request (plus a
+  ``page_watermark`` slack), so an in-flight slot can never deadlock on the
+  pool — admission defers instead.
+
+* **Chunked prefill**: the uncovered suffix runs in fixed-size chunks that
+  write K/V through the block table and attend through it too
+  (ops/paged_attention.paged_attention_prefill — the prefix-length-aware
+  prefill-against-block-table mode, Pallas kernel on TPU).  The scheduler
+  interleaves ONE chunk per decode tick instead of stalling the whole batch
+  for a monolithic prompt, so queued requests' time-to-first-token stops
+  scaling with the longest admitted prompt.  Chunk boundaries are aligned
+  to absolute-position multiples of ``prefill_chunk`` and the attended page
+  horizon is bucketed per chunk, so the K/V bits a chunk produces depend
+  only on (tokens, absolute positions) — a cache hit replays bitwise the
+  pages a cold prefill would compute (the cache-on/off parity contract,
+  tests/test_prefix_cache.py).  ``prefill_chunk=0`` restores the PR 1
+  monolithic dense prefill (and disables the prefix cache, which needs the
+  block-table prefill path).
 
 * **Slots + fixed shapes**: the decode tick runs ``max_slots`` rows every
   time, active or not.  Block tables, positions, per-slot sampling params
   and per-slot PRNG keys are *traced* inputs, so the tick compiles ONCE;
-  prefill compiles once per prompt-length bucket (BUCKET multiples, same
-  policy as generation/api.py).  Off-by-default slots cost one row of
-  wasted FLOPs — the price of never recompiling.
-
-* **Scheduler**: ``submit`` enqueues; admission fills free slots whenever
-  slots+pages allow (FCFS).  A prefill runs the prompt through the dense
-  cache path once (no logits head — ``logits_postprocess=False``) and
-  scatters the resulting K/V into the request's pages; the slot then joins
-  the shared per-tick decode.  The first generated token is sampled by the
-  slot's first tick, which re-feeds the last prompt token at position
-  ``prompt_len - 1`` (rewriting that K/V entry with identical values), so
-  every sampled token flows through the same tick program.
+  prefill compiles once per (chunk rows, page horizon) pair.  Slots mid
+  prefill keep their device block-table row at the null page, so tick
+  writes from not-yet-active rows land in garbage that is never attended.
 
 * **Decode tick**: one fused jitted step — embed [slots, 1] tokens, write
   each row's K/V into its current page, paged attention over block tables
@@ -50,8 +70,9 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -76,13 +97,34 @@ def _bucket_up(n: int, bucket: int = gen.BUCKET) -> int:
     return -(-n // bucket) * bucket
 
 
+class EngineOverloaded(RuntimeError):
+    """Submit-time backpressure: the request queue is at capacity.
+
+    The server maps this to a structured 503 with a ``Retry-After`` header
+    instead of queueing unboundedly (generation/server.py)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 class PagedKVPool:
-    """Device page pool + host free-list allocator.
+    """Device page pool + host refcounting allocator.
 
     The device arrays are plain stacked pytrees ``[L, P, page, nkv, d]``
     (scanned over L exactly like the dense cache); the allocator is
-    host-side python — alloc/free happen at request admission/retirement,
-    thousands of times below tick frequency.
+    host-side python — alloc/release happen at request admission/retirement
+    and page-boundary crossings, far below tick frequency.
+
+    Page states (disjoint, tests/test_prefix_cache.py invariants):
+
+    * **free** — on the free list, refcount 0, not cached;
+    * **referenced** — refcount > 0 (held by >= 1 request's block table),
+      possibly ALSO registered in the prefix cache;
+    * **cached-idle** — refcount 0 but registered in the prefix cache
+      (``cached``): reusable by a future match, reclaimable by
+      ``evict_hook`` (PrefixCache.evict, LRU leaf-first) when ``alloc``
+      outruns the free list.
     """
 
     def __init__(self, cfg, num_pages: int, page_size: int, dtype=None):
@@ -94,6 +136,11 @@ class PagedKVPool:
         self.v = jnp.zeros(shape, dtype)
         self.num_pages = num_pages
         self.page_size = page_size
+        self.refcounts = np.zeros((num_pages,), np.int32)
+        # pages owned by the prefix cache (trie nodes); maintained by
+        # PrefixCache, read here for release/eviction accounting
+        self.cached: Set[int] = set()
+        self.evict_hook = None  # PrefixCache.evict: (n) -> freed page list
         # page 0 reserved as the null page (never allocated)
         self._free: deque = deque(range(1, num_pages))
 
@@ -101,16 +148,147 @@ class PagedKVPool:
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_evictable(self) -> int:
+        """Cached pages no request references — reclaimable on demand."""
+        return sum(1 for p in self.cached if self.refcounts[p] == 0)
+
+    @property
+    def num_available(self) -> int:
+        """Pages an ``alloc`` could produce right now (free + evictable)."""
+        return self.num_free + self.num_evictable
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` pages, or None if the pool can't satisfy the request."""
+        """``n`` fresh pages at refcount 1, or None if free + evictable
+        can't satisfy the request.  Evicts cached-idle pages (LRU,
+        leaf-first) only when the free list alone runs short."""
+        if n > self.num_available:
+            return None
+        if n > len(self._free) and self.evict_hook is not None:
+            self._free.extend(self.evict_hook(n - len(self._free)))
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            assert self.refcounts[p] == 0 and p not in self.cached
+            self.refcounts[p] = 1
+        return pages
 
-    def free(self, pages: Sequence[int]) -> None:
+    def incref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert p != NULL_PAGE
+            self.refcounts[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page.  Unreferenced pages return to the
+        free list unless the prefix cache still holds them (those stay
+        cached-idle until matched again or evicted)."""
         for p in pages:
             assert p != NULL_PAGE, "null page is never allocated"
-            self._free.append(p)
+            self.refcounts[p] -= 1
+            assert self.refcounts[p] >= 0, f"page {p} over-released"
+            if self.refcounts[p] == 0 and p not in self.cached:
+                self._free.append(p)
+
+
+class _TrieNode:
+    __slots__ = ("key", "page", "parent", "children", "last_use")
+
+    def __init__(self, key, page, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Host-side radix/trie over page-aligned token chunks -> pool pages.
+
+    Each node owns one FULL page of prompt K/V, keyed by that page's
+    ``page_size`` token ids; a path from the root spells a prompt prefix.
+    ``match`` walks the trie and takes a pool reference on every matched
+    page (the caller's block table will point at them); ``insert`` registers
+    a freshly prefilled request's full prompt pages so later requests can
+    share them.  Because a request that matches a page has, by
+    construction, matched ALL its ancestors too, a refcount-0 node's
+    descendants are also refcount-0 — so eviction can always proceed
+    leaf-first through cached-idle subtrees, and ``PagedKVPool.num_evictable``
+    (a flat count) is exactly the number of reclaimable pages.
+    """
+
+    def __init__(self, pool: PagedKVPool, page_size: int):
+        self.pool = pool
+        self.page_size = page_size
+        self.root = _TrieNode(None, NULL_PAGE, None)
+        self._nodes: Dict[int, _TrieNode] = {}  # page id -> node
+        self._clock = 0
+        pool.evict_hook = self.evict
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _key(self, tokens: Sequence[int], i: int) -> Tuple[int, ...]:
+        ps = self.page_size
+        return tuple(tokens[i * ps:(i + 1) * ps])
+
+    def match(self, tokens: Sequence[int], max_pages: int) -> List[int]:
+        """Longest cached prefix of ``tokens`` in whole pages (capped at
+        ``max_pages``); takes one pool ref per matched page."""
+        self._clock += 1
+        node, pages = self.root, []
+        for i in range(max_pages):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            child.last_use = self._clock
+            pages.append(child.page)
+            node = child
+        self.pool.incref(pages)
+        return pages
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               n_pages: int) -> int:
+        """Register the first ``n_pages`` full pages of a prefilled prompt;
+        pages already cached at a position keep the incumbent (the
+        request's duplicate page simply stays private).  Returns the number
+        of pages newly cached."""
+        self._clock += 1
+        node, added = self.root, 0
+        for i in range(n_pages):
+            key = self._key(tokens, i)
+            child = node.children.get(key)
+            if child is None:
+                p = pages[i]
+                if p in self._nodes:  # defensive: one node per page
+                    break
+                child = _TrieNode(key, p, node)
+                node.children[key] = child
+                self._nodes[p] = child
+                self.pool.cached.add(p)
+                added += 1
+            child.last_use = self._clock
+            node = child
+        return added
+
+    def evict(self, n: int) -> List[int]:
+        """Reclaim up to ``n`` cached-idle pages, least-recently-used
+        leaves first (removing a leaf may expose its parent next round)."""
+        freed: List[int] = []
+        while len(freed) < n:
+            victim = None
+            for node in self._nodes.values():
+                if node.children or self.pool.refcounts[node.page] != 0:
+                    continue
+                if victim is None or node.last_use < victim.last_use:
+                    victim = node
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            del self._nodes[victim.page]
+            self.pool.cached.discard(victim.page)
+            freed.append(victim.page)
+        return freed
 
 
 @dataclasses.dataclass
@@ -139,6 +317,14 @@ class EngineRequest:
         default_factory=threading.Event, repr=False)
     _pages: List[int] = dataclasses.field(default_factory=list, repr=False)
     _step: int = 0  # decode ticks taken (== len(generated))
+    # scheduler state: queued -> prefill -> decode -> finished
+    _phase: str = dataclasses.field(default="queued", repr=False)
+    _slot: int = dataclasses.field(default=-1, repr=False)
+    _fill_pos: int = dataclasses.field(default=0, repr=False)
+    _max_pages: int = dataclasses.field(default=0, repr=False)
+    _hit_tokens: int = dataclasses.field(default=0, repr=False)
+    _t_submit: float = dataclasses.field(default=0.0, repr=False)
+    _t_first: float = dataclasses.field(default=0.0, repr=False)
 
     def result(self, timeout: Optional[float] = None):
         """Wait for completion; returns (full token list, gen log-probs)."""
@@ -148,15 +334,26 @@ class EngineRequest:
             raise RuntimeError(self.error)
         return list(self.prompt) + self.generated, list(self.log_probs)
 
+    @property
+    def ttft(self) -> Optional[float]:
+        """Seconds from submit to first generated token (bench telemetry)."""
+        if self._t_first == 0.0:
+            return None
+        return self._t_first - self._t_submit
+
 
 class ContinuousBatchingEngine:
-    """Shared-tick decode over a paged pool; the serving tentpole."""
+    """Shared-tick decode over a prefix-cached paged pool."""
 
     def __init__(self, cfg, params, tokenizer=None, *,
                  max_slots: Optional[int] = None,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 max_seq: Optional[int] = None):
+                 max_seq: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 page_watermark: Optional[int] = None,
+                 max_queue: Optional[int] = None):
         inf = cfg.inference
         self.cfg = cfg
         if inf.int8_weights:
@@ -175,10 +372,26 @@ class ContinuousBatchingEngine:
         assert gen.BUCKET % self.page_size == 0, (
             "page_size must divide the prefill bucket so bucketed prefills "
             "scatter whole pages")
+        self.prefill_chunk = (prefill_chunk if prefill_chunk is not None
+                              else getattr(inf, "prefill_chunk", gen.BUCKET))
+        if self.prefill_chunk:
+            assert self.prefill_chunk % self.page_size == 0, (
+                "prefill_chunk must be a whole number of pages")
+        use_cache = (prefix_cache if prefix_cache is not None
+                     else getattr(inf, "prefix_cache", True))
+        self.page_watermark = (page_watermark if page_watermark is not None
+                               else getattr(inf, "page_watermark", 0))
+        self.max_queue = (max_queue if max_queue is not None
+                          else getattr(inf, "max_queued_requests", 256))
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
         self.pool = PagedKVPool(cfg, num_pages, self.page_size)
+        # the prefix cache needs the block-table prefill path: a monolithic
+        # dense prefill recomputes and rewrites the whole prompt, shared
+        # pages included
+        self.cache = (PrefixCache(self.pool, self.page_size)
+                      if use_cache and self.prefill_chunk else None)
 
         s = self.max_slots
         self._block_tables = np.zeros((s, self.pages_per_seq), np.int32)
@@ -192,6 +405,11 @@ class ContinuousBatchingEngine:
         self._slots: List[Optional[EngineRequest]] = [None] * s
 
         self._queue: deque = deque()
+        self._prefill_q: deque = deque()  # admitted, prompt not yet filled
+        # worst-case pages admitted-but-not-yet-held; admission keeps
+        # free + evictable >= committed (+ watermark) so decode-time allocs
+        # can never deadlock an in-flight slot
+        self._committed = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         # serializes device-driving (step) across caller threads; state
@@ -202,13 +420,19 @@ class ContinuousBatchingEngine:
 
         self._tick_fn = None
         self._prefill_fns: Dict[Tuple[int, bool], object] = {}
+        self._chunk_fns: Dict[Tuple[int, int, bool], object] = {}
+        self._copy_fn = None
         # device mirror of the per-slot arrays; rebuilt from the host copies
         # whenever admission/retirement changes the slot layout
         self._dev_state: Optional[Tuple] = None
         self._dirty = True
-        # tick telemetry for the decode bench
+        # tick/cache telemetry for the decode bench
         self.ticks = 0
         self.ticked_tokens = 0
+        self.prefill_tokens_computed = 0  # rows pushed through prefill
+        self.prefix_hit_tokens = 0
+        self.prefix_miss_tokens = 0
+        self.cow_copies = 0
         # registry instruments, resolved once (observability/registry.py):
         # per-tick updates must stay dict-free on the scheduler thread
         reg = obs_registry.get_registry()
@@ -225,6 +449,21 @@ class ContinuousBatchingEngine:
             "mlt_engine_queued_requests", help="requests awaiting a slot")
         self._m_free_pages = reg.gauge(
             "mlt_engine_free_pages", help="KV pool pages free")
+        self._m_hit_tokens = reg.counter(
+            "mlt_engine_prefix_hit_tokens_total",
+            help="prompt tokens served from the prefix cache")
+        self._m_miss_tokens = reg.counter(
+            "mlt_engine_prefix_miss_tokens_total",
+            help="prompt tokens that had to be prefilled")
+        self._m_pages_cached = reg.gauge(
+            "mlt_engine_pages_cached",
+            help="pool pages registered in the prefix cache")
+        self._m_cow = reg.counter(
+            "mlt_engine_pages_cow_copies_total",
+            help="copy-on-write page copies (shared page would be written)")
+        self._m_prefill_tokens = reg.counter(
+            "mlt_engine_prefill_tokens_total",
+            help="token rows pushed through prefill (chunked or monolithic)")
         reg.gauge("mlt_engine_max_slots",
                   help="decode slots in the tick program").set(self.max_slots)
         reg.gauge("mlt_engine_pool_pages",
@@ -271,6 +510,9 @@ class ContinuousBatchingEngine:
         return self._tick_fn
 
     def _prefill(self, s_pre: int, with_log_probs: bool):
+        """Monolithic dense prefill (the ``prefill_chunk=0`` legacy path):
+        one dense-cache forward over the bucketed prompt, scattered into the
+        request's pages as whole pages."""
         key = (s_pre, with_log_probs)
         fn = self._prefill_fns.get(key)
         if fn is not None:
@@ -307,6 +549,57 @@ class ContinuousBatchingEngine:
         self._prefill_fns[key] = fn
         return fn
 
+    def _chunk_prefill(self, rows: int, kv_pages: int, with_log_probs: bool):
+        """One prefill CHUNK: feed ``rows`` prompt tokens at positions
+        ``start..start+rows-1`` through the block table (write K/V into the
+        owned pages, attend over the first ``kv_pages`` pages).  Compiled
+        per (rows, page horizon) — both page-aligned and horizon bucketed,
+        so a server sees a handful of shapes."""
+        key = (rows, kv_pages, with_log_probs)
+        fn = self._chunk_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+
+        def chunk(params, tokens, start, bt, pool_k, pool_v, targets):
+            out, (pool_k, pool_v) = model_forward(
+                cfg, params, tokens,
+                position_ids=start[:, None] + jnp.arange(rows)[None, :],
+                rope_cache=make_rope_cache(cfg),
+                kv_caches=(pool_k, pool_v),
+                paged=PagedState(bt, start),
+                logits_postprocess=with_log_probs,
+            )
+            if with_log_probs:
+                lp = gen._gather_token_log_probs(out, targets)
+                return pool_k, pool_v, lp[0]
+            return pool_k, pool_v
+
+        statics = ("engine_prefill_chunk", rows, kv_pages, with_log_probs,
+                   self.page_size, self.pool.num_pages,
+                   str(self.pool.k.dtype))
+        fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
+                            lambda: chunk, donate_argnums=(4, 5))
+        self._chunk_fns[key] = fn
+        return fn
+
+    def _copy_page(self):
+        """Device page copy for copy-on-write (src/dst are traced scalars —
+        one compile serves every copy)."""
+        if self._copy_fn is not None:
+            return self._copy_fn
+
+        def copy(pool_k, pool_v, src, dst):
+            pool_k = pool_k.at[:, dst].set(pool_k[:, src])
+            pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+            return pool_k, pool_v
+
+        statics = ("engine_copy_page", self.pool.num_pages, self.page_size,
+                   str(self.pool.k.dtype))
+        self._copy_fn = gen.cached_jit(self.cfg, "engine_copy_page", statics,
+                                       lambda: copy, donate_argnums=(0, 1))
+        return self._copy_fn
+
     # -- request lifecycle -------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -314,7 +607,8 @@ class ContinuousBatchingEngine:
         """Enqueue a generation; returns the request future.
 
         Raises ValueError for requests that can never fit (the legacy
-        engine's request-size guard, generation/api._check_limits)."""
+        engine's request-size guard, generation/api._check_limits) and
+        :class:`EngineOverloaded` when the queue is at capacity."""
         prompt = [int(t) for t in prompt]
         if len(prompt) < 1:
             raise ValueError("prompt must contain at least one token")
@@ -324,8 +618,12 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "Length of prompt + tokens_to_generate longer than allowed")
         req = EngineRequest(prompt=prompt, max_new_tokens=max_new_tokens, **kw)
+        req._t_submit = time.monotonic()
         with obs_trace.span("engine-enqueue", prompt_len=len(prompt)):
             with self._work:
+                if self.max_queue and len(self._queue) >= self.max_queue:
+                    raise EngineOverloaded(
+                        f"request queue full ({self.max_queue} waiting)")
                 self._queue.append(req)
                 if obs_registry.publishing():
                     self._m_requests.inc()
@@ -333,7 +631,7 @@ class ContinuousBatchingEngine:
                 self._work.notify()
         return req
 
-    def _pages_needed(self, req: EngineRequest) -> int:
+    def _max_pages_for(self, req: EngineRequest) -> int:
         total = min(len(req.prompt) + req.max_new_tokens, self.max_seq)
         return -(-total // self.page_size)
 
@@ -341,8 +639,14 @@ class ContinuousBatchingEngine:
         """Move queued requests into free slots while slots+pages allow.
 
         FCFS admission: blocks behind the queue head rather than starving
-        large requests (pages for the whole request are reserved here, so an
-        admitted request can always run to its budget)."""
+        large requests.  Chunked mode reserves only the uncovered prompt
+        suffix (plus the first decode page) and books the worst-case rest
+        in the commitment ledger; monolithic mode reserves the full budget
+        up front (PR 1 semantics).  Planning (trie match, budget check,
+        allocation, slot assignment) happens under ``_lock``; only the
+        device work (COW copy / monolithic prefill) runs outside it, with
+        every owned page ref tracked in ``req._pages`` throughout so a
+        failure path releases exactly what is held."""
         while True:
             with self._lock:
                 if not self._queue:
@@ -352,20 +656,117 @@ class ContinuousBatchingEngine:
                 except ValueError:
                     return
                 req = self._queue[0]
-                pages = self.pool.alloc(self._pages_needed(req))
-                if pages is None:
-                    return
+                if self.prefill_chunk:
+                    plan = self._plan_chunked(req, slot)
+                else:
+                    plan = self._plan_monolithic(req, slot)
+                if plan is None:
+                    return  # page pressure: head waits, nothing skips it
                 self._queue.popleft()
+                if obs_registry.publishing():
+                    self._m_queued.set(len(self._queue))
             try:
-                self._place(req, slot, pages)
+                if self.prefill_chunk:
+                    self._place_chunked(req, plan)
+                else:
+                    self._place_monolithic(req)
             except Exception as e:  # noqa: BLE001 — surface to the waiter
-                self.pool.free(pages)
-                req.error = f"{type(e).__name__}: {e}"
-                req.finished = True
-                req._done.set()
+                self._fail(req, e)
 
-    def _place(self, req: EngineRequest, slot: int, pages: List[int]) -> None:
-        """Prefill the prompt into ``pages`` and activate the slot."""
+    # ---- chunked admission ----
+
+    def _plan_chunked(self, req: EngineRequest, slot: int) -> Optional[dict]:
+        """Under _lock: match the prefix cache, check the page budget,
+        allocate the suffix pages, and reserve the slot.  None = can't
+        admit now (matched refs undone)."""
+        ps = self.page_size
+        prompt_len = len(req.prompt)
+        max_total = self._max_pages_for(req)
+        matched: List[int] = []
+        if self.cache is not None and not req.return_log_probs:
+            # log-prob requests recompute the whole prompt (the teacher-
+            # forced scores need every position's logits), so they take no
+            # shared pages — their pages still feed the cache afterwards
+            matched = self.cache.match(req.prompt, prompt_len // ps)
+        covered = len(matched) * ps
+        # full page-aligned match: the first tick re-feeds the last prompt
+        # token and would WRITE the final shared page -> copy-on-write
+        cow = bool(matched) and covered == prompt_len
+        n_keep = len(matched) - (1 if cow else 0)
+        fill_end = _bucket_up(prompt_len, ps)
+        suffix_pages = (fill_end - covered) // ps
+        held_core = n_keep + (1 if cow else 0) + suffix_pages
+        extra = 1 if max_total > held_core else 0  # first decode page
+        need_now = (1 if cow else 0) + suffix_pages + extra
+        remaining = max_total - held_core - extra
+        if (self.pool.num_available - need_now
+                < self._committed + remaining + self.page_watermark):
+            self.pool.release(matched)
+            return None
+        fresh = self.pool.alloc(need_now)
+        if fresh is None:  # unreachable given the check; stay safe
+            self.pool.release(matched)
+            return None
+        self._committed += remaining
+        # every ref this request owns lives in _pages from here on, so any
+        # failure path releases exactly the right set; the COW page swap
+        # reorders the list after the device copy lands
+        req._pages = matched + fresh
+        req._max_pages = max_total
+        req._fill_pos = prompt_len if cow else covered
+        req._hit_tokens = covered
+        req._slot = slot
+        self._slots[slot] = req
+        self.prefix_hit_tokens += covered
+        self.prefix_miss_tokens += prompt_len - covered
+        if obs_registry.publishing():
+            self._m_hit_tokens.inc(covered)
+            self._m_miss_tokens.inc(prompt_len - covered)
+        return {"matched": matched, "fresh": fresh, "cow": cow,
+                "n_keep": n_keep}
+
+    def _place_chunked(self, req: EngineRequest, plan: dict) -> None:
+        matched, fresh = plan["matched"], plan["fresh"]
+        n_keep, cow = plan["n_keep"], plan["cow"]
+        if cow:
+            src, dst = matched[-1], fresh[0]
+            # device copy OUTSIDE the lock (driver thread; serialized with
+            # ticks via _drive_lock), then drop our ref on the shared page
+            self.pool.k, self.pool.v = self._copy_page()(
+                self.pool.k, self.pool.v, jnp.int32(src), jnp.int32(dst))
+        with self._lock:
+            if cow:
+                # block-table order: kept shared pages, the private COW
+                # copy, then the first decode page
+                req._pages = matched[:n_keep] + [fresh[0]] + fresh[1:]
+                self.pool.release([matched[-1]])
+                self.cow_copies += 1
+                if obs_registry.publishing():
+                    self._m_cow.inc()
+            if req._fill_pos >= len(req.prompt):
+                # fully served from cache: straight to decode
+                self._activate(req, req._slot)
+            else:
+                req._phase = "prefill"
+                self._prefill_q.append(req)
+
+    # ---- monolithic admission (prefill_chunk=0, PR 1 semantics) ----
+
+    def _plan_monolithic(self, req: EngineRequest,
+                         slot: int) -> Optional[dict]:
+        pages = self.pool.alloc(self._max_pages_for(req))
+        if pages is None:
+            return None
+        req._pages = pages
+        req._max_pages = len(pages)
+        req._slot = slot
+        self._slots[slot] = req
+        return {"pages": pages}
+
+    def _place_monolithic(self, req: EngineRequest) -> None:
+        """Prefill the whole prompt into the request's pages and activate
+        the slot."""
+        pages = req._pages
         prompt_len = len(req.prompt)
         s_pre = min(_bucket_up(prompt_len), _bucket_up(self.max_seq))
         tokens = np.zeros((1, s_pre), np.int32)
@@ -386,27 +787,57 @@ class ContinuousBatchingEngine:
         else:
             self.pool.k, self.pool.v = out
 
+        with self._lock:
+            req._fill_pos = prompt_len
+            self.prefix_miss_tokens += prompt_len
+            self.prefill_tokens_computed += s_pre
+            if obs_registry.publishing():
+                self._m_miss_tokens.inc(prompt_len)
+                self._m_prefill_tokens.inc(s_pre)
+            self._activate(req, req._slot)
+
+    # ---- shared lifecycle tail ----
+
+    def _activate(self, req: EngineRequest, slot: int) -> None:
+        """Under _lock: install the slot's decode state (prompt fully in
+        pages); the next tick samples the first generated token by
+        re-feeding the last prompt token at position prompt_len - 1 —
+        identical K/V rewrite into a PRIVATE page (COW guarantees it)."""
+        prompt_len = len(req.prompt)
         seed = req.seed
         if seed is None:
             seed = int.from_bytes(os.urandom(4), "little")
         key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        bt = np.full((self.pages_per_seq,), NULL_PAGE, np.int32)
+        bt[: len(req._pages)] = req._pages
+        self._block_tables[slot] = bt
+        self._positions[slot] = prompt_len - 1
+        self._tokens[slot] = req.prompt[-1]
+        self._temperature[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        self._keys[slot] = key
+        self._steps[slot] = 0
+        req._phase = "decode"
+        self._dirty = True
 
+    def _fail(self, req: EngineRequest, e: Exception) -> None:
         with self._lock:
-            req._pages = pages
-            self._slots[slot] = req
-            bt = np.full((self.pages_per_seq,), NULL_PAGE, np.int32)
-            bt[: len(pages)] = pages
-            self._block_tables[slot] = bt
-            # first tick re-feeds the last prompt token at prompt_len-1:
-            # identical K/V rewrite, and the tick samples generated token #1
-            self._positions[slot] = prompt_len - 1
-            self._tokens[slot] = req.prompt[-1]
-            self._temperature[slot] = req.temperature
-            self._top_k[slot] = req.top_k
-            self._top_p[slot] = req.top_p
-            self._keys[slot] = key
-            self._steps[slot] = 0
+            self._fail_locked(req, e)
+
+    def _fail_locked(self, req: EngineRequest, e: Exception) -> None:
+        if 0 <= req._slot < len(self._slots) \
+                and self._slots[req._slot] is req:
+            self._slots[req._slot] = None
+            self._block_tables[req._slot] = NULL_PAGE
             self._dirty = True
+        pages, req._pages = req._pages, []
+        self._committed -= max(0, req._max_pages - len(pages))
+        self.pool.release(pages)
+        req._phase = "finished"
+        req.error = f"{type(e).__name__}: {e}"
+        req.finished = True
+        req._done.set()
 
     def _retire(self, slot: int) -> None:
         req = self._slots[slot]
@@ -418,8 +849,11 @@ class ContinuousBatchingEngine:
         self._top_p[slot] = 0.0
         self._temperature[slot] = 1.0
         pages, req._pages = req._pages, []
-        self.pool.free(pages)
+        # early termination returns its unneeded worst-case commitment
+        self._committed -= max(0, req._max_pages - len(pages))
+        self.pool.release(pages)
         self._dirty = True
+        req._phase = "finished"
         req.finished = True
         req._done.set()
 
@@ -435,24 +869,124 @@ class ContinuousBatchingEngine:
             return False
         return tok == req.termination_id
 
+    # -- chunked prefill scheduling ---------------------------------------
+
+    def _advance_prefill(self) -> bool:
+        """Run ONE prefill chunk for the oldest prefilling request (FCFS).
+        Returns True if a chunk ran — at most one per tick, so decode slots
+        keep ticking while long prompts fill in the gaps."""
+        with self._lock:
+            while self._prefill_q and self._prefill_q[0]._phase != "prefill":
+                self._prefill_q.popleft()  # failed/cancelled requests
+            if not self._prefill_q:
+                return False
+            req = self._prefill_q[0]
+            ps = self.page_size
+            chunk = self.prefill_chunk
+            prompt_len = len(req.prompt)
+            start = req._fill_pos
+            fill_end = _bucket_up(prompt_len, ps)
+            # chunk boundaries are ABSOLUTE-position grid multiples of
+            # prefill_chunk (first/last chunks may be short): the K/V bits a
+            # chunk writes then depend only on (tokens, positions), never on
+            # how much prefix the cache covered — the bitwise cache-on/off
+            # parity contract
+            end = min(fill_end, (start // chunk + 1) * chunk)
+            rows = end - start
+            # attention horizon: every page the chunk's queries can see,
+            # bucketed (multiples of BUCKET tokens) to bound compile count
+            kv_pages = min(self.pages_per_seq, _bucket_up(end) // ps)
+            tokens = np.zeros((1, rows), np.int32)
+            n_real = min(end, prompt_len) - start
+            tokens[0, :n_real] = req.prompt[start:start + n_real]
+            bt = np.full((1, kv_pages), NULL_PAGE, np.int32)
+            n_bt = min(len(req._pages), kv_pages)
+            bt[0, :n_bt] = req._pages[:n_bt]
+            targets = np.zeros((1, rows), np.int32)
+            n_lp = max(0, min(rows, prompt_len - 1 - start))
+            if req.return_log_probs and n_lp:
+                targets[0, :n_lp] = req.prompt[start + 1:start + 1 + n_lp]
+
+        try:
+            with obs_trace.span("engine-prefill-chunk", start=start,
+                                rows=rows):
+                out = self._chunk_prefill(rows, kv_pages,
+                                          req.return_log_probs)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray([start], np.int32), jnp.asarray(bt),
+                    self.pool.k, self.pool.v, jnp.asarray(targets))
+            if req.return_log_probs:
+                self.pool.k, self.pool.v, lp = out
+                if req.prompt_log_probs is None:
+                    req.prompt_log_probs = []
+                req.prompt_log_probs.extend(
+                    float(x) for x in np.asarray(lp)[:n_lp])
+            else:
+                self.pool.k, self.pool.v = out
+        except Exception as e:  # noqa: BLE001 — surface to the waiter
+            self._fail(req, e)
+            return True
+
+        with self._lock:
+            req._fill_pos = end
+            self.prefill_tokens_computed += rows
+            if obs_registry.publishing():
+                self._m_prefill_tokens.inc(rows)
+            if end >= fill_end:
+                self._prefill_q.popleft()
+                if self.cache is not None:
+                    # cache every page FULLY covered by prompt tokens that
+                    # the refeed tick will never write: (prompt_len-1)//page
+                    # excludes the refeed page, so shared pages are
+                    # immutable from birth
+                    self.cache.insert(req.prompt, req._pages,
+                                      (prompt_len - 1) // ps)
+                self._activate(req, req._slot)
+        return True
+
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> int:
-        """Admit what fits, run one fused decode tick over every slot, and
-        retire finished requests.  Returns the number of active slots the
-        tick advanced (0 = idle, nothing ran).  Call from one driver at a
-        time (:meth:`run_until_idle` / the background loop serialize via
+        """Admit what fits, advance one prefill chunk, run one fused decode
+        tick over every slot, and retire finished requests.  Returns the
+        number of slots advanced (decode rows ticked, +1 if a prefill chunk
+        ran; 0 = idle, nothing ran).  Call from one driver at a time
+        (:meth:`run_until_idle` / the background loop serialize via
         ``_drive_lock``)."""
         with obs_trace.span("engine-admit"):
             self._admit()
+        did_prefill = int(self._advance_prefill())
         with self._lock:
-            active = [i for i, r in enumerate(self._slots) if r is not None]
+            active = [i for i, r in enumerate(self._slots)
+                      if r is not None and r._phase == "decode"]
             if not active:
                 if obs_registry.publishing():
                     self._m_active.set(0)
                     self._m_queued.set(len(self._queue))
                     self._m_free_pages.set(self.pool.num_free)
-                return 0
+                    self._m_pages_cached.set(
+                        len(self.cache) if self.cache else 0)
+                return did_prefill
+            # on-demand paging: a row crossing into a page it doesn't own
+            # yet gets one allocated now (commitment ledger guarantees this
+            # can't fail while the slot is in flight)
+            for i in list(active):
+                req = self._slots[i]
+                idx = int(self._positions[i]) // self.page_size
+                if self._block_tables[i][idx] == NULL_PAGE:
+                    got = self.pool.alloc(1)
+                    if got is None:  # ledger-unreachable; fail just the row
+                        self._fail_locked(req, RuntimeError(
+                            "KV pool exhausted for an in-flight slot — "
+                            "commitment ledger violated"))
+                        active.remove(i)
+                        continue
+                    self._block_tables[i][idx] = got[0]
+                    req._pages.append(got[0])
+                    self._committed -= 1
+                    self._dirty = True
+            if not active:
+                return did_prefill
             if self._dirty:
                 self._dev_state = (jnp.asarray(self._block_tables),
                                    jnp.asarray(self._positions),
@@ -473,6 +1007,7 @@ class ContinuousBatchingEngine:
             next_np = np.asarray(next_tok)
             logp_np = np.asarray(logp)
 
+        now = time.monotonic()
         with self._lock:
             if not self._dirty:
                 # steady state: the tick already advanced the device mirror
@@ -489,6 +1024,8 @@ class ContinuousBatchingEngine:
                 req.generated.append(tok)
                 req.log_probs.append(float(logp_np[i]))
                 req._step += 1
+                if req._step == 1:
+                    req._t_first = now
                 self._positions[i] += 1
                 self._tokens[i] = tok
                 self._steps[i] += 1
@@ -499,10 +1036,13 @@ class ContinuousBatchingEngine:
                     self._retire(i)
             if obs_registry.publishing():
                 self._m_active.set(
-                    sum(r is not None for r in self._slots))
+                    sum(r is not None and r._phase == "decode"
+                        for r in self._slots))
                 self._m_queued.set(len(self._queue))
                 self._m_free_pages.set(self.pool.num_free)
-        return len(active)
+                self._m_pages_cached.set(
+                    len(self.cache) if self.cache else 0)
+        return len(active) + did_prefill
 
     def run_until_idle(self) -> None:
         """Drive ticks on the calling thread until queue and slots drain.

@@ -27,6 +27,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
 
+from megatron_llm_tpu.generation.engine import EngineOverloaded
+
 _STATIC_DIR = Path(__file__).parent / "static"
 
 
@@ -179,6 +181,12 @@ class MegatronServer:
                 )
                 return 200, {"text": texts, "segments": segments,
                              "logprobs": logprobs}
+            except EngineOverloaded as eo:
+                # backpressure instead of unbounded queueing: structured
+                # 503 + machine-readable retry hint (the HTTP handler turns
+                # retry_after into a Retry-After header)
+                return 503, {"error": str(eo),
+                             "retry_after": getattr(eo, "retry_after", 1.0)}
             except (ValueError, AssertionError) as ve:
                 return 400, {"error": str(ve.args[0] if ve.args else ve)}
             except Exception as e:  # engine failure must still answer the client
@@ -189,12 +197,15 @@ class MegatronServer:
 
     def _make_handler(server):  # noqa: N805 — `server` is the enclosing object
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, body, content_type="application/json"):
+            def _send(self, code: int, body, content_type="application/json",
+                      headers=None):
                 data = (json.dumps(body) if content_type == "application/json"
                         else body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -213,7 +224,12 @@ class MegatronServer:
                         "error": f"internal error: {type(e).__name__}: {e}"}
                 if isinstance(body, str):  # legacy engines may return text
                     return self._send(code, body, "text/plain")
-                return self._send(code, body)
+                headers = None
+                if code == 503 and isinstance(body, dict) \
+                        and "retry_after" in body:
+                    headers = {"Retry-After":
+                               str(max(1, int(body["retry_after"])))}
+                return self._send(code, body, headers=headers)
 
             do_POST = do_PUT  # convenience; reference is PUT-only
 
@@ -238,17 +254,26 @@ class MegatronServer:
         return Handler
 
     def health(self) -> dict:
-        """Liveness + engine occupancy (continuous-batching engines only)."""
+        """Liveness + engine occupancy + prefix-cache state (continuous-
+        batching engines only)."""
         info = {"status": "ok", "batching": self.batching}
         eng = self.engine
         if self.batching:
             with eng._lock:
+                cache = getattr(eng, "cache", None)
                 info.update(
                     active_slots=sum(r is not None for r in eng._slots),
                     max_slots=eng.max_slots,
                     queued=len(eng._queue),
+                    prefilling=sum(
+                        r is not None and r._phase == "prefill"
+                        for r in eng._slots),
                     free_pages=eng.pool.num_free,
                     total_pages=eng.pool.num_pages - 1,
+                    pages_cached=len(cache) if cache is not None else 0,
+                    available_pages=eng.pool.num_available,
+                    prefix_hit_tokens=eng.prefix_hit_tokens,
+                    prefix_miss_tokens=eng.prefix_miss_tokens,
                     ticks=eng.ticks,
                 )
         return info
@@ -269,6 +294,9 @@ class MegatronServer:
                 reg.gauge("mlt_engine_free_pages").set(eng.pool.num_free)
                 reg.gauge("mlt_engine_max_slots").set(eng.max_slots)
                 reg.gauge("mlt_engine_pool_pages").set(eng.pool.num_pages - 1)
+                cache = getattr(eng, "cache", None)
+                reg.gauge("mlt_engine_pages_cached").set(
+                    len(cache) if cache is not None else 0)
         return reg.render()
 
     def _start_engine(self):

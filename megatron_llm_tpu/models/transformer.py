@@ -224,33 +224,46 @@ def attention_sublayer(
 
     new_cache = None
     if paged is not None:
-        # Continuous-batching decode tick: one token per row, each at its own
-        # position. Write k/v into the row's current page, then attend over
-        # the row's block table (ops/paged_attention.py). Inactive slots'
-        # block tables point at the reserved null page 0, so their writes
-        # land in garbage that is never attended.
-        from megatron_llm_tpu.ops.paged_attention import paged_attention_decode
+        # Continuous-batching paged path. s == 1 is the decode tick: one
+        # token per row, each at its own position. s > 1 is a prefill CHUNK:
+        # the block of tokens occupies positions positions[b] ..
+        # positions[b] + s - 1 of each row. Either way: write k/v through
+        # the block table, then attend over the block table
+        # (ops/paged_attention.py). Inactive slots' block tables point at
+        # the reserved null page 0, so their writes land in garbage that is
+        # never attended.
+        from megatron_llm_tpu.ops.paged_attention import (
+            paged_attention_decode,
+            paged_attention_prefill,
+        )
 
-        assert s == 1, "paged attention is a single-position decode path"
         pk, pv = kv_cache
         page_size = pk.shape[1]
         pos = paged.positions
-        rows = jnp.arange(b)
+        wpos = pos[:, None] + jnp.arange(s)[None, :]       # [b, s]
         # clip: idle slots' device-side positions keep advancing between
-        # engine re-uploads; their (null-page) block-table lookups must stay
-        # in bounds
-        page_slot = jnp.clip(pos // page_size, 0,
+        # engine re-uploads, and a chunk's garbage padding rows may run past
+        # the table; clipped lookups resolve to null-page (or
+        # decode-overwritten) entries, so the stray writes are never attended
+        page_slot = jnp.clip(wpos // page_size, 0,
                              paged.block_tables.shape[1] - 1)
-        page_ids = paged.block_tables[rows, page_slot]
-        offs = pos % page_size
-        pk = pk.at[page_ids, offs].set(k[:, 0].astype(pk.dtype))
-        pv = pv.at[page_ids, offs].set(v[:, 0].astype(pv.dtype))
+        page_ids = jnp.take_along_axis(paged.block_tables, page_slot, axis=1)
+        offs = wpos % page_size
+        pk = pk.at[page_ids, offs].set(k.astype(pk.dtype))
+        pv = pv.at[page_ids, offs].set(v.astype(pv.dtype))
         new_cache = (pk, pv)
-        ctx = paged_attention_decode(
-            q, pk, pv, paged.block_tables, pos, scale=scale,
-            sliding_window=m.sliding_window_size,
-            use_kernel=cfg.training.use_flash_attn,
-        )
+        if s == 1:
+            ctx = paged_attention_decode(
+                q, pk, pv, paged.block_tables, pos, scale=scale,
+                sliding_window=m.sliding_window_size,
+                use_kernel=cfg.training.use_flash_attn,
+            )
+        else:
+            ctx = paged_attention_prefill(
+                q, pk, pv, paged.block_tables, pos, scale=scale,
+                sliding_window=m.sliding_window_size,
+                use_kernel=cfg.training.use_flash_attn,
+            )
     elif kv_cache is not None:
         # Incremental decode: write current k/v at cache_index, attend to the
         # full cache prefix (InferenceParams semantics, text_generation/

@@ -35,14 +35,19 @@ from megatron_llm_tpu.ops import attention as attn_ops
 
 
 class PagedState(NamedTuple):
-    """Per-tick addressing state threaded through model_forward.
+    """Per-call addressing state threaded through model_forward.
 
-    Both leaves are traced arrays, so one compiled tick program serves any
+    Both leaves are traced arrays, so one compiled program serves any
     block-table/position contents (fixed engine shapes, variable routing).
+
+    ``positions`` is the position of the FIRST token in the fed block: the
+    decode tick feeds ``[b, 1]`` tokens (one per row at its own position);
+    the chunked-prefill path feeds ``[1, chunk]`` tokens occupying positions
+    ``positions[0] .. positions[0] + chunk - 1`` of one sequence.
     """
 
     block_tables: jax.Array  # [b, max_pages_per_seq] int32 page ids
-    positions: jax.Array     # [b] int32 — position being decoded per row
+    positions: jax.Array     # [b] int32 — position of tokens[:, 0] per row
 
 
 def paged_gather_kv(k_pool: jax.Array, v_pool: jax.Array,
@@ -99,6 +104,59 @@ def paged_attention_decode(
     bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)
     return attn_ops.xla_attention(
         q, k_all, v_all, bias=bias[:, None, None, :], scale=scale)
+
+
+def paged_attention_prefill(
+    q: jax.Array,             # [b, s, n_heads, d] — chunk queries
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    block_tables: jax.Array,  # [b, kv_pages] int32 — pages covering the chunk
+    start: jax.Array,         # [b] int32 — position of q[:, 0]
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    use_kernel: bool = True,
+) -> jax.Array:
+    """One prefill CHUNK of paged attention; returns [b, s, n_heads, d].
+
+    Query row ``j`` of sequence ``i`` sits at position ``start[i] + j`` and
+    attends to cache positions ``<= start[i] + j`` of ``i``'s block table —
+    the prefix-length-aware prefill-against-block-table mode: earlier pages
+    may have been written by a previous chunk, by a different request's
+    prefill (shared prefix-cache pages), or by this very call (the engine
+    writes the chunk's own K/V through the block table before attending,
+    matching the decode tick's write-then-attend order).
+
+    ``block_tables`` is normally SLICED to the chunk's page horizon
+    (``ceil((start + s) / page_size)`` pages, possibly bucket-padded with
+    null pages) so the gather/grid cost scales with the attended context,
+    not the sequence budget.  Padding pages past a row's context are fully
+    masked — exact zeros after softmax, identical numerics either way.
+    """
+    assert q.ndim == 4, "prefill expects [b, s, n, d]"
+    b, s, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    if use_kernel and _kernel_ok(q, k_pool):
+        from megatron_llm_tpu.ops.pallas.paged_attention import (
+            paged_prefill_kernel,
+        )
+
+        return paged_prefill_kernel(
+            q, k_pool, v_pool, block_tables, start,
+            scale=scale, sliding_window=sliding_window,
+        )
+
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables)
+    kv_len = k_all.shape[1]
+    q_pos = start[:, None, None] + jnp.arange(s)[None, :, None]  # [b, s, 1]
+    kv_pos = jnp.arange(kv_len)[None, None, :]
+    allowed = kv_pos <= q_pos
+    if sliding_window is not None:
+        allowed &= q_pos - kv_pos < sliding_window
+    bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)
+    return attn_ops.xla_attention(
+        q, k_all, v_all, bias=bias[:, None, :, :], scale=scale)
 
 
 def _kernel_ok(q: jax.Array, k_pool: jax.Array) -> bool:

@@ -1,7 +1,9 @@
-"""Pallas TPU paged-attention decode kernel (Ragged Paged Attention style).
+"""Pallas TPU paged-attention kernels (Ragged Paged Attention style).
 
-One decode step for a batch of sequences whose KV lives in a shared page
-pool, addressed through per-sequence block tables.  The dense-cache decode
+Two kernels over the same layout: one DECODE step for a batch of sequences
+whose KV lives in a shared page pool, and one PREFILL CHUNK (s query rows
+of one sequence against its block-tabled prefix — the prefix-cache engine's
+prefill-against-block-table mode, ISSUE 5).  The dense-cache decode
 attention reads a contiguous [b, max_seq] cache; here the block table is a
 *scalar-prefetch* operand (pltpu.PrefetchScalarGridSpec), so the BlockSpec
 index map resolves ``page_id = block_table[seq, j]`` before the grid step
@@ -101,6 +103,143 @@ def _decode_kernel(
         l = l_s[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    bt_ref,      # [b, kv_pages] int32 block tables (chunk horizon)
+    pos_ref,     # [b] int32 position of the chunk's first query
+    # tensor refs
+    q_ref,       # block [1, 1, s*g, d] — chunk queries, kv-head-major
+    k_ref,       # block [1, page, 1, d]
+    v_ref,       # block [1, page, 1, d]
+    o_ref,       # block [1, 1, s*g, d]
+    # scratch
+    m_s,         # [s*g, 1] fp32 running max
+    l_s,         # [s*g, 1] fp32 normalizer
+    acc_s,       # [s*g, d] fp32 accumulator
+    *,
+    scale: float,
+    page_size: int,
+    group: int,
+    sliding_window: Optional[int],
+):
+    """Chunked-prefill sibling of :func:`_decode_kernel`: same grid layout
+    and online-softmax page loop, but ``s*group`` query rows per
+    (sequence, kv-head) pair, each at its own position ``pos0 + row//group``
+    — the causal mask is per ROW, not per sequence.  Pages past the LAST
+    query's position are skipped; rows whose own position is below a page
+    mask it off inside the page step."""
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    first = j * page_size
+    pos0 = pos_ref[i]
+    rows = q_ref.shape[2]
+    s_chunk = rows // group
+    last_pos = pos0 + s_chunk - 1
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    run = first <= last_pos
+    if sliding_window is not None:
+        # page entirely below every query row's window -> skip
+        run = jnp.logical_and(
+            run, first + page_size > pos0 - sliding_window + 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # [rows, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)       # [page, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rows, page]
+        kv_pos = first + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        q_pos = pos0 + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 0) // group
+        mask = kv_pos <= q_pos
+        if sliding_window is not None:
+            mask = jnp.logical_and(mask, q_pos - kv_pos < sliding_window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_s[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - m_cur[:, None]))
+        l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
+        m_s[:, 0] = m_cur
+        v = v_ref[0, :, 0, :].astype(jnp.float32)       # [page, d]
+        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_s[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_prefill_kernel(
+    q: jax.Array,             # [b, s, n_heads, d]
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    block_tables: jax.Array,  # [b, kv_pages] int32 (chunk horizon)
+    start: jax.Array,         # [b] int32 — position of q[:, 0]
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatch wrapper; returns [b, s, n_heads, d] in q's dtype."""
+    b, s, n, d = q.shape
+    num_pages, page_size, nkv, _ = k_pool.shape
+    assert n % nkv == 0
+    g = n // nkv
+    kv_pages = block_tables.shape[1]
+
+    # kv-head-major query rows: [b, nkv, s*g, d] so one grid step sees all
+    # of a kv head's query rows for the chunk
+    qg = q.reshape(b, s, nkv, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, nkv, s * g, d)
+    grid = (b, nkv, kv_pages)
+
+    kernel = functools.partial(
+        _prefill_kernel, scale=scale, page_size=page_size, group=g,
+        sliding_window=sliding_window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, s * g, d),
+                         lambda i, h, j, bt, pos: (i, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s * g, d),
+                               lambda i, h, j, bt, pos: (i, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s * g, 1), jnp.float32),
+            pltpu.VMEM((s * g, 1), jnp.float32),
+            pltpu.VMEM((s * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, s * g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), start.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, nkv, s, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, s, n, d)
 
 
 def paged_decode_kernel(

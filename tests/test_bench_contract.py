@@ -156,6 +156,42 @@ def test_engine_decode_bench_in_watch_jobs():
     assert bounded is False and pred is _bench_on_tpu
 
 
+def test_prefix_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode shared_prefix (ISSUE 5) reuses bench.py's
+    off-TPU contract: headline 0, the cache-on/off comparison (prefill
+    tokens, TTFT, hit rate) rides under cpu_sanity, TPU evidence goes to
+    its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_prefix_prefill_reduction_llama470m_c8_1chip",
+        "value": 7.0, "unit": "x", "backend": "cpu",
+        "ttft_mean_speedup": 1.7, "hit_rate": 0.92,
+        "rows": [{"concurrency": 8, "prefill_token_reduction": 7.0,
+                  "reduction_ok": True,
+                  "cache_on": {"prefill_tokens_computed": 128},
+                  "cache_off": {"prefill_tokens_computed": 896}}],
+    }, tag="engine_decode_prefix")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["ttft_mean_speedup"] == 1.7
+    assert line["cpu_sanity"]["rows"][0]["reduction_ok"] is True
+    bench.persist_tpu_result({"metric": "engine_prefix", "value": 4.2,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_prefix")
+    assert bench.load_last_tpu(tag="engine_decode_prefix")["value"] == 4.2
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_prefix_bench_in_watch_jobs():
+    """ISSUE 5: the shared-prefix decode bench is in the tunnel-up capture
+    list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_prefix" in by_name
+    cmd, bounded, pred = by_name["bench_decode_prefix"]
+    assert "--mode" in cmd and "shared_prefix" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
 def test_resilience_smoke_in_watch_jobs():
     """ISSUE 3: the resilience chaos smoke is in the tunnel-up capture
     list.  Unlike the bench jobs it IS bounded by --job_timeout: its

@@ -198,3 +198,62 @@ def test_batching_server_health_endpoint(batching_server):
         info = json.loads(resp.read())
     assert info["status"] == "ok" and info["batching"] is True
     assert info["free_pages"] == info["total_pages"]  # idle between tests
+
+
+def test_batching_server_health_reports_cache_and_queue(batching_server):
+    """ISSUE 5: /health carries prefix-cache occupancy and queue depth."""
+    url, engine = batching_server
+    with urllib.request.urlopen(url + "/health") as resp:
+        info = json.loads(resp.read())
+    for field in ("pages_cached", "available_pages", "prefix_hit_tokens",
+                  "prefix_miss_tokens", "queued", "prefilling"):
+        assert field in info, f"missing {field}"
+    assert info["pages_cached"] == len(engine.pool.cached)
+    assert info["available_pages"] >= info["free_pages"]
+
+
+def test_server_queue_overflow_returns_503_with_retry_after():
+    """ISSUE 5: backpressure is a structured JSON 503 with a Retry-After
+    header, not an unbounded queue."""
+    from megatron_llm_tpu.generation.engine import EngineOverloaded
+    from megatron_llm_tpu.generation.server import MegatronServer
+
+    class StuffedEngine:
+        """Duck-typed batching engine whose queue is at capacity."""
+
+        def submit(self, *a, **kw):
+            raise EngineOverloaded("request queue full (2 waiting)",
+                                   retry_after=3.0)
+
+        def generate_and_post_process(self, *a, **kw):
+            return self.submit()
+
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+    srv = MegatronServer(StuffedEngine())
+    code, body = srv.handle_request(
+        {"prompts": ["hi"], "tokens_to_generate": 4})
+    assert code == 503
+    assert "queue full" in body["error"] and body["retry_after"] == 3.0
+
+    port = srv.start_background(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api",
+            data=json.dumps({"prompts": ["hi"],
+                             "tokens_to_generate": 4}).encode(),
+            method="PUT")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers["Retry-After"] == "3"
+            payload = json.loads(e.read().decode())
+            assert "queue full" in payload["error"]
+    finally:
+        srv.stop()

@@ -573,7 +573,12 @@ def test_generation_server_metrics_endpoint():
         assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
         for field in ("mlt_engine_active_slots", "mlt_engine_max_slots",
                       "mlt_engine_queued_requests", "mlt_engine_free_pages",
-                      "mlt_engine_pool_pages"):
+                      "mlt_engine_pool_pages",
+                      # ISSUE 5: prefix-cache telemetry
+                      "mlt_engine_prefix_hit_tokens_total",
+                      "mlt_engine_prefix_miss_tokens_total",
+                      "mlt_engine_pages_cached",
+                      "mlt_engine_pages_cow_copies_total"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
         # /health still answers alongside

@@ -109,6 +109,12 @@ JOBS = [
     # serving-throughput headline (bench_decode.py, engine_decode evidence)
     ("engine_decode_bench", [sys.executable, "bench_decode.py"],
      False, _bench_on_tpu),
+    # ISSUE 5: prefix-cache shared-prompt workload — prefill tokens
+    # computed, TTFT and hit rate with the cache on vs off
+    # (bench_decode.py --mode shared_prefix, engine_decode_prefix evidence)
+    ("bench_decode_prefix",
+     [sys.executable, "bench_decode.py", "--mode", "shared_prefix"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
