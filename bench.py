@@ -145,6 +145,51 @@ def fail(reason: str, seq: int = 1024, **extra) -> None:
          "vs_baseline": 0.0, "error": reason, **extra}, seq))
 
 
+# Host-cost budgets for CPU-sanity evidence. The BENCH_r02-r05 trajectory
+# shows step time drifting 18.4s -> 25.3s -> 52.2s and compile 38s -> 100s
+# with nothing failing loudly (ROADMAP item 5 tail): these are deliberately
+# GENEROUS ceilings — a regression guard against unbounded host-side drift,
+# not a performance target.  A violated budget stamps ``error`` on the
+# contract line, which the tpu_watch evidence predicate already rejects.
+# Override per-run via MLT_BENCH_BUDGET_<FIELD> env vars (seconds).
+CPU_SANITY_BUDGETS = {
+    "compile_time_s": 180.0,
+    "step_time_s": 120.0,
+    "step_time_dispatch_s": 5.0,
+}
+
+
+def _budget(field: str) -> float:
+    env = os.environ.get("MLT_BENCH_BUDGET_" + field.upper())
+    return float(env) if env else CPU_SANITY_BUDGETS[field]
+
+
+def apply_budgets(line: dict, budgets: dict | None = None) -> dict:
+    """Annotate a contract line with compile/dispatch budget verdicts.
+
+    Reads the timing fields from ``cpu_sanity`` (or the line itself for
+    on-TPU lines), records ``budgets`` = {field: {value, budget}} for every
+    field present, and on any violation sets ``budget_exceeded`` AND
+    ``error`` so the failure is loud in CI/tpu_watch instead of a slow
+    upward drift across evidence files."""
+    caps = {k: _budget(k) for k in (budgets or CPU_SANITY_BUDGETS)}
+    src = line.get("cpu_sanity", line)
+    checked, violations = {}, []
+    for k, cap in caps.items():
+        v = src.get(k)
+        if v is None:
+            continue
+        checked[k] = {"value": v, "budget": cap}
+        if float(v) > cap:
+            violations.append(f"{k} {v} > budget {cap}")
+    if checked:
+        line["budgets"] = checked
+    if violations:
+        line["budget_exceeded"] = violations
+        line["error"] = "host-cost budget exceeded: " + "; ".join(violations)
+    return line
+
+
 def cpu_contract_line(result: dict, seq: int = 1024,
                       tag: str | None = None) -> dict:
     """Off-TPU contract shared by bench.py and tools/moe_bench.py: the
@@ -167,7 +212,7 @@ def cpu_contract_line(result: dict, seq: int = 1024,
                  "liveness check, last_measured_tpu is the evidence"),
         "cpu_sanity": sanity,
     })
-    return attach_last_tpu(line, seq, tag)
+    return apply_budgets(attach_last_tpu(line, seq, tag))
 
 
 def probe_backend(timeout_s: float = 120.0) -> str:

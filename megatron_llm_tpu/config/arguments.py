@@ -791,6 +791,29 @@ def _coerce(value: Any, default: Any) -> Any:
     return value
 
 
+# Short spellings for the mesh-layout flags (the Megatron-style names the
+# paper and ROADMAP use): --tp/--pp/--dp/--cp expand to the long dataclass
+# field flags before parsing, so both forms work everywhere.
+_PARALLEL_ALIASES = {
+    "--tp": "--tensor_model_parallel_size",
+    "--pp": "--pipeline_model_parallel_size",
+    "--dp": "--data_parallel_size",
+    "--cp": "--context_parallel_size",
+    "--ep": "--expert_parallel_size",
+}
+
+
+def _expand_parallel_aliases(argv: List[str]) -> List[str]:
+    out = []
+    for a in argv:
+        head, eq, tail = a.partition("=")
+        if head in _PARALLEL_ALIASES:
+            out.append(_PARALLEL_ALIASES[head] + (eq + tail if eq else ""))
+        else:
+            out.append(a)
+    return out
+
+
 def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         description="TPU-native Megatron-LLM", allow_abbrev=False
@@ -821,7 +844,9 @@ def parse_args(argv: Optional[List[str]] = None, extra_args_provider=None,
     (initialize.py:39): values applied before CLI overrides.
     """
     parser = build_parser(extra_args_provider)
-    ns, _unknown = parser.parse_known_args(sys.argv[1:] if argv is None else argv)
+    raw = sys.argv[1:] if argv is None else list(argv)
+    raw = _expand_parallel_aliases(raw)
+    ns, _unknown = parser.parse_known_args(raw)
     cfg = Config()
     if ns.model_name:
         apply_architecture(cfg, ns.model_name)

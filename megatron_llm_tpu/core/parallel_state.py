@@ -131,6 +131,12 @@ def build_mesh_from_config(cfg, devices=None) -> Mesh:
 def set_global_mesh(mesh: Mesh) -> None:
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh
+    # Layouts that reach partial-manual shard_map code (pp/cp) must compile
+    # under the shardy partitioner on jax 0.4.37 (parallel/compat.py);
+    # dp/ep/tp-only meshes stay on GSPMD (bitwise-stable pjit lowering).
+    from megatron_llm_tpu.parallel import compat
+
+    compat.enable_partitioner_for(mesh)
 
 
 def get_global_mesh() -> Mesh:
@@ -166,14 +172,18 @@ def target_platform() -> str:
 
 @contextlib.contextmanager
 def global_mesh(mesh: Mesh):
+    from megatron_llm_tpu.parallel import compat
+
     global _GLOBAL_MESH
     prev = _GLOBAL_MESH
+    prev_partitioner = compat.enable_partitioner_for(mesh)
     set_global_mesh(mesh)
     try:
         with mesh:
             yield mesh
     finally:
         _GLOBAL_MESH = prev
+        compat.restore_partitioner(prev_partitioner)
 
 
 def _axis_size(mesh: Mesh, axis: str) -> int:
@@ -211,7 +221,9 @@ def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
 
 def pipeline_stage_index() -> jax.Array:
     """Current pp-stage index; only valid inside shard_map over PP_AXIS."""
-    return jax.lax.axis_index(PP_AXIS)
+    from megatron_llm_tpu.parallel import compat
+
+    return compat.axis_index(PP_AXIS)
 
 
 def is_pipeline_first_stage() -> jax.Array:
@@ -219,4 +231,6 @@ def is_pipeline_first_stage() -> jax.Array:
 
 
 def is_pipeline_last_stage() -> jax.Array:
-    return pipeline_stage_index() == jax.lax.axis_size(PP_AXIS) - 1
+    from megatron_llm_tpu.parallel import compat
+
+    return pipeline_stage_index() == compat.axis_size(PP_AXIS) - 1

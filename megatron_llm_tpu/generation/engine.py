@@ -77,7 +77,9 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from megatron_llm_tpu.core.parallel_state import TP_AXIS
 from megatron_llm_tpu.generation import generation as gen
 from megatron_llm_tpu.generation.sampling import sample_per_slot
 from megatron_llm_tpu.observability import registry as obs_registry
@@ -127,13 +129,32 @@ class PagedKVPool:
       outruns the free list.
     """
 
-    def __init__(self, cfg, num_pages: int, page_size: int, dtype=None):
+    def __init__(self, cfg, num_pages: int, page_size: int, dtype=None,
+                 mesh: Optional[Mesh] = None):
         m = cfg.model
         dtype = dtype or _compute_dtype(cfg)
         shape = (m.num_layers, num_pages, page_size,
                  m.num_attention_heads_kv, m.kv_channels)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        # Tensor parallelism shards the pool over the KV-heads dim (each tp
+        # rank attends its own heads — the same decomposition as the qkv
+        # column-parallel rule in parallel/tp.py). Block tables and the
+        # allocator below stay host-side and apply to every shard alike;
+        # tp=1 (or no mesh) degrades to a single-device replicated pool.
+        self.mesh = mesh
+        tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+        if tp > 1:
+            assert m.num_attention_heads_kv % tp == 0, (
+                f"kv heads {m.num_attention_heads_kv} not divisible by "
+                f"tp {tp}")
+            self.kv_sharding = NamedSharding(
+                mesh, P(None, None, None, TP_AXIS, None))
+            self.k = jax.device_put(jnp.zeros(shape, dtype), self.kv_sharding)
+            self.v = jax.device_put(jnp.zeros(shape, dtype), self.kv_sharding)
+        else:
+            self.kv_sharding = (NamedSharding(mesh, P())
+                                if mesh is not None else None)
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
         self.num_pages = num_pages
         self.page_size = page_size
         self.refcounts = np.zeros((num_pages,), np.int32)
@@ -353,7 +374,8 @@ class ContinuousBatchingEngine:
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
                  page_watermark: Optional[int] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
         if inf.int8_weights:
@@ -361,6 +383,33 @@ class ContinuousBatchingEngine:
             from megatron_llm_tpu.ops.quant import quantize_layer_weights_int8
 
             params = quantize_layer_weights_int8(params)
+        # Tensor-parallel serving: params shard by the parallel/tp.py rules
+        # (qkv/lm_head column-parallel, dense/fc2 row-parallel, vocab-
+        # parallel embedding), the KV pool shards over the heads dim, and
+        # every jitted program (tick / prefill chunk / page copy) follows
+        # its committed input shardings — XLA inserts the row-parallel
+        # all-reduces. mesh=None (or an all-1 mesh) is today's single-chip
+        # engine, byte for byte.
+        self.mesh = mesh
+        self._tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+        if mesh is not None:
+            from megatron_llm_tpu.parallel.tp import param_shardings
+
+            m = cfg.model
+            if self._tp > 1:
+                from megatron_llm_tpu.models.language_model import (
+                    padded_vocab_size,
+                )
+
+                assert m.num_attention_heads % self._tp == 0, (
+                    f"attention heads {m.num_attention_heads} not divisible "
+                    f"by tp {self._tp}")
+                assert padded_vocab_size(m.vocab_size, cfg) % self._tp == 0, (
+                    "padded vocab not divisible by tp")
+            params = jax.device_put(params, param_shardings(mesh, params))
+            self._repl = NamedSharding(mesh, P())
+        else:
+            self._repl = None
         self.params = params
         self.tokenizer = tokenizer
         self.max_slots = max_slots or inf.max_batch_slots
@@ -386,7 +435,7 @@ class ContinuousBatchingEngine:
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
-        self.pool = PagedKVPool(cfg, num_pages, self.page_size)
+        self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh)
         # the prefix cache needs the block-table prefill path: a monolithic
         # dense prefill recomputes and rewrites the whole prompt, shared
         # pages included
@@ -469,6 +518,27 @@ class ContinuousBatchingEngine:
         reg.gauge("mlt_engine_pool_pages",
                   help="allocatable KV pool pages (null page excluded)"
                   ).set(self.pool.num_pages - 1)
+        if mesh is not None:
+            for ax, size in dict(mesh.shape).items():
+                reg.gauge("mlt_mesh_axis_size", help="mesh axis size",
+                          labels={"axis": str(ax)}).set(size)
+
+    def _asarray(self, x):
+        """Host -> device for tick/prefill operands: mesh-replicated when a
+        mesh is active (slot vectors, block tables, token rows are identical
+        on every shard), plain asarray otherwise."""
+        a = jnp.asarray(x)
+        if self._repl is not None:
+            a = jax.device_put(a, self._repl)
+        return a
+
+    @property
+    def _mesh_statics(self) -> Tuple:
+        """Compiled-program cache key extension: engines on different mesh
+        layouts must not share executables (gen.cached_jit is process-wide)."""
+        if self.mesh is None:
+            return ("mesh", None)
+        return ("mesh", tuple(sorted(dict(self.mesh.shape).items())))
 
     # -- compiled programs -------------------------------------------------
 
@@ -481,15 +551,22 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         m = cfg.model
 
+        # scope name carries the tp degree: the row-parallel all-reduces
+        # GSPMD inserts under a tp>1 mesh inherit it in HLO op metadata,
+        # so device profiles attribute them to the decode forward
+        scope = ("decode-fwd" if self._tp == 1
+                 else f"decode-fwd-tp{self._tp}")
+
         def tick(params, pool_k, pool_v, block_tables, positions, tokens,
                  req_keys, steps, temperature, top_k, top_p):
             rope = make_rope_cache(cfg)
-            logits, (pool_k, pool_v) = model_forward(
-                cfg, params, tokens[:, None],
-                position_ids=positions[:, None],
-                rope_cache=rope, kv_caches=(pool_k, pool_v),
-                paged=PagedState(block_tables, positions),
-            )
+            with jax.named_scope(scope):
+                logits, (pool_k, pool_v) = model_forward(
+                    cfg, params, tokens[:, None],
+                    position_ids=positions[:, None],
+                    rope_cache=rope, kv_caches=(pool_k, pool_v),
+                    paged=PagedState(block_tables, positions),
+                )
             last = logits[:, -1]
             keys = jax.vmap(jax.random.fold_in)(req_keys, steps)
             next_tok = sample_per_slot(
@@ -503,7 +580,8 @@ class ContinuousBatchingEngine:
                     positions + 1, steps + 1)
 
         statics = ("engine_tick", self.max_slots, self.pages_per_seq,
-                   self.page_size, self.pool.num_pages, str(self.pool.k.dtype))
+                   self.page_size, self.pool.num_pages,
+                   str(self.pool.k.dtype), self._mesh_statics)
         self._tick_fn = gen.cached_jit(
             self.cfg, "engine_tick", statics, lambda: tick,
             donate_argnums=(1, 2))
@@ -543,7 +621,8 @@ class ContinuousBatchingEngine:
             return pool_k, pool_v
 
         statics = (s_pre, with_log_probs, self.page_size,
-                   self.pool.num_pages, str(self.pool.k.dtype))
+                   self.pool.num_pages, str(self.pool.k.dtype),
+                   self._mesh_statics)
         fn = gen.cached_jit(self.cfg, "engine_prefill", statics,
                             lambda: prefill, donate_argnums=(2, 3))
         self._prefill_fns[key] = fn
@@ -577,7 +656,7 @@ class ContinuousBatchingEngine:
 
         statics = ("engine_prefill_chunk", rows, kv_pages, with_log_probs,
                    self.page_size, self.pool.num_pages,
-                   str(self.pool.k.dtype))
+                   str(self.pool.k.dtype), self._mesh_statics)
         fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
                             lambda: chunk, donate_argnums=(4, 5))
         self._chunk_fns[key] = fn
@@ -595,7 +674,7 @@ class ContinuousBatchingEngine:
             return pool_k, pool_v
 
         statics = ("engine_copy_page", self.pool.num_pages, self.page_size,
-                   str(self.pool.k.dtype))
+                   str(self.pool.k.dtype), self._mesh_statics)
         self._copy_fn = gen.cached_jit(self.cfg, "engine_copy_page", statics,
                                        lambda: copy, donate_argnums=(0, 1))
         return self._copy_fn
@@ -733,7 +812,8 @@ class ContinuousBatchingEngine:
             # device copy OUTSIDE the lock (driver thread; serialized with
             # ticks via _drive_lock), then drop our ref on the shared page
             self.pool.k, self.pool.v = self._copy_page()(
-                self.pool.k, self.pool.v, jnp.int32(src), jnp.int32(dst))
+                self.pool.k, self.pool.v, self._asarray(np.int32(src)),
+                self._asarray(np.int32(dst)))
         with self._lock:
             if cow:
                 # block-table order: kept shared pages, the private COW
@@ -778,8 +858,8 @@ class ContinuousBatchingEngine:
         page_ids[:n] = pages[:n]
 
         out = self._prefill(s_pre, req.return_log_probs)(
-            self.params, jnp.asarray(tokens), self.pool.k, self.pool.v,
-            jnp.asarray(page_ids))
+            self.params, self._asarray(tokens), self.pool.k, self.pool.v,
+            self._asarray(page_ids))
         if req.return_log_probs:
             self.pool.k, self.pool.v, prompt_lp = out
             req.prompt_log_probs = [
@@ -909,12 +989,13 @@ class ContinuousBatchingEngine:
 
         try:
             with obs_trace.span("engine-prefill-chunk", start=start,
-                                rows=rows):
+                                rows=rows, tp=self._tp):
                 out = self._chunk_prefill(rows, kv_pages,
                                           req.return_log_probs)(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray([start], np.int32), jnp.asarray(bt),
-                    self.pool.k, self.pool.v, jnp.asarray(targets))
+                    self.params, self._asarray(tokens),
+                    self._asarray(np.asarray([start], np.int32)),
+                    self._asarray(bt),
+                    self.pool.k, self.pool.v, self._asarray(targets))
             if req.return_log_probs:
                 self.pool.k, self.pool.v, lp = out
                 if req.prompt_log_probs is None:
@@ -988,18 +1069,19 @@ class ContinuousBatchingEngine:
             if not active:
                 return did_prefill
             if self._dirty:
-                self._dev_state = (jnp.asarray(self._block_tables),
-                                   jnp.asarray(self._positions),
-                                   jnp.asarray(self._tokens),
-                                   jnp.asarray(self._keys),
-                                   jnp.asarray(self._steps),
-                                   jnp.asarray(self._temperature),
-                                   jnp.asarray(self._top_k),
-                                   jnp.asarray(self._top_p))
+                self._dev_state = (self._asarray(self._block_tables),
+                                   self._asarray(self._positions),
+                                   self._asarray(self._tokens),
+                                   self._asarray(self._keys),
+                                   self._asarray(self._steps),
+                                   self._asarray(self._temperature),
+                                   self._asarray(self._top_k),
+                                   self._asarray(self._top_p))
                 self._dirty = False
             bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
 
-        with obs_trace.span("engine-tick", active=len(active)):
+        with obs_trace.span("engine-tick", active=len(active),
+                            tp=self._tp):
             (self.pool.k, self.pool.v, next_tok, logp,
              new_pos, new_steps) = self._tick()(
                 self.params, self.pool.k, self.pool.v,

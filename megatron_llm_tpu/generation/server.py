@@ -276,6 +276,10 @@ class MegatronServer:
                     prefix_miss_tokens=eng.prefix_miss_tokens,
                     ticks=eng.ticks,
                 )
+            mesh = getattr(eng, "mesh", None)
+            info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}
+                            if mesh is not None else {})
+            info["tp"] = getattr(eng, "_tp", 1)
         return info
 
     def metrics_text(self) -> str:

@@ -60,7 +60,9 @@ def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
         return flash_attention(q, k, v, segment_ids=segment_ids, **kwargs)
 
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from megatron_llm_tpu.parallel import compat
+    from megatron_llm_tpu.parallel.compat import shard_map
 
     # Nested-manual composition: called from inside an enclosing shard_map
     # (the pipeline engine manualizes pp/cp), the inner shard_map must bind
@@ -70,7 +72,7 @@ def _flash_sharded(q, k, v, segment_ids, scale, sliding_window, block_q,
     # Manualize every axis not already manual in the enclosing context:
     # Mosaic kernels reject being left under ANY auto axis (even size-1),
     # and an enclosing pipeline shard_map has already manualized pp/cp.
-    abstract = jax.sharding.get_abstract_mesh()
+    abstract = compat.get_abstract_mesh()
     if abstract is not None and not abstract.empty and abstract.manual_axes:
         mesh = abstract
         names = set(mesh.axis_names) - set(mesh.manual_axes)

@@ -36,10 +36,12 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.parallel import compat
+from megatron_llm_tpu.parallel.compat import shard_map
 from megatron_llm_tpu.ops.attention import NEG_INF
 
 # Row-blocking of the ring online softmax (see _ring_attention_local):
@@ -217,7 +219,7 @@ def _flash_ring_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq, bkv,
     ppermute does not have that problem — it stays inside."""
     from megatron_llm_tpu.ops.pallas.flash_attention import _fwd
 
-    cp = lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     b, n, s, d = qh.shape
     perm = _ring_perm(cp)
 
@@ -285,7 +287,7 @@ def _flash_ring_bwd(scale, causal, bq, bkv, interpret, axis_name,
     from megatron_llm_tpu.ops.pallas.flash_attention import _bwd
 
     qh, kh, vh, sq3, skv3, i, out, lse = residuals
-    cp = lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     perm = _ring_perm(cp)
     # delta = rowsum(do * o) is loop-invariant — computed ONCE here (XLA
     # cannot CSE across scan iterations; recomputing it per ring step would
@@ -378,7 +380,7 @@ def _flash_ring_zz_fwd_impl(qh, kh, vh, sq3, skv3, i, scale, causal, bq,
     from megatron_llm_tpu.ops.pallas.flash_attention import _fwd
 
     assert causal, "striped ring is causal-only (see module note)"
-    cp = lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     b, n, s, d = qh.shape
     c = s // 2
     perm = _ring_perm(cp)
@@ -458,7 +460,7 @@ def _flash_ring_zz_bwd(scale, causal, bq, bkv, interpret, axis_name,
     from megatron_llm_tpu.ops.pallas.flash_attention import _bwd
 
     qh, kh, vh, sq3, skv3, i, out, lse = residuals
-    cp = lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     b, n, s, d = qh.shape
     nkv = kh.shape[1]
     c = s // 2
@@ -566,7 +568,7 @@ def _ring_attention_flash(q, k, v, seg_q, seg_kv, *, axis_name, scale,
     whole ring loop (kernels + ppermutes; cp stays bound from the outer
     context) nests one shard_map over the rest, batch on (dp, ep), heads
     on tp (same composition as ops/attention._flash_sharded)."""
-    abstract = jax.sharding.get_abstract_mesh()
+    abstract = compat.get_abstract_mesh()
     auto = set()
     if abstract is not None and not abstract.empty and abstract.manual_axes:
         auto = set(abstract.axis_names) - set(abstract.manual_axes)
@@ -575,7 +577,7 @@ def _ring_attention_flash(q, k, v, seg_q, seg_kv, *, axis_name, scale,
     # the cp coordinate is computed HERE — where the caller's context binds
     # cp — and passed in: lax.axis_index emitted inside the nested
     # shard_map would double-bind the axis (sdy verifier error)
-    i = lax.axis_index(axis_name)
+    i = compat.axis_index(axis_name)
     if not auto:
         return _ring_attention_flash_core(q, k, v, seg_q, seg_kv, i, **kw)
     qs = P(ps.DATA_AXES, None, ps.TP_AXIS, None)
@@ -614,7 +616,7 @@ def _ring_attention_local(
     causal: bool,
     sliding_window: Optional[int],
 ) -> jax.Array:
-    cp = lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     b, sq, n, d = q.shape
     nkv = k.shape[2]
     g = n // nkv
@@ -718,7 +720,7 @@ def _local_indices(token_idx: Optional[jax.Array], s_local: int, axis_name: str)
     """Global token indices of this device's chunk (contiguous by default)."""
     if token_idx is not None:
         return token_idx
-    return lax.axis_index(axis_name) * s_local + jnp.arange(s_local)
+    return compat.axis_index(axis_name) * s_local + jnp.arange(s_local)
 
 
 # ---------------------------------------------------------------------------
@@ -791,7 +793,7 @@ def _dispatch_local(q, k, v, seg, tok, *, axis_name, scale, causal,
 
 def cp_is_manual() -> bool:
     """True when tracing inside a shard_map that already binds the cp axis."""
-    abstract = jax.sharding.get_abstract_mesh()
+    abstract = compat.get_abstract_mesh()
     return (
         abstract is not None
         and not abstract.empty

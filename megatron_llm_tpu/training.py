@@ -375,6 +375,11 @@ def pretrain(
 
     initialize_distributed()  # no-op single-host; pod autodetect multi-host
     mesh = build_mesh_from_config(cfg)
+    print0(f"mesh: {dict(mesh.shape)}")
+    for _ax, _size in dict(mesh.shape).items():
+        registry_mod.get_registry().gauge(
+            "mlt_mesh_axis_size", help="mesh axis size",
+            labels={"axis": str(_ax)}).set(_size)
     tokenizer = None
     if cfg.data.tokenizer_type and (cfg.data.data_path or cfg.data.tokenizer_model
                                     or cfg.data.tokenizer_type == "NullTokenizer"):

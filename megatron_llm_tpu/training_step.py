@@ -101,11 +101,18 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
     if loss_fn is None:
         loss_fn = loss_from_batch
 
+    # Name the forward region by its tp degree: the column/row-parallel
+    # collectives GSPMD inserts inherit this scope in their HLO op
+    # metadata, so device profiles (observability/profiler.py) attribute
+    # the TP all-reduces to the forward instead of an anonymous fusion.
+    _tp_deg = (mesh.shape.get("tp", 1) if mesh is not None else 1)
+    _fwd_scope = "forward" if _tp_deg == 1 else f"forward-tp{_tp_deg}"
+
     def micro_loss(params, mb, dropout_key, rope):
         deterministic = (
             cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0
         ) or dropout_key is None
-        with jax.named_scope("forward"):
+        with jax.named_scope(_fwd_scope):
             return loss_fn(
                 cfg, params, mb,
                 dropout_key=dropout_key,

@@ -401,3 +401,107 @@ def test_e2e_staged_helpers(tmp_path):
     assert done_iters(str(tmp_path)) == 0
     (tmp_path / "latest_checkpointed_iteration.txt").write_text("junk")
     assert done_iters(str(tmp_path)) == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: host-cost budgets + the tp mesh bench
+# ---------------------------------------------------------------------------
+
+
+def test_budgets_annotate_within_limits(evidence_dir):
+    """A contract line whose compile/step/dispatch costs sit inside the
+    budgets gains the budgets block and NO error."""
+    line = bench.cpu_contract_line({
+        "metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+        "compile_time_s": 40.0, "step_time_s": 20.0,
+        "step_time_dispatch_s": 0.1,
+    })
+    assert "error" not in line
+    assert line["budgets"]["compile_time_s"]["value"] == 40.0
+    assert line["budgets"]["compile_time_s"]["budget"] == 180.0
+    assert line["budgets"]["step_time_s"]["budget"] == 120.0
+
+
+def test_budgets_fail_loudly_on_drift(evidence_dir):
+    """The BENCH_r02-r05 drift shape (compile 38s -> 100s -> beyond) must
+    flip the line to an error the watch predicate rejects — no more silent
+    upward creep across evidence files."""
+    line = bench.cpu_contract_line({
+        "metric": "m", "value": 1.0, "unit": "x", "backend": "cpu",
+        "compile_time_s": 500.0, "step_time_s": 20.0,
+    })
+    assert "budget exceeded" in line["error"]
+    assert any("compile_time_s" in v for v in line["budget_exceeded"])
+    # an error line is not TPU evidence
+    assert not _bench_on_tpu(json.dumps(line))
+
+
+def test_budgets_env_override(evidence_dir, monkeypatch):
+    monkeypatch.setenv("MLT_BENCH_BUDGET_STEP_TIME_S", "1.0")
+    line = bench.apply_budgets({"cpu_sanity": {"step_time_s": 2.0},
+                                "metric": "m"})
+    assert "error" in line and "step_time_s" in line["error"]
+
+
+def test_budgets_skip_missing_fields(evidence_dir):
+    """Benches that don't report a field aren't judged on it."""
+    line = bench.apply_budgets({"cpu_sanity": {"hit_rate": 0.9},
+                                "metric": "m"})
+    assert "error" not in line and "budgets" not in line
+
+
+def test_tp_bench_cpu_contract(evidence_dir):
+    """bench_tp.py rides the same off-TPU contract: headline 0, per-layout
+    mechanism checks under cpu_sanity, budget fields populated from the
+    largest layout, tagged TPU evidence file."""
+    line = bench.cpu_contract_line({
+        "metric": "tp_mesh_train_steps_s", "value": 25.9, "unit": "steps/s",
+        "backend": "cpu",
+        "layouts": [
+            {"tp": 1, "all_reduce_count": 0, "loss": 6.1},
+            {"tp": 4, "all_reduce_count": 67, "loss": 6.1},
+        ],
+        "loss_parity_vs_tp1": {"tp4_loss_delta": 0.0},
+        "engine_tokens_match_tp1": True,
+        "step_time_s": 0.04, "step_time_dispatch_s": 0.04,
+        "compile_time_s": 2.0,
+    }, tag="tp")
+    assert line["value"] == 0.0
+    assert line["cpu_sanity"]["layouts"][1]["all_reduce_count"] > 0
+    assert line["budgets"]["compile_time_s"]["value"] == 2.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "tp_mesh_train_steps_s",
+                              "value": 12.0, "backend": "tpu"}, {}, tag="tp")
+    assert bench.load_last_tpu(tag="tp")["value"] == 12.0
+    assert bench.load_last_tpu() is None
+
+
+def test_tp_bench_in_watch_jobs():
+    """ISSUE 6: the tp mesh bench is in the tunnel-up capture list."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_tp" in by_name
+    cmd, bounded, pred = by_name["bench_tp"]
+    assert "bench_tp.py" in cmd[1]
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_tp_bench_committed_cpu_evidence():
+    """The CPU-sanity evidence JSON is committed with the budget fields
+    populated (ISSUE 6 acceptance)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_tp_cpu_sanity.json")
+    with open(path) as f:
+        line = json.load(f)
+    assert line["metric"] == "tp_mesh_train_steps_s"
+    assert line["value"] == 0.0  # CPU headline contract
+    assert "error" not in line
+    for field in ("compile_time_s", "step_time_s", "step_time_dispatch_s"):
+        assert field in line["budgets"], field
+    sanity = line["cpu_sanity"]
+    by_tp = {r["tp"]: r for r in sanity["layouts"] if "skipped" not in r}
+    assert by_tp[4]["all_reduce_count"] > 0
+    assert by_tp[1]["all_reduce_count"] == 0
+    assert sanity["loss_parity_vs_tp1"]["tp4_loss_delta"] <= 1e-4
+    assert sanity["engine_tokens_match_tp1"] is True

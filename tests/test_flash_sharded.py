@@ -25,6 +25,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.parallel import compat
 from megatron_llm_tpu.ops.attention import _flash_sharded, xla_attention
 from megatron_llm_tpu.ops.attention import make_attention_bias
 
@@ -106,7 +107,7 @@ def test_flash_nested_manual_parity(eight_devices):
             perm = [(i, i) for i in range(2)]
             return jax.lax.ppermute(o, ps.PP_AXIS, perm)
 
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             body, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
             axis_names={ps.PP_AXIS, ps.CP_AXIS}, check_vma=False)
 
@@ -141,7 +142,7 @@ def test_flash_nested_manual_sliding_window(eight_devices):
     q, k, v = _qkv(jax.random.PRNGKey(2), b=2, s=256, n=4, nkv=4)
 
     with ps.global_mesh(mesh), mesh:
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             lambda q_, k_, v_: _flash_sharded(
                 q_, k_, v_, None, 1.0 / 8.0, 64, 128, 128),
             mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),

@@ -18,6 +18,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.parallel import compat
 from megatron_llm_tpu.ops.attention import make_attention_bias, xla_attention
 from megatron_llm_tpu.parallel.ring import _ring_attention_flash
 
@@ -36,7 +37,7 @@ def _run_ring_flash(mesh, cp, q, k, v, seg=None, causal=True):
     segs = P(None, "cp")
 
     if seg is None:
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             lambda q_, k_, v_: _ring_attention_flash(
                 q_, k_, v_, None, None, axis_name=ps.CP_AXIS, scale=scale,
                 causal=causal, interpret=True),
@@ -50,7 +51,7 @@ def _run_ring_flash(mesh, cp, q, k, v, seg=None, causal=True):
         return jax.jit(jax.value_and_grad(
             loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         lambda q_, k_, v_, s_: _ring_attention_flash(
             q_, k_, v_, s_, s_, axis_name=ps.CP_AXIS, scale=scale,
             causal=causal, interpret=True),
@@ -157,7 +158,7 @@ def test_ring_flash_striped_zigzag(eight_devices, cp, segmented):
 
     with ps.global_mesh(mesh), mesh:
         if segp is None:
-            fn = jax.shard_map(
+            fn = compat.shard_map(
                 lambda q_, k_, v_: _ring_attention_flash(
                     q_, k_, v_, None, None, axis_name=ps.CP_AXIS,
                     scale=scale, causal=True, interpret=True, striped=True),
@@ -168,7 +169,7 @@ def test_ring_flash_striped_zigzag(eight_devices, cp, segmented):
                 o = fn(q_, k_, v_)
                 return (o.astype(jnp.float32) ** 2).sum(), o
         else:
-            fn = jax.shard_map(
+            fn = compat.shard_map(
                 lambda q_, k_, v_, s_: _ring_attention_flash(
                     q_, k_, v_, s_, s_, axis_name=ps.CP_AXIS,
                     scale=scale, causal=True, interpret=True, striped=True),

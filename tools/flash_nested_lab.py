@@ -37,6 +37,7 @@ def run_one(strategy: str) -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from megatron_llm_tpu.core.parallel_state import global_mesh
+    from megatron_llm_tpu.parallel import compat
     from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
 
     topo = topologies.get_topology_desc("v5e:2x4", "tpu")
@@ -58,9 +59,9 @@ def run_one(strategy: str) -> None:
         """The inner dispatch, from inside the {pp}-manual context."""
         kwargs = dict(causal=True, scale=0.125)
         if strategy in ("baseline", "reorder"):
-            return jax.shard_map(
+            return compat.shard_map(
                 lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
-                mesh=jax.sharding.get_abstract_mesh(),
+                mesh=compat.get_abstract_mesh(),
                 in_specs=(qs, qs, qs), out_specs=qs,
                 axis_names={"dp", "ep", "tp"}, check_vma=False,
             )(q, k, v)
@@ -71,15 +72,15 @@ def run_one(strategy: str) -> None:
             second = {"dp", "ep"} if strategy == "split" else {"tp"}
 
             def outer(q_, k_, v_):
-                return jax.shard_map(
+                return compat.shard_map(
                     lambda q2, k2, v2: flash_attention(q2, k2, v2, **kwargs),
-                    mesh=jax.sharding.get_abstract_mesh(),
+                    mesh=compat.get_abstract_mesh(),
                     in_specs=(second_spec,) * 3, out_specs=second_spec,
                     axis_names=second, check_vma=False,
                 )(q_, k_, v_)
 
-            return jax.shard_map(
-                outer, mesh=jax.sharding.get_abstract_mesh(),
+            return compat.shard_map(
+                outer, mesh=compat.get_abstract_mesh(),
                 in_specs=(first_spec,) * 3, out_specs=first_spec,
                 axis_names=first, check_vma=False,
             )(q, k, v)
@@ -117,7 +118,7 @@ def run_one(strategy: str) -> None:
         return x + acc.astype(x.dtype)
 
     def step(q, k, v):
-        out = jax.shard_map(
+        out = compat.shard_map(
             pipe_body, mesh=mesh,
             in_specs=(P(), P(), P()), out_specs=P(),
             axis_names={"pp", "cp"}, check_vma=False,

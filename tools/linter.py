@@ -8,6 +8,14 @@ uncomment away).  Observability instruments the async training loop's
 overlap; an instrument that syncs the device destroys the thing it
 measures, and the PR-2 bitwise-loss guarantee with it.
 
+Plus the shard_map import rule: the pinned jax 0.4.37 has no
+``jax.shard_map`` (only ``jax.experimental.shard_map`` with a different
+signature), so every module must import shard_map (and get_abstract_mesh /
+axis_index) from ``megatron_llm_tpu/parallel/compat.py`` — the one module
+allowed to touch jax's own spellings.  A direct import compiles fine on
+newer jax and breaks the pinned container, which is exactly how the
+original 8-failure gap regressed in.
+
     python tools/linter.py megatron_llm_tpu tools tasks tests
 """
 
@@ -22,6 +30,16 @@ TODO_RE = re.compile(r"#\s*TODO(?!\()")
 # matches the attribute names however they are reached (jax.device_get,
 # a bare import, x.block_until_ready(), or a string that smuggles one in)
 DEVICE_SYNC_RE = re.compile(r"device_get|block_until_ready")
+# direct jax shard_map spellings (code only — comments/docstrings may
+# discuss them): jax.shard_map, from jax import shard_map,
+# jax.experimental.shard_map in any form.  parallel/compat.py is exempt.
+SHARD_MAP_RE = re.compile(
+    r"jax\s*\.\s*shard_map"
+    r"|from\s+jax\s+import\s+[^\n]*\bshard_map\b"
+    r"|jax\s*\.\s*experimental\s*\.\s*shard_map"
+    r"|from\s+jax\s*\.\s*experimental(\s*\.\s*|\s+import\s+)[^\n]*shard_map"
+    r"|jax\s*\.\s*sharding\s*\.\s*get_abstract_mesh"
+)
 
 
 def _in_observability(path: str) -> bool:
@@ -29,9 +47,24 @@ def _in_observability(path: str) -> bool:
         os.sep)
 
 
+def _is_compat(path: str) -> bool:
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    # compat.py implements the rule; the linter itself describes it
+    return (parts[-2:] == ["parallel", "compat.py"]
+            or parts[-2:] == ["tools", "linter.py"])
+
+
+def _strip_comment(line: str) -> str:
+    # good enough for a line-based linter: drop an inline # comment (the
+    # rule targets code; '#' inside strings is rare in this codebase and
+    # a false NEGATIVE there only relaxes the rule for prose)
+    return line.split("#", 1)[0]
+
+
 def lint_file(path: str) -> int:
     issues = 0
     no_sync = _in_observability(path)
+    check_shard_map = not _is_compat(path)
     with open(path, encoding="utf-8", errors="replace") as f:
         for lineno, line in enumerate(f, 1):
             stripped = line.rstrip("\n")
@@ -51,6 +84,12 @@ def lint_file(path: str) -> int:
                 print(f"{path}:{lineno}: device sync in observability/ — "
                       f"instruments must never sync the device "
                       f"(megatron_llm_tpu/observability/__init__.py)")
+                issues += 1
+            if check_shard_map and SHARD_MAP_RE.search(
+                    _strip_comment(stripped)):
+                print(f"{path}:{lineno}: direct jax shard_map import/use — "
+                      f"go through megatron_llm_tpu/parallel/compat.py "
+                      f"(jax 0.4.37 has no jax.shard_map; see that module)")
                 issues += 1
     return issues
 

@@ -77,7 +77,26 @@ def main():
     if args.legacy_engine:
         engine = InferenceEngine(cfg, params, tokenizer)
     else:
-        engine = ContinuousBatchingEngine(cfg, params, tokenizer)
+        # --tp N (--tensor_model_parallel_size) shards the engine over a
+        # named mesh: params by the parallel/tp.py rules, the KV pool over
+        # the heads dim — one engine then serves a model larger than a
+        # single chip's HBM. tp=1 keeps the single-chip engine unchanged.
+        mesh = None
+        if cfg.parallel.tensor_model_parallel_size > 1:
+            from megatron_llm_tpu.core.parallel_state import (
+                build_mesh, set_global_mesh,
+            )
+
+            assert cfg.parallel.pipeline_model_parallel_size == 1, (
+                "serving supports tensor parallelism only (pp must be 1)")
+            mesh = build_mesh(
+                tensor_model_parallel_size=(
+                    cfg.parallel.tensor_model_parallel_size),
+                data_parallel_size=1,
+            )
+            set_global_mesh(mesh)
+            print(f"engine mesh: {dict(mesh.shape)}", flush=True)
+        engine = ContinuousBatchingEngine(cfg, params, tokenizer, mesh=mesh)
     server = MegatronServer(engine)
     kind = "legacy" if args.legacy_engine else "continuous-batching"
     print(f"serving ({kind}) on http://{args.host}:{args.port}/api",

@@ -126,6 +126,13 @@ JOBS = [
     # BENCH_LAST_TPU_observability.json)
     ("bench_observability", [sys.executable, "bench_observability.py"],
      False, _bench_on_tpu),
+    # ISSUE 6: tensor-parallel mesh — train-step steps/sec per tp layout
+    # with sharded-param/collective/loss-parity mechanism checks and engine
+    # decode-token parity; CPU hosts run it as a host-device-count sanity
+    # mode (own watchdog, bench contract with host-cost budgets; evidence
+    # in BENCH_LAST_TPU_tp.json, CPU record in BENCH_tp_cpu_sanity.json)
+    ("bench_tp", [sys.executable, "bench_tp.py"],
+     False, _bench_on_tpu),
     # ISSUE 3: resilience chaos smoke — kill-9/corrupt/hang round-trips on
     # CPU (mid-step kills would wedge the tunnel) + an integrity/resume
     # round-trip on TPU for the evidence line. Its children carry their own
