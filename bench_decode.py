@@ -22,6 +22,15 @@ Two modes:
   arrivals do not dedup in-flight prefills — admission only matches pages
   already cached — which is why the cache is warmed first.)
 
+* ``--mode slo`` (ISSUE 7): mixed-priority overload — batch requests
+  (priority 2, long generations, no deadline) fill every slot, then
+  interactive requests (priority 0, TTFT deadline) arrive.  The same
+  traffic runs through the ``fcfs``, ``priority``, and ``slo`` scheduling
+  policies (generation/scheduling/); each row reports per-class p50/p99
+  TTFT, deadline-miss rate, preemption and shed counts.  Headline:
+  high-priority p99 TTFT speedup of ``slo`` over ``fcfs`` (priority-class
+  reordering + preemption-by-page-release).  Gate: >= 2x.
+
 Same tunnel-hardening contract as bench.py: backend probed in a bounded
 subprocess; off-TPU the headline is 0 with the run riding under
 ``cpu_sanity`` (a CPU timing is not a TPU measurement); TPU measurements
@@ -49,6 +58,7 @@ from bench import (  # noqa: E402
 
 METRIC = "engine_decode_tok_s_llama470m_c8_1chip"
 METRIC_PREFIX = "engine_prefix_prefill_reduction_llama470m_c8_1chip"
+METRIC_SLO = "engine_slo_hi_p99_ttft_speedup_llama470m_1chip"
 
 
 def _requests(num: int, prompt: int, gen: int, vocab: int, seed: int = 0):
@@ -197,20 +207,128 @@ def bench_shared_prefix(cfg, params, concurrency: int, shared_len: int,
     }
 
 
+def _percentile(xs, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(xs, np.float64), q))
+
+
+def bench_slo(cfg, params, slots: int, n_hi: int, n_lo: int,
+              prompt_len: int, gen_hi: int, gen_lo: int, vocab: int,
+              ttft_slo_ms: float) -> dict:
+    """Mixed-priority overload through each scheduling policy.
+
+    Batch traffic (priority 2, ``gen_lo`` tokens, no deadline) is
+    submitted first and driven until every slot is decoding — the
+    overload steady state — then the interactive burst (priority 0,
+    ``gen_hi`` tokens, ``ttft_slo_ms`` TTFT deadline) arrives.  fcfs
+    makes the burst wait behind the whole batch backlog; priority/slo
+    reorder admission and preempt batch decoders by page release, so the
+    burst's TTFT stops scaling with the backlog."""
+    import time
+
+    import numpy as np
+
+    from megatron_llm_tpu.generation import (
+        ContinuousBatchingEngine,
+        RequestShed,
+    )
+
+    rng = np.random.default_rng(7)
+    lo_prompts = [[int(t) for t in rng.integers(1, vocab, prompt_len)]
+                  for _ in range(n_lo)]
+    hi_prompts = [[int(t) for t in rng.integers(1, vocab, prompt_len)]
+                  for _ in range(n_hi)]
+    kw = dict(top_k=1, termination_id=0, use_eod_for_termination=False)
+
+    def run(policy: str) -> dict:
+        eng = ContinuousBatchingEngine(
+            cfg, params, None, max_slots=slots,
+            max_seq=prompt_len + max(gen_hi, gen_lo),
+            sched_policy=policy)
+        lo = [eng.submit(p, gen_lo, priority=2, seed=i, **kw)
+              for i, p in enumerate(lo_prompts)]
+        # drive until every slot decodes batch traffic (true overload)
+        while sum(r._t_first > 0 for r in lo) < min(slots, n_lo):
+            eng.step()
+        hi = [eng.submit(p, gen_hi, priority=0,
+                         ttft_deadline_ms=ttft_slo_ms, seed=100 + i, **kw)
+              for i, p in enumerate(hi_prompts)]
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        wall = time.perf_counter() - t0
+        ticks = max(eng.ticks, 1)
+        shed = 0
+        for r in hi + lo:
+            try:
+                r.result(timeout=600)
+            except RequestShed:
+                shed += 1
+
+        def klass(reqs, deadline_ms):
+            ttfts = [r.ttft for r in reqs if r.ttft is not None]
+            missed = sum(
+                1 for r in reqs
+                if r.shed or (deadline_ms is not None and r.ttft is not None
+                              and r.ttft > deadline_ms / 1e3))
+            return {
+                "n": len(reqs),
+                "ttft_p50_ms": round(1e3 * _percentile(ttfts, 50), 2),
+                "ttft_p99_ms": round(1e3 * _percentile(ttfts, 99), 2),
+                "deadline_miss_rate": round(missed / max(len(reqs), 1), 4),
+            }
+
+        return {
+            "policy": policy,
+            "hi": klass(hi, ttft_slo_ms),
+            "lo": klass(lo, None),
+            "preemptions": eng.preemptions,
+            "shed": eng.shed_requests,
+            "wall_s": round(wall, 4),
+            "tick_ms": round(wall / ticks * 1e3, 3),
+        }
+
+    # compile-warm every shape on a throwaway arm, then measure
+    t0 = time.perf_counter()
+    run("fcfs")
+    compile_s = time.perf_counter() - t0
+    rows = [run(p) for p in ("fcfs", "priority", "slo")]
+    by = {r["policy"]: r for r in rows}
+    speedup = (by["fcfs"]["hi"]["ttft_p99_ms"]
+               / max(by["slo"]["hi"]["ttft_p99_ms"], 1e-9))
+    return {
+        "slots": slots,
+        "n_hi": n_hi,
+        "n_lo": n_lo,
+        "prompt_len": prompt_len,
+        "gen_hi": gen_hi,
+        "gen_lo": gen_lo,
+        "ttft_slo_ms": ttft_slo_ms,
+        "hi_p99_ttft_speedup": round(speedup, 2),
+        "speedup_ok": speedup >= 2.0,
+        "compile_time_s": round(compile_s, 1),
+        "step_time_s": by["fcfs"]["tick_ms"] / 1e3,
+        "rows": rows,
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
     prefix_mode = args.mode == "shared_prefix"
+    slo_mode = args.mode == "slo"
     if probe_backend(args.probe_timeout) == "cpu":
         from megatron_llm_tpu.utils.platform import pin_cpu_platform
 
         pin_cpu_platform()
         # CPU sanity shape: small enough for tier-1 time, big enough that
-        # the >=3x batching / >=2x prefill-reuse gates are real
-        # measurements, not noise
+        # the >=3x batching / >=2x prefill-reuse / >=2x slo-TTFT gates
+        # are real measurements, not noise
         layers, args.prompt, args.gen, args.reps = 2, 32, 24, 1
         hidden, heads, ffn, vocab = 256, 4, 512, 1024
         args.shared, args.tail = 96, 8
+        args.slots, args.n_hi, args.n_lo = 2, 6, 6
+        args.gen_lo, args.ttft_slo = 48, 250.0
 
     import jax
 
@@ -218,7 +336,8 @@ def _run(args, finished):
     from megatron_llm_tpu.models import init_model_params, make_config
 
     seq_need = max(args.prompt + args.gen,
-                   args.shared + args.tail + args.gen)
+                   args.shared + args.tail + args.gen,
+                   args.prompt + args.gen_lo)
     cfg = make_config(
         "llama2", num_layers=layers, hidden_size=hidden,
         num_attention_heads=heads, num_attention_heads_kv=heads,
@@ -237,11 +356,36 @@ def _run(args, finished):
             c = levels[-1]
             row = bench_shared_prefix(cfg, params, c, args.shared,
                                       args.tail, args.gen, vocab)
+        elif slo_mode:
+            row = bench_slo(cfg, params, args.slots, args.n_hi, args.n_lo,
+                            args.prompt, args.gen, args.gen_lo, vocab,
+                            args.ttft_slo)
         else:
             rows = [bench_engine(cfg, params, c, args.prompt, args.gen,
                                  vocab, args.reps) for c in levels]
 
-    if prefix_mode:
+    if slo_mode:
+        by = {r["policy"]: r for r in row["rows"]}
+        result = {
+            "metric": METRIC_SLO,
+            "value": row["hi_p99_ttft_speedup"],
+            "unit": "x",
+            "speedup_ok": row["speedup_ok"],
+            "hi_deadline_miss_rate": {
+                p: by[p]["hi"]["deadline_miss_rate"] for p in by},
+            "preemptions": {p: by[p]["preemptions"] for p in by},
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in
+                         ("slots", "n_hi", "n_lo", "prompt_len", "gen_hi",
+                          "gen_lo", "ttft_slo_ms")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_slo"
+    elif prefix_mode:
         result = {
             "metric": METRIC_PREFIX.replace(
                 "_c8_", f"_c{row['concurrency']}_"),
@@ -279,7 +423,7 @@ def _run(args, finished):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("occupancy", "shared_prefix"),
+    ap.add_argument("--mode", choices=("occupancy", "shared_prefix", "slo"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
@@ -290,13 +434,24 @@ def main():
                     help="shared system-prompt tokens (shared_prefix mode)")
     ap.add_argument("--tail", type=int, default=32,
                     help="distinct per-request prompt tail (shared_prefix)")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode slots (slo mode; overload = requests >> slots)")
+    ap.add_argument("--n_hi", type=int, default=16,
+                    help="interactive priority-0 requests (slo mode)")
+    ap.add_argument("--n_lo", type=int, default=16,
+                    help="batch priority-2 requests (slo mode)")
+    ap.add_argument("--gen_lo", type=int, default=256,
+                    help="batch-request generation length (slo mode)")
+    ap.add_argument("--ttft_slo", type=float, default=2000.0,
+                    help="interactive TTFT deadline in ms (slo mode)")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=1500.0)
     args = ap.parse_args()
 
-    metric = METRIC_PREFIX if args.mode == "shared_prefix" else METRIC
-    unit = "x" if args.mode == "shared_prefix" else "tok/s"
+    metric = {"shared_prefix": METRIC_PREFIX, "slo": METRIC_SLO}.get(
+        args.mode, METRIC)
+    unit = "x" if args.mode in ("shared_prefix", "slo") else "tok/s"
     finished = threading.Event()
 
     def on_timeout():

@@ -424,6 +424,17 @@ class InferenceConfig:
     prefill_chunk: int = 64
     page_watermark: int = 0
     max_queued_requests: int = 256
+    # scheduling control plane (generation/scheduling/, ISSUE 7):
+    # --sched_policy fcfs|priority|slo picks the admission/preemption
+    # policy (fcfs = the pre-policy engine, bitwise); --sched_aging_s is
+    # the priority policy's anti-starvation horizon (a queued request
+    # climbs one class per aging_s seconds); --sched_quota bounds queue
+    # depth per priority class ("0:64,2:16", overflow -> 503);
+    # --sched_preemption gates preemption-by-page-release
+    sched_policy: str = "fcfs"
+    sched_aging_s: float = 5.0
+    sched_quota: Optional[str] = None
+    sched_preemption: bool = True
 
 
 @dataclass

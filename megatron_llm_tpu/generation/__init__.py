@@ -15,6 +15,11 @@ from megatron_llm_tpu.generation.generation import (
     score_tokens,
 )
 from megatron_llm_tpu.generation.sampling import sample, sample_per_slot
+from megatron_llm_tpu.generation.scheduling import (
+    RequestShed,
+    SchedulerPolicy,
+    get_policy,
+)
 
 __all__ = [
     "ContinuousBatchingEngine",
@@ -23,8 +28,11 @@ __all__ = [
     "InferenceEngine",
     "PagedKVPool",
     "PrefixCache",
+    "RequestShed",
+    "SchedulerPolicy",
     "beam_search",
     "generate_tokens",
+    "get_policy",
     "sample",
     "sample_per_slot",
     "score_tokens",

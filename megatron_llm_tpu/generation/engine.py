@@ -59,6 +59,20 @@ resident, keep its batch full, and never compute the same prefix twice.
   token log-probs.  Pool buffers are donated, so the cache updates in
   place.
 
+* **Scheduling control plane** (generation/scheduling/): every scheduling
+  DECISION — admission order, the per-tick prefill-chunk budget,
+  preemption victims, load shedding — delegates to a pluggable
+  :class:`~megatron_llm_tpu.generation.scheduling.SchedulerPolicy`
+  (``--sched_policy``: ``fcfs`` default / ``priority`` / ``slo``), while
+  the MECHANISMS (pages, slots, the commitment ledger) stay here.
+  Preemption works by page release: the victim's finished KV pages are
+  parked in the prefix trie, its pages released, and the request
+  re-queued — re-admission matches the pages back out of the trie and
+  resume is bitwise-identical to never having been preempted.  Admission
+  control is metrics-driven: overload 503s carry an EMA-drain Retry-After,
+  per-priority queue bounds gate the classes independently, and the slo
+  policy sheds requests whose deadline is already unmeetable.
+
 Threading: ``submit`` may be called from any thread (e.g. concurrent HTTP
 handlers — generation/server.py); device work happens on whichever thread
 drives :meth:`step`, either the built-in background loop (:meth:`start`) or
@@ -82,6 +96,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from megatron_llm_tpu.core.parallel_state import TP_AXIS
 from megatron_llm_tpu.generation import generation as gen
 from megatron_llm_tpu.generation.sampling import sample_per_slot
+from megatron_llm_tpu.generation.scheduling import (
+    RequestShed,
+    SchedulerPolicy,
+    SchedulerState,
+    get_policy,
+)
 from megatron_llm_tpu.observability import registry as obs_registry
 from megatron_llm_tpu.observability import trace as obs_trace
 from megatron_llm_tpu.generation.tokenization import detokenize_generations
@@ -103,11 +123,16 @@ class EngineOverloaded(RuntimeError):
     """Submit-time backpressure: the request queue is at capacity.
 
     The server maps this to a structured 503 with a ``Retry-After`` header
-    instead of queueing unboundedly (generation/server.py)."""
+    instead of queueing unboundedly (generation/server.py).  ``retry_after``
+    is metrics-driven — the engine's EMA drain estimate for the current
+    queue depth, not a constant — and ``info`` carries the queue snapshot
+    the server includes in the 503 body."""
 
-    def __init__(self, msg: str, retry_after: float = 1.0):
+    def __init__(self, msg: str, retry_after: float = 1.0,
+                 info: Optional[dict] = None):
         super().__init__(msg)
         self.retry_after = retry_after
+        self.info = info or {}
 
 
 class PagedKVPool:
@@ -327,6 +352,12 @@ class EngineRequest:
     stop_on_eol: bool = False
     seed: Optional[int] = None
     return_log_probs: bool = False
+    # scheduling (generation/scheduling/): priority class (0 = most
+    # urgent, the `priority` policy) and soft deadlines (the `slo`
+    # policy); all ignored by fcfs
+    priority: int = 1
+    ttft_deadline_ms: Optional[float] = None
+    tpot_deadline_ms: Optional[float] = None
 
     # engine-filled state
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -334,6 +365,8 @@ class EngineRequest:
     prompt_log_probs: Optional[List[float]] = None
     finished: bool = False
     error: Optional[str] = None
+    shed: bool = False  # dropped by the scheduler, never served
+    shed_retry_after: float = 1.0
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
     _pages: List[int] = dataclasses.field(default_factory=list, repr=False)
@@ -346,14 +379,28 @@ class EngineRequest:
     _hit_tokens: int = dataclasses.field(default=0, repr=False)
     _t_submit: float = dataclasses.field(default=0.0, repr=False)
     _t_first: float = dataclasses.field(default=0.0, repr=False)
+    _seqno: int = dataclasses.field(default=0, repr=False)
+    _preemptions: int = dataclasses.field(default=0, repr=False)
+    # PRNG key resolved at FIRST activation and pinned: a preempted
+    # request resumes the same sampling stream (fold_in(key, _step))
+    _key: Optional[np.ndarray] = dataclasses.field(default=None, repr=False)
 
     def result(self, timeout: Optional[float] = None):
         """Wait for completion; returns (full token list, gen log-probs)."""
         if not self._done.wait(timeout):
             raise TimeoutError("generation did not finish in time")
+        if self.shed:
+            raise RequestShed(self.error or "request shed",
+                              retry_after=self.shed_retry_after)
         if self.error:
             raise RuntimeError(self.error)
         return list(self.prompt) + self.generated, list(self.log_probs)
+
+    @property
+    def seq_tokens(self) -> List[int]:
+        """Prompt + tokens generated so far — the effective prompt a
+        preempted request re-admits with (fresh requests: the prompt)."""
+        return list(self.prompt) + self.generated
 
     @property
     def ttft(self) -> Optional[float]:
@@ -375,6 +422,7 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  page_watermark: Optional[int] = None,
                  max_queue: Optional[int] = None,
+                 sched_policy=None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -432,6 +480,24 @@ class ContinuousBatchingEngine:
                                else getattr(inf, "page_watermark", 0))
         self.max_queue = (max_queue if max_queue is not None
                           else getattr(inf, "max_queued_requests", 256))
+        # scheduling policy (generation/scheduling/): decisions delegate
+        # to it, mechanisms stay here.  A string resolves through the
+        # registry; tests may hand a policy instance directly.
+        sched = (sched_policy if sched_policy is not None
+                 else getattr(inf, "sched_policy", "fcfs"))
+        if isinstance(sched, SchedulerPolicy):
+            self.policy = sched
+        else:
+            self.policy = get_policy(sched)(
+                aging_s=getattr(inf, "sched_aging_s", 5.0),
+                preemption=getattr(inf, "sched_preemption", True))
+        # per-priority queue bounds ("0:64,2:16"); classes without a quota
+        # share only the global max_queue bound
+        self._quota: Dict[int, int] = {}
+        for part in (getattr(inf, "sched_quota", None) or "").split(","):
+            if part.strip():
+                prio, bound = part.split(":")
+                self._quota[int(prio)] = int(bound)
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
@@ -482,6 +548,15 @@ class ContinuousBatchingEngine:
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
         self.cow_copies = 0
+        # scheduler telemetry (bench_decode --mode slo + /health payload)
+        self.preemptions = 0
+        self.shed_requests = 0
+        self.deadline_misses = 0
+        self._seqno = 0          # submit order, stable policy tie-break
+        self._ema_tick_s: Optional[float] = None    # decode-tick wall EMA
+        self._ema_retire_s: Optional[float] = None  # inter-retire EMA
+        self._last_retire_t: Optional[float] = None
+        self._queued_prios: Set[int] = set()  # label sets ever published
         # registry instruments, resolved once (observability/registry.py):
         # per-tick updates must stay dict-free on the scheduler thread
         reg = obs_registry.get_registry()
@@ -513,6 +588,26 @@ class ContinuousBatchingEngine:
         self._m_prefill_tokens = reg.counter(
             "mlt_engine_prefill_tokens_total",
             help="token rows pushed through prefill (chunked or monolithic)")
+        self._m_preempt = reg.counter(
+            "mlt_engine_preemptions_total",
+            help="decoding requests preempted by page release")
+        self._m_shed = reg.counter(
+            "mlt_engine_shed_total",
+            help="queued requests shed (unmeetable deadline / load)")
+        self._m_ttft = reg.histogram(
+            "mlt_engine_ttft_seconds",
+            help="submit-to-first-token latency of retired requests")
+        self._m_miss_ttft = reg.counter(
+            "mlt_engine_deadline_miss_total",
+            help="retired requests that missed a declared deadline",
+            labels={"kind": "ttft"})
+        self._m_miss_tpot = reg.counter(
+            "mlt_engine_deadline_miss_total",
+            help="retired requests that missed a declared deadline",
+            labels={"kind": "tpot"})
+        reg.gauge("mlt_engine_sched_policy_info",
+                  help="active scheduling policy (value always 1)",
+                  labels={"policy": self.policy.name}).set(1)
         reg.gauge("mlt_engine_max_slots",
                   help="decode slots in the tick program").set(self.max_slots)
         reg.gauge("mlt_engine_pool_pages",
@@ -702,48 +797,146 @@ class ContinuousBatchingEngine:
             with self._work:
                 if self.max_queue and len(self._queue) >= self.max_queue:
                     raise EngineOverloaded(
-                        f"request queue full ({self.max_queue} waiting)")
+                        f"request queue full ({self.max_queue} waiting)",
+                        retry_after=self._drain_eta(len(self._queue)),
+                        info=self._overload_info())
+                quota = self._quota.get(req.priority)
+                if quota is not None:
+                    depth = sum(1 for r in self._queue
+                                if r.priority == req.priority)
+                    if depth >= quota:
+                        raise EngineOverloaded(
+                            f"priority-{req.priority} queue full "
+                            f"({quota} waiting)",
+                            retry_after=self._drain_eta(depth),
+                            info=self._overload_info())
+                self._seqno += 1
+                req._seqno = self._seqno
                 self._queue.append(req)
                 if obs_registry.publishing():
                     self._m_requests.inc()
-                    self._m_queued.set(len(self._queue))
+                self._publish_queued_locked()
                 self._work.notify()
         return req
+
+    def _drain_eta(self, depth: int) -> float:
+        """Seconds until ``depth`` queued requests likely drain — the
+        EMA retirement interval (tick EMA before any retirement), clamped
+        to [1, 60].  This is the Retry-After a 503 carries, so it tracks
+        load instead of being a constant."""
+        per = (self._ema_retire_s if self._ema_retire_s is not None
+               else self._ema_tick_s)
+        if per is None:
+            return 1.0
+        return min(60.0, max(1.0, depth * per))
+
+    def _overload_info(self) -> dict:
+        return {"queued": len(self._queue), "policy": self.policy.name,
+                "active_slots": sum(r is not None for r in self._slots)}
+
+    def _publish_queued_locked(self, force: bool = False) -> None:
+        """THE queue-depth gauge update point (total + per-priority
+        labels) — every enqueue/admit/preempt/shed path funnels here, so
+        the gauges can never disagree with each other.  ``force`` is the
+        scrape-time pull (server metrics_text), which refreshes even with
+        per-tick publishing switched off."""
+        if not (force or obs_registry.publishing()):
+            return
+        self._m_queued.set(len(self._queue))
+        by_prio: Dict[int, int] = {}
+        for r in self._queue:
+            by_prio[r.priority] = by_prio.get(r.priority, 0) + 1
+        self._queued_prios |= set(by_prio)
+        reg = obs_registry.get_registry()
+        for prio in self._queued_prios:  # stale labels drop to 0
+            reg.gauge("mlt_engine_queued_requests",
+                      help="requests awaiting a slot",
+                      labels={"priority": str(prio)}
+                      ).set(by_prio.get(prio, 0))
 
     def _max_pages_for(self, req: EngineRequest) -> int:
         total = min(len(req.prompt) + req.max_new_tokens, self.max_seq)
         return -(-total // self.page_size)
 
-    def _admit(self) -> None:
-        """Move queued requests into free slots while slots+pages allow.
+    def _sched_state(self, now: float) -> SchedulerState:
+        """Read-only snapshot for policy decisions (under _lock)."""
+        return SchedulerState(
+            now=now,
+            ema_tick_s=self._ema_tick_s,
+            ema_retire_s=self._ema_retire_s,
+            free_slots=sum(r is None for r in self._slots),
+            queue_depth=len(self._queue),
+            can_preempt=bool(self.prefill_chunk),
+        )
 
-        FCFS admission: blocks behind the queue head rather than starving
-        large requests.  Chunked mode reserves only the uncovered prompt
+    def _admit(self) -> None:
+        """Move queued requests into slots while the policy and pages
+        allow.
+
+        The policy owns the DECISIONS: which queued request to try next
+        (``admission_order``; fcfs = queue head with nothing skipping it,
+        ``barrier_admission``), which queued requests to shed outright,
+        and which decoding victim to preempt when the best candidate
+        can't get a slot or its page budget.  The engine owns the
+        MECHANISMS: chunked mode reserves only the uncovered prompt
         suffix (plus the first decode page) and books the worst-case rest
-        in the commitment ledger; monolithic mode reserves the full budget
-        up front (PR 1 semantics).  Planning (trie match, budget check,
-        allocation, slot assignment) happens under ``_lock``; only the
-        device work (COW copy / monolithic prefill) runs outside it, with
-        every owned page ref tracked in ``req._pages`` throughout so a
-        failure path releases exactly what is held."""
+        in the commitment ledger; monolithic mode reserves the full
+        budget up front (PR 1 semantics).  Planning (trie match, budget
+        check, allocation, slot assignment) happens under ``_lock``; only
+        the device work (COW copy / monolithic prefill) runs outside it,
+        with every owned page ref tracked in ``req._pages`` throughout so
+        a failure path releases exactly what is held."""
         while True:
             with self._lock:
                 if not self._queue:
                     return
+                now = time.monotonic()
+                state = self._sched_state(now)
+                shed = self.policy.shed(list(self._queue), state)
+                for victim, reason in shed:
+                    if victim in self._queue:  # defensive vs policy bugs
+                        self._queue.remove(victim)
+                        self._shed_locked(victim, reason)
+                if shed:
+                    self._publish_queued_locked()
+                    if not self._queue:
+                        return
+                order = self.policy.admission_order(list(self._queue),
+                                                    state)
+                req = plan = None
                 try:
                     slot = self._slots.index(None)
                 except ValueError:
-                    return
-                req = self._queue[0]
-                if self.prefill_chunk:
-                    plan = self._plan_chunked(req, slot)
-                else:
-                    plan = self._plan_monolithic(req, slot)
+                    slot = None
+                if slot is not None:
+                    for cand in order:
+                        p = (self._plan_chunked(cand, slot)
+                             if self.prefill_chunk
+                             else self._plan_monolithic(cand, slot))
+                        if p is not None:
+                            req, plan = cand, p
+                            break
+                        if self.policy.barrier_admission:
+                            break  # page pressure: head waits, no skips
                 if plan is None:
-                    return  # page pressure: head waits, nothing skips it
-                self._queue.popleft()
-                if obs_registry.publishing():
-                    self._m_queued.set(len(self._queue))
+                    # blocked on a slot or on pages: the policy may evict
+                    # the lowest-value decoding request — its pages go
+                    # back to the pool (prefix-covered ones stay in the
+                    # trie) and it re-queues for a cached-page resume
+                    victim = None
+                    if order and state.can_preempt:
+                        decoding = [r for r in self._slots
+                                    if r is not None
+                                    and r._phase == "decode"
+                                    and not r.return_log_probs]
+                        victim = self.policy.preempt_victim(
+                            order[0], decoding, state)
+                    if victim is None:
+                        return
+                    self._preempt_locked(victim)
+                    continue
+                self._queue.remove(req)
+                self._publish_queued_locked()
             try:
                 if self.prefill_chunk:
                     self._place_chunked(req, plan)
@@ -752,21 +945,107 @@ class ContinuousBatchingEngine:
             except Exception as e:  # noqa: BLE001 — surface to the waiter
                 self._fail(req, e)
 
+    def _preempt_locked(self, victim: EngineRequest) -> None:
+        """Preemption by page release: park the victim's finished KV
+        pages in the prefix-cache trie, release every page it holds
+        (trie-registered ones go cached-idle, the rest go free), return
+        its unused worst-case commitment, and re-queue it.  On
+        re-admission the trie match re-takes the SAME physical pages, so
+        resume recomputes only the partial last page — bitwise identical
+        to never having been preempted (tests/test_scheduler.py)."""
+        assert victim._phase == "decode" and victim._slot >= 0
+        slot = victim._slot
+        seq = victim.seq_tokens
+        if self.cache is not None:
+            # every page fully covered by seq[:-1] is finished K/V the
+            # resume's refeed tick will never write — safe to share
+            self.cache.insert(seq, victim._pages,
+                              (len(seq) - 1) // self.page_size)
+        self._slots[slot] = None
+        self._block_tables[slot] = NULL_PAGE
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self._top_k[slot] = 1
+        self._top_p[slot] = 0.0
+        self._temperature[slot] = 1.0
+        pages, victim._pages = victim._pages, []
+        self._committed -= max(0, victim._max_pages - len(pages))
+        self.pool.release(pages)
+        victim._phase = "queued"
+        victim._slot = -1
+        victim._fill_pos = 0
+        victim._preemptions += 1
+        self.preemptions += 1
+        self._queue.append(victim)  # position is policy-ordered anyway
+        if obs_registry.publishing():
+            self._m_preempt.inc()
+        self._publish_queued_locked()
+        self._dirty = True
+
+    def _shed_locked(self, req: EngineRequest, reason: str) -> None:
+        """Drop a QUEUED request (owns no pages): fail its future with a
+        retryable :class:`RequestShed` carrying the drain estimate."""
+        req.shed = True
+        req.shed_retry_after = self._drain_eta(len(self._queue))
+        req._phase = "finished"
+        req.error = f"request shed: {reason}"
+        req.finished = True
+        self.shed_requests += 1
+        if obs_registry.publishing():
+            self._m_shed.inc()
+        req._done.set()
+
+    def preempt(self, req: EngineRequest) -> bool:
+        """Force-preempt one decoding request (ops/test hook — policy-
+        driven preemption runs the same ``_preempt_locked`` path during
+        admission).  False if the request isn't currently decoding."""
+        with self._lock:
+            if req._phase != "decode" or not self.prefill_chunk:
+                return False
+            self._preempt_locked(req)
+            return True
+
+    def scheduler_stats(self) -> dict:
+        """Control-plane snapshot for ``/health`` (generation/server.py)
+        and the slo bench."""
+        with self._lock:
+            by_prio: Dict[str, int] = {}
+            for r in self._queue:
+                k = str(r.priority)
+                by_prio[k] = by_prio.get(k, 0) + 1
+            return {
+                "policy": self.policy.name,
+                "queued": len(self._queue),
+                "queued_by_priority": by_prio,
+                "preemptions": self.preemptions,
+                "shed": self.shed_requests,
+                "deadline_misses": self.deadline_misses,
+                "ema_tick_ms": (None if self._ema_tick_s is None
+                                else round(self._ema_tick_s * 1e3, 3)),
+                "ema_retire_ms": (None if self._ema_retire_s is None
+                                  else round(self._ema_retire_s * 1e3, 3)),
+                "retry_after_s": round(self._drain_eta(len(self._queue)), 3),
+            }
+
     # ---- chunked admission ----
 
     def _plan_chunked(self, req: EngineRequest, slot: int) -> Optional[dict]:
         """Under _lock: match the prefix cache, check the page budget,
         allocate the suffix pages, and reserve the slot.  None = can't
-        admit now (matched refs undone)."""
+        admit now (matched refs undone).  Works on the request's
+        EFFECTIVE prompt (prompt + generated): a preempted request
+        re-admits here and its parked pages match straight back out of
+        the trie."""
         ps = self.page_size
-        prompt_len = len(req.prompt)
+        seq = req.seq_tokens
+        prompt_len = len(seq)
         max_total = self._max_pages_for(req)
         matched: List[int] = []
         if self.cache is not None and not req.return_log_probs:
             # log-prob requests recompute the whole prompt (the teacher-
             # forced scores need every position's logits), so they take no
             # shared pages — their pages still feed the cache afterwards
-            matched = self.cache.match(req.prompt, prompt_len // ps)
+            matched = self.cache.match(seq, prompt_len // ps)
         covered = len(matched) * ps
         # full page-aligned match: the first tick re-feeds the last prompt
         # token and would WRITE the final shared page -> copy-on-write
@@ -823,7 +1102,7 @@ class ContinuousBatchingEngine:
                 self.cow_copies += 1
                 if obs_registry.publishing():
                     self._m_cow.inc()
-            if req._fill_pos >= len(req.prompt):
+            if req._fill_pos >= len(req.seq_tokens):
                 # fully served from cache: straight to decode
                 self._activate(req, req._slot)
             else:
@@ -879,25 +1158,28 @@ class ContinuousBatchingEngine:
     # ---- shared lifecycle tail ----
 
     def _activate(self, req: EngineRequest, slot: int) -> None:
-        """Under _lock: install the slot's decode state (prompt fully in
-        pages); the next tick samples the first generated token by
-        re-feeding the last prompt token at position prompt_len - 1 —
-        identical K/V rewrite into a PRIVATE page (COW guarantees it)."""
-        prompt_len = len(req.prompt)
-        seed = req.seed
-        if seed is None:
-            seed = int.from_bytes(os.urandom(4), "little")
-        key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        """Under _lock: install the slot's decode state (effective prompt
+        fully in pages); the next tick samples the next token by
+        re-feeding the last token at position len(seq) - 1 — identical
+        K/V rewrite into a PRIVATE page (COW guarantees it).  A resumed
+        request re-enters with its ORIGINAL key and step count, so its
+        sampling stream continues exactly where preemption cut it."""
+        seq = req.seq_tokens
+        if req._key is None:
+            seed = req.seed
+            if seed is None:
+                seed = int.from_bytes(os.urandom(4), "little")
+            req._key = np.asarray(jax.random.PRNGKey(seed), np.uint32)
         bt = np.full((self.pages_per_seq,), NULL_PAGE, np.int32)
         bt[: len(req._pages)] = req._pages
         self._block_tables[slot] = bt
-        self._positions[slot] = prompt_len - 1
-        self._tokens[slot] = req.prompt[-1]
+        self._positions[slot] = len(seq) - 1
+        self._tokens[slot] = seq[-1]
         self._temperature[slot] = req.temperature
         self._top_k[slot] = req.top_k
         self._top_p[slot] = req.top_p
-        self._keys[slot] = key
-        self._steps[slot] = 0
+        self._keys[slot] = req._key
+        self._steps[slot] = req._step
         req._phase = "decode"
         self._dirty = True
 
@@ -935,6 +1217,32 @@ class ContinuousBatchingEngine:
         self._dirty = True
         req._phase = "finished"
         req.finished = True
+        # drain-rate EMA (feeds Retry-After + slo shed predictions) and
+        # SLO outcome accounting
+        now = time.monotonic()
+        if self._last_retire_t is not None:
+            dt = now - self._last_retire_t
+            self._ema_retire_s = (dt if self._ema_retire_s is None
+                                  else 0.7 * self._ema_retire_s + 0.3 * dt)
+        self._last_retire_t = now
+        ttft = req.ttft
+        missed = False
+        if ttft is not None:
+            if obs_registry.publishing():
+                self._m_ttft.observe(ttft)
+            if (req.ttft_deadline_ms is not None
+                    and ttft > req.ttft_deadline_ms / 1e3):
+                missed = True
+                if obs_registry.publishing():
+                    self._m_miss_ttft.inc()
+            if (req.tpot_deadline_ms is not None and req._step > 1
+                    and ((now - req._t_first) / (req._step - 1)
+                         > req.tpot_deadline_ms / 1e3)):
+                missed = True
+                if obs_registry.publishing():
+                    self._m_miss_tpot.inc()
+        if missed:
+            self.deadline_misses += 1
         req._done.set()
 
     def _stopped_by_token(self, req: EngineRequest, tok: int) -> bool:
@@ -952,18 +1260,22 @@ class ContinuousBatchingEngine:
     # -- chunked prefill scheduling ---------------------------------------
 
     def _advance_prefill(self) -> bool:
-        """Run ONE prefill chunk for the oldest prefilling request (FCFS).
-        Returns True if a chunk ran — at most one per tick, so decode slots
-        keep ticking while long prompts fill in the gaps."""
+        """Run ONE prefill chunk for the policy's chosen prefilling
+        request (fcfs: the oldest).  Returns True if a chunk ran — the
+        policy's per-tick budget bounds how many run back to back, so
+        decode slots keep ticking while long prompts fill in the gaps."""
         with self._lock:
-            while self._prefill_q and self._prefill_q[0]._phase != "prefill":
-                self._prefill_q.popleft()  # failed/cancelled requests
-            if not self._prefill_q:
+            live = [r for r in self._prefill_q if r._phase == "prefill"]
+            if len(live) != len(self._prefill_q):  # failed/cancelled
+                self._prefill_q = deque(live)
+            if not live:
                 return False
-            req = self._prefill_q[0]
+            req = self.policy.prefill_order(
+                live, self._sched_state(time.monotonic()))[0]
             ps = self.page_size
             chunk = self.prefill_chunk
-            prompt_len = len(req.prompt)
+            seq = req.seq_tokens  # resumed requests re-prefill their tail
+            prompt_len = len(seq)
             start = req._fill_pos
             fill_end = _bucket_up(prompt_len, ps)
             # chunk boundaries are ABSOLUTE-position grid multiples of
@@ -978,14 +1290,14 @@ class ContinuousBatchingEngine:
             kv_pages = min(self.pages_per_seq, _bucket_up(end) // ps)
             tokens = np.zeros((1, rows), np.int32)
             n_real = min(end, prompt_len) - start
-            tokens[0, :n_real] = req.prompt[start:start + n_real]
+            tokens[0, :n_real] = seq[start:start + n_real]
             bt = np.full((1, kv_pages), NULL_PAGE, np.int32)
             n_bt = min(len(req._pages), kv_pages)
             bt[0, :n_bt] = req._pages[:n_bt]
             targets = np.zeros((1, rows), np.int32)
             n_lp = max(0, min(rows, prompt_len - 1 - start))
             if req.return_log_probs and n_lp:
-                targets[0, :n_lp] = req.prompt[start + 1:start + 1 + n_lp]
+                targets[0, :n_lp] = seq[start + 1:start + 1 + n_lp]
 
         try:
             with obs_trace.span("engine-prefill-chunk", start=start,
@@ -1014,13 +1326,13 @@ class ContinuousBatchingEngine:
             if obs_registry.publishing():
                 self._m_prefill_tokens.inc(rows)
             if end >= fill_end:
-                self._prefill_q.popleft()
+                self._prefill_q.remove(req)
                 if self.cache is not None:
                     # cache every page FULLY covered by prompt tokens that
                     # the refeed tick will never write: (prompt_len-1)//page
                     # excludes the refeed page, so shared pages are
                     # immutable from birth
-                    self.cache.insert(req.prompt, req._pages,
+                    self.cache.insert(seq, req._pages,
                                       (prompt_len - 1) // ps)
                 self._activate(req, req._slot)
         return True
@@ -1036,17 +1348,25 @@ class ContinuousBatchingEngine:
         ``_drive_lock``)."""
         with obs_trace.span("engine-admit"):
             self._admit()
-        did_prefill = int(self._advance_prefill())
+        with self._lock:
+            budget = self.policy.prefill_budget(
+                [r for r in self._prefill_q if r._phase == "prefill"],
+                self._sched_state(time.monotonic()))
+        did_prefill = 0
+        for _ in range(max(1, budget)):
+            if not self._advance_prefill():
+                break
+            did_prefill += 1
         with self._lock:
             active = [i for i, r in enumerate(self._slots)
                       if r is not None and r._phase == "decode"]
             if not active:
                 if obs_registry.publishing():
                     self._m_active.set(0)
-                    self._m_queued.set(len(self._queue))
                     self._m_free_pages.set(self.pool.num_free)
                     self._m_pages_cached.set(
                         len(self.cache) if self.cache else 0)
+                self._publish_queued_locked()
                 return did_prefill
             # on-demand paging: a row crossing into a page it doesn't own
             # yet gets one allocated now (commitment ledger guarantees this
@@ -1080,6 +1400,7 @@ class ContinuousBatchingEngine:
                 self._dirty = False
             bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
 
+        t_tick = time.monotonic()
         with obs_trace.span("engine-tick", active=len(active),
                             tp=self._tp):
             (self.pool.k, self.pool.v, next_tok, logp,
@@ -1091,6 +1412,9 @@ class ContinuousBatchingEngine:
 
         now = time.monotonic()
         with self._lock:
+            dt = now - t_tick  # feeds Retry-After/shed drain estimates
+            self._ema_tick_s = (dt if self._ema_tick_s is None
+                                else 0.8 * self._ema_tick_s + 0.2 * dt)
             if not self._dirty:
                 # steady state: the tick already advanced the device mirror
                 self._dev_state = (bt, new_pos, next_tok, keys, new_steps,
@@ -1120,10 +1444,10 @@ class ContinuousBatchingEngine:
                 self._m_active.set(
                     sum(r is not None and r._phase == "decode"
                         for r in self._slots))
-                self._m_queued.set(len(self._queue))
                 self._m_free_pages.set(self.pool.num_free)
                 self._m_pages_cached.set(
                     len(self.cache) if self.cache else 0)
+            self._publish_queued_locked()
         return len(active) + did_prefill
 
     def run_until_idle(self) -> None:
@@ -1183,6 +1507,9 @@ class ContinuousBatchingEngine:
         stop_on_double_eol: bool = False,
         stop_on_eol: bool = False,
         random_seed: int = -1,
+        priority: int = 1,
+        ttft_deadline_ms: Optional[float] = None,
+        tpot_deadline_ms: Optional[float] = None,
     ):
         """Drop-in for api.generate_and_post_process: tokenize, submit each
         prompt as its own request (all of them share decode ticks), wait,
@@ -1209,6 +1536,9 @@ class ContinuousBatchingEngine:
                 stop_on_eol=stop_on_eol,
                 seed=None if random_seed == -1 else random_seed + i,
                 return_log_probs=return_output_log_probs,
+                priority=priority,
+                ttft_deadline_ms=ttft_deadline_ms,
+                tpot_deadline_ms=tpot_deadline_ms,
             ))
         if self._thread is None:
             self.run_until_idle()

@@ -1,0 +1,48 @@
+"""Serving control plane: pluggable scheduling policies for the engine.
+
+The continuous-batching engine (generation/engine.py) delegates every
+scheduling *decision* here — admission ordering, the per-tick prefill-chunk
+budget, preemption victims, and load shedding — while keeping every
+scheduling *mechanism* (page allocation, slot state, the commitment
+ledger) in the engine.  Three policies ship:
+
+* ``fcfs`` (default) — strict submission order, the head blocks admission
+  under page pressure, never preempts, never sheds.  Reproduces the
+  pre-policy engine token-for-token (tests/test_scheduler.py).
+* ``priority`` — per-request integer priority classes (0 = most urgent)
+  ordered by an aging-adjusted effective priority, so a starved request
+  climbs one class per ``--sched_aging_s`` seconds; may preempt a
+  strictly lower-value decoding request.
+* ``slo`` — per-request TTFT / per-token deadlines, earliest-deadline-
+  first, sheds requests whose deadline is already unmeetable instead of
+  burning pool pages on a guaranteed miss.
+
+Preemption works by page release: the victim's full KV pages re-enter the
+prefix-cache trie before its pages are released, so re-admission matches
+them back and resume is bitwise-identical to never having been preempted
+(the PR 5 grid-aligned chunk invariant).
+"""
+
+from megatron_llm_tpu.generation.scheduling.policy import (
+    RequestShed,
+    SchedulerPolicy,
+    SchedulerState,
+    available_policies,
+    get_policy,
+    register_policy,
+)
+from megatron_llm_tpu.generation.scheduling.fcfs import FcfsPolicy
+from megatron_llm_tpu.generation.scheduling.priority import PriorityPolicy
+from megatron_llm_tpu.generation.scheduling.slo import SloPolicy
+
+__all__ = [
+    "FcfsPolicy",
+    "PriorityPolicy",
+    "RequestShed",
+    "SchedulerPolicy",
+    "SchedulerState",
+    "SloPolicy",
+    "available_policies",
+    "get_policy",
+    "register_policy",
+]

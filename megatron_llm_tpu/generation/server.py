@@ -28,6 +28,7 @@ from pathlib import Path
 from typing import Optional
 
 from megatron_llm_tpu.generation.engine import EngineOverloaded
+from megatron_llm_tpu.generation.scheduling import RequestShed
 
 _STATIC_DIR = Path(__file__).parent / "static"
 
@@ -122,6 +123,19 @@ def _validate(payload: dict):
     if not isinstance(length_penalty, float):
         return None, "length_penalty must be a float"
     p["length_penalty"] = length_penalty
+
+    # scheduling control plane (generation/scheduling/): priority class
+    # for --sched_policy priority, soft deadlines for --sched_policy slo
+    priority = payload.get("priority", 1)
+    if not isinstance(priority, int) or not 0 <= priority <= 9:
+        return None, "priority must be an integer between 0 and 9"
+    p["priority"] = priority
+    for field in ("ttft_deadline_ms", "tpot_deadline_ms"):
+        val = payload.get(field)
+        if val is not None and (not isinstance(val, (int, float))
+                                or isinstance(val, bool) or val <= 0):
+            return None, f"{field} must be a positive number of milliseconds"
+        p[field] = None if val is None else float(val)
     return p, None
 
 
@@ -167,6 +181,12 @@ class MegatronServer:
                     )
                     return 200, {"text": texts, "segments": segments,
                                  "scores": scores}
+                kw = {}
+                if self.batching:
+                    # scheduling fields only exist on the batching engine
+                    kw = dict(priority=params["priority"],
+                              ttft_deadline_ms=params["ttft_deadline_ms"],
+                              tpot_deadline_ms=params["tpot_deadline_ms"])
                 texts, segments, logprobs, _ = self.engine.generate_and_post_process(
                     params["prompts"],
                     tokens_to_generate=params["tokens_to_generate"],
@@ -178,15 +198,24 @@ class MegatronServer:
                     stop_on_double_eol=params["stop_on_double_eol"],
                     stop_on_eol=params["stop_on_eol"],
                     random_seed=params["random_seed"],
+                    **kw,
                 )
                 return 200, {"text": texts, "segments": segments,
                              "logprobs": logprobs}
             except EngineOverloaded as eo:
                 # backpressure instead of unbounded queueing: structured
                 # 503 + machine-readable retry hint (the HTTP handler turns
-                # retry_after into a Retry-After header)
+                # retry_after into a Retry-After header).  retry_after is
+                # the engine's EMA drain estimate for the current queue
+                # depth, and info carries the queue snapshot behind it.
                 return 503, {"error": str(eo),
-                             "retry_after": getattr(eo, "retry_after", 1.0)}
+                             "retry_after": getattr(eo, "retry_after", 1.0),
+                             **getattr(eo, "info", {})}
+            except RequestShed as rs:
+                # the scheduler refused the request (unmeetable deadline /
+                # load shed) — retryable load feedback, not a client error
+                return 503, {"error": str(rs), "shed": True,
+                             "retry_after": getattr(rs, "retry_after", 1.0)}
             except (ValueError, AssertionError) as ve:
                 return 400, {"error": str(ve.args[0] if ve.args else ve)}
             except Exception as e:  # engine failure must still answer the client
@@ -280,6 +309,10 @@ class MegatronServer:
             info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}
                             if mesh is not None else {})
             info["tp"] = getattr(eng, "_tp", 1)
+            if hasattr(eng, "scheduler_stats"):
+                # control-plane view: policy, per-priority queue depths,
+                # preemption/shed/deadline-miss totals, drain EMAs
+                info["scheduler"] = eng.scheduler_stats()
         return info
 
     def metrics_text(self) -> str:
@@ -294,13 +327,15 @@ class MegatronServer:
             with eng._lock:
                 reg.gauge("mlt_engine_active_slots").set(
                     sum(r is not None for r in eng._slots))
-                reg.gauge("mlt_engine_queued_requests").set(len(eng._queue))
                 reg.gauge("mlt_engine_free_pages").set(eng.pool.num_free)
                 reg.gauge("mlt_engine_max_slots").set(eng.max_slots)
                 reg.gauge("mlt_engine_pool_pages").set(eng.pool.num_pages - 1)
                 cache = getattr(eng, "cache", None)
                 reg.gauge("mlt_engine_pages_cached").set(
                     len(cache) if cache is not None else 0)
+                # queue-depth gauges (total + per-priority) have ONE owner:
+                # the engine's scheduler update point
+                eng._publish_queued_locked(force=True)
         return reg.render()
 
     def _start_engine(self):

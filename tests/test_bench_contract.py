@@ -192,6 +192,73 @@ def test_prefix_bench_in_watch_jobs():
     assert bounded is False and pred is _bench_on_tpu
 
 
+def test_slo_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode slo (ISSUE 7) reuses bench.py's off-TPU
+    contract: headline 0, the per-policy TTFT/deadline-miss/preemption
+    comparison rides under cpu_sanity WITH the host-cost budget fields
+    populated, TPU evidence goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_slo_hi_p99_ttft_speedup_llama470m_1chip",
+        "value": 3.1, "unit": "x", "backend": "cpu",
+        "speedup_ok": True,
+        "hi_deadline_miss_rate": {"fcfs": 1.0, "slo": 0.0},
+        "preemptions": {"fcfs": 0, "slo": 2},
+        "compile_time_s": 2.7, "step_time_s": 0.002,
+        "rows": [{"policy": "fcfs", "hi": {"ttft_p99_ms": 359.0}},
+                 {"policy": "slo", "hi": {"ttft_p99_ms": 115.0}}],
+    }, tag="engine_decode_slo")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["speedup_ok"] is True
+    assert line["cpu_sanity"]["hi_deadline_miss_rate"]["slo"] == 0.0
+    assert line["cpu_sanity"]["preemptions"]["slo"] == 2
+    # budget fields populated and within caps (no error stamp)
+    assert line["budgets"]["compile_time_s"]["value"] == 2.7
+    assert line["budgets"]["step_time_s"]["budget"] == 120.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "engine_slo", "value": 2.5,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_slo")
+    assert bench.load_last_tpu(tag="engine_decode_slo")["value"] == 2.5
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_slo_bench_in_watch_jobs():
+    """ISSUE 7: the scheduling-policy overload bench is in the tunnel-up
+    capture list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_slo" in by_name
+    cmd, bounded, pred = by_name["bench_decode_slo"]
+    assert "--mode" in cmd and "slo" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_slo_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_slo_cpu_sanity.json)
+    satisfies the contract: headline 0 off-TPU, >= 2x hi-priority p99
+    TTFT for slo vs fcfs, miss rates + preemptions present, budgets
+    populated without violations."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "BENCH_decode_slo_cpu_sanity.json"
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["speedup_ok"] is True
+    by = {r["policy"]: r for r in sanity["rows"]}
+    assert set(by) == {"fcfs", "priority", "slo"}
+    assert (by["fcfs"]["hi"]["ttft_p99_ms"]
+            >= 2.0 * by["slo"]["hi"]["ttft_p99_ms"])
+    assert by["slo"]["preemptions"] >= 1
+    for row in by.values():
+        assert {"ttft_p50_ms", "ttft_p99_ms",
+                "deadline_miss_rate"} <= set(row["hi"])
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+
+
 def test_resilience_smoke_in_watch_jobs():
     """ISSUE 3: the resilience chaos smoke is in the tunnel-up capture
     list.  Unlike the bench jobs it IS bounded by --job_timeout: its

@@ -15,7 +15,15 @@ and prefill runs in schedulable chunks interleaved with decode
 dense one-request-at-a-time path instead.  Engine geometry and
 backpressure come from ``cfg.inference`` (--max_batch_slots, --page_size,
 --page_watermark, --max_queued_requests: overflow answers a structured 503
-with Retry-After, docs/guide/serving.md).
+with an EMA-drain Retry-After, docs/guide/serving.md).
+
+Scheduling is pluggable (``--sched_policy fcfs|priority|slo``,
+generation/scheduling/): requests may carry ``priority`` (0 = most
+urgent) and ``ttft_deadline_ms``/``tpot_deadline_ms`` fields; priority
+and slo policies reorder admission, preempt low-value decodes by page
+release (resume is bitwise through the prefix cache), and shed requests
+whose deadline is already unmeetable.  ``--sched_aging_s`` bounds
+starvation, ``--sched_quota "0:64,2:16"`` bounds queue depth per class.
 """
 
 from __future__ import annotations
@@ -99,6 +107,8 @@ def main():
         engine = ContinuousBatchingEngine(cfg, params, tokenizer, mesh=mesh)
     server = MegatronServer(engine)
     kind = "legacy" if args.legacy_engine else "continuous-batching"
+    if not args.legacy_engine:
+        kind += f", sched={engine.policy.name}"
     print(f"serving ({kind}) on http://{args.host}:{args.port}/api",
           flush=True)
     server.run(args.host, args.port)

@@ -115,6 +115,13 @@ JOBS = [
     ("bench_decode_prefix",
      [sys.executable, "bench_decode.py", "--mode", "shared_prefix"],
      False, _bench_on_tpu),
+    # ISSUE 7: scheduling control plane — mixed-priority overload through
+    # fcfs/priority/slo policies: per-class p50/p99 TTFT, deadline-miss
+    # rate, preemption counts (bench_decode.py --mode slo,
+    # engine_decode_slo evidence)
+    ("bench_decode_slo",
+     [sys.executable, "bench_decode.py", "--mode", "slo"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
