@@ -193,8 +193,12 @@ class AsyncCheckpointSaver:
     """
 
     def __init__(self):
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # caller-side only
+        # written by the writer thread, read+cleared by the caller; the
+        # join() in wait() orders the WRITE, but the lock makes the
+        # cross-thread handoff explicit and checkable — guarded by _err_lock
         self._error: Optional[BaseException] = None
+        self._err_lock = threading.Lock()
 
     @property
     def pending(self) -> bool:
@@ -236,15 +240,17 @@ class AsyncCheckpointSaver:
                 save_checkpoint(cfg, save_dir, iteration, params, opt_state,
                                 consumed_samples, extra_state)
         except BaseException as e:
-            self._error = e
+            with self._err_lock:
+                self._error = e
 
     def wait(self) -> None:
         """Join any pending write; re-raise its error on the caller."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
-        if self._error is not None:
+        with self._err_lock:
             err, self._error = self._error, None
+        if err is not None:
             raise err
 
 

@@ -88,7 +88,11 @@ class BatchPrefetcher:
         full_gbs: Optional[int] = None,
     ):
         self.place_fn = place_fn
+        # the worker SWAPS the source mid-stream (ramp-up -> full-batch
+        # switch) while close() reads it from the consumer thread to
+        # propagate shutdown — guarded by _src_lock
         self._source = source
+        self._src_lock = threading.Lock()
         self._gbs_fn = gbs_fn
         self._chunk_size = chunk_size
         self._consumed = consumed_samples
@@ -118,7 +122,8 @@ class BatchPrefetcher:
         return False
 
     def _worker(self) -> None:
-        src = self._source
+        with self._src_lock:
+            src = self._source
         consumed = self._consumed
         chunking = self._chunk_size is not None
         steps = 0
@@ -131,7 +136,9 @@ class BatchPrefetcher:
                         and self._switch_source is not None):
                     # ramp finished: the same switch the synchronous loop
                     # makes — steady state pays no per-step concatenation
-                    src = self._source = self._switch_source(consumed)
+                    src = self._switch_source(consumed)
+                    with self._src_lock:
+                        self._source = src
                     chunking = False
                     self.switched_full = True
                 if chunking:
@@ -192,7 +199,9 @@ class BatchPrefetcher:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        src_close = getattr(self._source, "close", None)
+        with self._src_lock:
+            source = self._source
+        src_close = getattr(source, "close", None)
         if callable(src_close):
             try:
                 src_close()

@@ -508,22 +508,31 @@ class ContinuousBatchingEngine:
         self.cache = (PrefixCache(self.pool, self.page_size)
                       if use_cache and self.prefill_chunk else None)
 
+        # host-side slot state + scheduler queues: every attribute marked
+        # "guarded by _lock" below is shared between submitter threads,
+        # the background scheduler and drive-through callers — graftcheck's
+        # lock-discipline rule enforces the with-blocks / '# holds'
+        # annotations (docs/guide/static-analysis.md)
         s = self.max_slots
+        # guarded by _lock
         self._block_tables = np.zeros((s, self.pages_per_seq), np.int32)
-        self._positions = np.zeros((s,), np.int32)
-        self._tokens = np.zeros((s,), np.int32)
-        self._temperature = np.ones((s,), np.float32)
-        self._top_k = np.ones((s,), np.int32)  # idle slots decode greedy
-        self._top_p = np.zeros((s,), np.float32)
-        self._keys = np.zeros((s, 2), np.uint32)
-        self._steps = np.zeros((s,), np.int32)
+        self._positions = np.zeros((s,), np.int32)    # guarded by _lock
+        self._tokens = np.zeros((s,), np.int32)       # guarded by _lock
+        self._temperature = np.ones((s,), np.float32)  # guarded by _lock
+        # idle slots decode greedy — guarded by _lock
+        self._top_k = np.ones((s,), np.int32)
+        self._top_p = np.zeros((s,), np.float32)      # guarded by _lock
+        self._keys = np.zeros((s, 2), np.uint32)      # guarded by _lock
+        self._steps = np.zeros((s,), np.int32)        # guarded by _lock
+        # guarded by _lock
         self._slots: List[Optional[EngineRequest]] = [None] * s
 
-        self._queue: deque = deque()
-        self._prefill_q: deque = deque()  # admitted, prompt not yet filled
+        self._queue: deque = deque()  # guarded by _lock
+        # admitted, prompt not yet filled — guarded by _lock
+        self._prefill_q: deque = deque()
         # worst-case pages admitted-but-not-yet-held; admission keeps
         # free + evictable >= committed (+ watermark) so decode-time allocs
-        # can never deadlock an in-flight slot
+        # can never deadlock an in-flight slot — guarded by _lock
         self._committed = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -531,7 +540,7 @@ class ContinuousBatchingEngine:
         # mutation is under _lock, device dispatch under _drive_lock
         self._drive_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
-        self._stopping = False
+        self._stopping = False  # guarded by _lock
 
         self._tick_fn = None
         self._prefill_fns: Dict[Tuple[int, bool], object] = {}
@@ -539,8 +548,8 @@ class ContinuousBatchingEngine:
         self._copy_fn = None
         # device mirror of the per-slot arrays; rebuilt from the host copies
         # whenever admission/retirement changes the slot layout
-        self._dev_state: Optional[Tuple] = None
-        self._dirty = True
+        self._dev_state: Optional[Tuple] = None  # guarded by _lock
+        self._dirty = True  # guarded by _lock
         # tick/cache telemetry for the decode bench
         self.ticks = 0
         self.ticked_tokens = 0
@@ -552,11 +561,15 @@ class ContinuousBatchingEngine:
         self.preemptions = 0
         self.shed_requests = 0
         self.deadline_misses = 0
-        self._seqno = 0          # submit order, stable policy tie-break
-        self._ema_tick_s: Optional[float] = None    # decode-tick wall EMA
-        self._ema_retire_s: Optional[float] = None  # inter-retire EMA
-        self._last_retire_t: Optional[float] = None
-        self._queued_prios: Set[int] = set()  # label sets ever published
+        # submit order, stable policy tie-break — guarded by _lock
+        self._seqno = 0
+        # decode-tick wall EMA — guarded by _lock
+        self._ema_tick_s: Optional[float] = None
+        # inter-retire EMA — guarded by _lock
+        self._ema_retire_s: Optional[float] = None
+        self._last_retire_t: Optional[float] = None  # guarded by _lock
+        # label sets ever published — guarded by _lock
+        self._queued_prios: Set[int] = set()
         # registry instruments, resolved once (observability/registry.py):
         # per-tick updates must stay dict-free on the scheduler thread
         reg = obs_registry.get_registry()
@@ -819,7 +832,7 @@ class ContinuousBatchingEngine:
                 self._work.notify()
         return req
 
-    def _drain_eta(self, depth: int) -> float:
+    def _drain_eta(self, depth: int) -> float:  # holds _lock
         """Seconds until ``depth`` queued requests likely drain — the
         EMA retirement interval (tick EMA before any retirement), clamped
         to [1, 60].  This is the Retry-After a 503 carries, so it tracks
@@ -830,11 +843,11 @@ class ContinuousBatchingEngine:
             return 1.0
         return min(60.0, max(1.0, depth * per))
 
-    def _overload_info(self) -> dict:
+    def _overload_info(self) -> dict:  # holds _lock
         return {"queued": len(self._queue), "policy": self.policy.name,
                 "active_slots": sum(r is not None for r in self._slots)}
 
-    def _publish_queued_locked(self, force: bool = False) -> None:
+    def _publish_queued_locked(self, force: bool = False) -> None:  # holds _lock
         """THE queue-depth gauge update point (total + per-priority
         labels) — every enqueue/admit/preempt/shed path funnels here, so
         the gauges can never disagree with each other.  ``force`` is the
@@ -858,7 +871,7 @@ class ContinuousBatchingEngine:
         total = min(len(req.prompt) + req.max_new_tokens, self.max_seq)
         return -(-total // self.page_size)
 
-    def _sched_state(self, now: float) -> SchedulerState:
+    def _sched_state(self, now: float) -> SchedulerState:  # holds _lock
         """Read-only snapshot for policy decisions (under _lock)."""
         return SchedulerState(
             now=now,
@@ -945,7 +958,7 @@ class ContinuousBatchingEngine:
             except Exception as e:  # noqa: BLE001 — surface to the waiter
                 self._fail(req, e)
 
-    def _preempt_locked(self, victim: EngineRequest) -> None:
+    def _preempt_locked(self, victim: EngineRequest) -> None:  # holds _lock
         """Preemption by page release: park the victim's finished KV
         pages in the prefix-cache trie, release every page it holds
         (trie-registered ones go cached-idle, the rest go free), return
@@ -982,7 +995,8 @@ class ContinuousBatchingEngine:
         self._publish_queued_locked()
         self._dirty = True
 
-    def _shed_locked(self, req: EngineRequest, reason: str) -> None:
+    def _shed_locked(self, req: EngineRequest,
+                     reason: str) -> None:  # holds _lock
         """Drop a QUEUED request (owns no pages): fail its future with a
         retryable :class:`RequestShed` carrying the drain estimate."""
         req.shed = True
@@ -1029,7 +1043,8 @@ class ContinuousBatchingEngine:
 
     # ---- chunked admission ----
 
-    def _plan_chunked(self, req: EngineRequest, slot: int) -> Optional[dict]:
+    def _plan_chunked(self, req: EngineRequest,
+                      slot: int) -> Optional[dict]:  # holds _lock
         """Under _lock: match the prefix cache, check the page budget,
         allocate the suffix pages, and reserve the slot.  None = can't
         admit now (matched refs undone).  Works on the request's
@@ -1112,7 +1127,7 @@ class ContinuousBatchingEngine:
     # ---- monolithic admission (prefill_chunk=0, PR 1 semantics) ----
 
     def _plan_monolithic(self, req: EngineRequest,
-                         slot: int) -> Optional[dict]:
+                         slot: int) -> Optional[dict]:  # holds _lock
         pages = self.pool.alloc(self._max_pages_for(req))
         if pages is None:
             return None
@@ -1157,7 +1172,8 @@ class ContinuousBatchingEngine:
 
     # ---- shared lifecycle tail ----
 
-    def _activate(self, req: EngineRequest, slot: int) -> None:
+    def _activate(self, req: EngineRequest,
+                  slot: int) -> None:  # holds _lock
         """Under _lock: install the slot's decode state (effective prompt
         fully in pages); the next tick samples the next token by
         re-feeding the last token at position len(seq) - 1 — identical
@@ -1187,7 +1203,8 @@ class ContinuousBatchingEngine:
         with self._lock:
             self._fail_locked(req, e)
 
-    def _fail_locked(self, req: EngineRequest, e: Exception) -> None:
+    def _fail_locked(self, req: EngineRequest,
+                     e: Exception) -> None:  # holds _lock
         if 0 <= req._slot < len(self._slots) \
                 and self._slots[req._slot] is req:
             self._slots[req._slot] = None
@@ -1201,7 +1218,7 @@ class ContinuousBatchingEngine:
         req.finished = True
         req._done.set()
 
-    def _retire(self, slot: int) -> None:
+    def _retire(self, slot: int) -> None:  # holds _lock
         req = self._slots[slot]
         self._slots[slot] = None
         self._block_tables[slot] = NULL_PAGE
@@ -1469,7 +1486,11 @@ class ContinuousBatchingEngine:
         """Run the scheduler loop in a daemon thread (server mode)."""
         if self._thread is not None:
             return
-        self._stopping = False
+        # under _work: a racing stop() must not interleave between this
+        # write and the thread starting (found by graftcheck's
+        # lock-discipline rule — the write was bare)
+        with self._work:
+            self._stopping = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 

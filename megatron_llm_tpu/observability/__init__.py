@@ -15,10 +15,13 @@ run:
 * ``flops``    — config-derived flops/MFU math shared by driver, bench
   and registry.
 
-Package-wide contract, enforced by tools/linter.py: nothing in here may
-sync the device — observability must never perturb the overlap it
-measures (the PR-2 bitwise-identical-loss guarantee includes running
-with every instrument on).
+Package-wide contract, enforced by the ``obs-no-sync`` graftcheck rule
+(docs/guide/static-analysis.md): nothing in here may sync the device —
+no ``jax.device_get``, no ``block_until_ready`` — because observability
+must never perturb the overlap it measures (the PR-2
+bitwise-identical-loss guarantee includes running with every instrument
+on).  This docstring can name those calls only because the rule is
+AST-based: prose is prose, a call is a finding.
 """
 
 from megatron_llm_tpu.observability import flops, registry, trace

@@ -65,8 +65,10 @@ class ProfileTrigger:
         self._start_fn = start_fn
         self._stop_fn = stop_fn
         self._lock = threading.Lock()
-        self._requested: Optional[int] = None  # steps wanted, not started
-        self._remaining: Optional[int] = None  # steps left in live capture
+        # steps wanted, not started — guarded by _lock
+        self._requested: Optional[int] = None
+        # steps left in live capture — guarded by _lock
+        self._remaining: Optional[int] = None
         self.captures = 0
         self.capture_dirs: List[str] = []
 

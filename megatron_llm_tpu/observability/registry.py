@@ -84,7 +84,7 @@ class Counter(_Instrument):
 
     def __init__(self):
         super().__init__()
-        self._value = 0.0
+        self._value = 0.0  # guarded by _lock
 
     def inc(self, v: float = 1.0) -> None:
         if v < 0:
@@ -105,7 +105,7 @@ class GaugeMetric(_Instrument):
 
     def __init__(self):
         super().__init__()
-        self._value = 0.0
+        self._value = 0.0  # guarded by _lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -132,9 +132,9 @@ class Histogram(_Instrument):
         if not bs or bs[-1] != float("inf"):
             bs.append(float("inf"))
         self.buckets = tuple(bs)
-        self._counts = [0] * len(self.buckets)
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * len(self.buckets)  # guarded by _lock
+        self._sum = 0.0    # guarded by _lock
+        self._count = 0    # guarded by _lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -178,7 +178,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded by _lock
 
     # ---- get-or-create ----
 

@@ -5,10 +5,10 @@ The async training loop (training.py) and the decode engine
 critical path; a tracer that synchronized — or even allocated without
 bound — would undo exactly the overlap it is supposed to make visible
 (T3, PAPERS.md: overlap is only tunable when it can be SEEN).  So this
-module obeys two hard rules, enforced by a lint rule
-(tools/linter.py): nothing in ``observability/`` may touch the device,
-and every record is O(1) into a fixed-capacity ring (old events drop,
-the hot path never blocks on I/O).
+module obeys two hard rules, enforced by the ``obs-no-sync`` graftcheck
+rule (docs/guide/static-analysis.md): nothing in ``observability/`` may
+touch the device, and every record is O(1) into a fixed-capacity ring
+(old events drop, the hot path never blocks on I/O).
 
 Usage::
 
@@ -100,10 +100,11 @@ class SpanTracer:
         self.capacity = max(int(capacity), 16)
         self.enabled = bool(enabled)
         self._epoch = time.perf_counter()
-        self._buf: deque = deque(maxlen=self.capacity)
+        self._buf: deque = deque(maxlen=self.capacity)  # guarded by _lock
         self._lock = threading.Lock()
-        self._total = 0
-        self._dropped = 0  # evictions, NOT reset by drain (honest dumps)
+        self._total = 0  # guarded by _lock
+        # evictions, NOT reset by drain (honest dumps) — guarded by _lock
+        self._dropped = 0
 
     # ---- recording (hot path) ----
 

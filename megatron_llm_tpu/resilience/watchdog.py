@@ -98,10 +98,10 @@ class StepWatchdog:
         self._exit_fn = exit_fn
         self._exit_code = exit_code
         self._stream = stream
-        self._ema: Optional[float] = None
-        self._deadline: Optional[float] = None  # monotonic
+        self._ema: Optional[float] = None  # driver-thread only (no lock)
+        self._deadline: Optional[float] = None  # guarded by _cond
         self._cond = threading.Condition()
-        self._shutdown = False
+        self._shutdown = False  # guarded by _cond
         self.expired = False
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="step-watchdog"

@@ -1,0 +1,22 @@
+"""graftcheck — AST-based invariant analyzer for this repo.
+
+See tools/graftcheck/core.py for the design and
+docs/guide/static-analysis.md for the rule catalog, the suppression and
+baseline workflow, and how to add a rule.
+
+    python -m tools.graftcheck megatron_llm_tpu tools tasks tests
+"""
+
+from tools.graftcheck.core import (  # noqa: F401 — public API
+    BASELINE_DEFAULT,
+    FileContext,
+    Finding,
+    Rule,
+    RuleCrash,
+    RunResult,
+    check_file,
+    load_baseline,
+    main,
+    run,
+    save_baseline,
+)

@@ -1,0 +1,507 @@
+"""graftcheck core: file model, rule protocol, baseline, runner, CLI.
+
+The analyzer exists because this repo's expensive failures are *static*
+properties: a direct jax shard_map import compiles on modern jax and
+breaks the pinned 0.4.37 container (the 8-test regression of PR 6's
+prehistory); a compiled-program cache keyed on ``id()`` serves a stale
+executable after GC recycles the id (PR 1); an instrument that syncs the
+device destroys the PR-2/PR-4 overlap it measures; and unguarded shared
+state races exactly once a quarter, in production.  A regex line scanner
+(the old tools/linter.py) cannot see scope — it flagged spellings inside
+docstrings and missed aliased calls — so every rule here works on the
+``ast`` module's view of the file (stdlib only, no third-party deps).
+
+Vocabulary:
+
+* **Finding** — one (path, line, rule, message) diagnostic.
+* **Rule** — a class with an ``id``, a one-line ``summary``, and
+  ``check(ctx)`` yielding findings for one file.
+* **Suppression** — ``# graftcheck: noqa[rule-id]`` on the offending
+  line (with a reason after it, by convention).  Bare
+  ``# graftcheck: noqa`` suppresses every rule on that line.
+* **Baseline** — ``tools/graftcheck/baseline.json``: grandfathered
+  findings keyed by (path, rule, stripped source line) so they survive
+  line-number drift.  Baselined findings don't fail the run; every entry
+  carries a human reason.
+
+Exit codes (the tools/resilience_smoke.py convention, so the tpu_watch
+predicate can tell an analyzer crash from real findings):
+
+* 0 — clean (no findings outside the baseline)
+* 1 — findings
+* 2 — internal error (a rule crashed, bad arguments, unreadable file)
+
+Usage::
+
+    python -m tools.graftcheck megatron_llm_tpu tools tasks tests
+    python -m tools.graftcheck --json <targets>
+    python -m tools.graftcheck --update-baseline <targets>
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import time
+import tokenize
+import traceback
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_NOQA_RE = re.compile(r"graftcheck:\s*noqa(?:\[([^\]]*)\])?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic.  ``path`` is the path as reported (relative to the
+    invocation root when possible), ``line`` 1-based."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    baselined: bool = False
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def json_obj(self) -> Dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message,
+                "baselined": self.baselined}
+
+
+def qualname(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ('jax.random.split'), else
+    None — the single spelling-resolution helper every rule shares."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FileContext:
+    """Everything a rule needs about one file, computed once: source
+    lines, the AST (or the syntax error), per-line comments (the ast
+    module drops them — ``tokenize`` recovers them for the annotation
+    grammars), per-line noqa sets, and a child->parent node map."""
+
+    def __init__(self, path: str, source: Optional[str] = None,
+                 relpath: Optional[str] = None):
+        self.path = path
+        self.relpath = relpath if relpath is not None else path
+        if source is None:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        self.source = source
+        self.lines: List[str] = source.splitlines()
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(source)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        # line -> comment text (without the leading '#', stripped)
+        self.comments: Dict[int, str] = {}
+        # line -> None (suppress all) or set of rule ids
+        self.noqa: Dict[int, Optional[Set[str]]] = {}
+        self._scan_comments()
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        if self.tree is not None:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in toks:
+                if tok.type == tokenize.COMMENT:
+                    text = tok.string.lstrip("#").strip()
+                    line = tok.start[0]
+                    # keep the first comment on a line (inline ones)
+                    self.comments.setdefault(line, text)
+                    m = _NOQA_RE.search(tok.string)
+                    if m:
+                        if m.group(1) is None:
+                            self.noqa[line] = None  # suppress every rule
+                        elif not (line in self.noqa
+                                  and self.noqa[line] is None):
+                            ids = {r.strip() for r in m.group(1).split(",")
+                                   if r.strip()}
+                            self.noqa[line] = \
+                                (self.noqa.get(line) or set()) | ids
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # comments stay partial; AST rules still run if it parsed
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        if line not in self.noqa:
+            return False
+        ids = self.noqa[line]
+        return ids is None or rule_id in ids
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Rule:
+    """Base class: subclasses set ``id`` + ``summary`` and implement
+    ``check``.  ``summary`` is the one-liner shown by ``--list-rules``;
+    the *why* lives in docs/guide/static-analysis.md."""
+
+    id: str = ""
+    summary: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node, message: str) -> Finding:
+        if isinstance(node, int):
+            line, col = node, 0
+        else:
+            line, col = node.lineno, getattr(node, "col_offset", 0)
+        return Finding(path=ctx.relpath, line=line, col=col,
+                       rule=self.id, message=message)
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def _baseline_key(path: str, rule: str, line_text: str):
+    return (path.replace(os.sep, "/"), rule, line_text.strip())
+
+
+def load_baseline(path: str) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("entries", []))
+
+
+def save_baseline(path: str, entries: List[Dict]) -> None:
+    entries = sorted(entries, key=lambda e: (e["path"], e["rule"], e["line"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], entries: List[Dict],
+                   line_text_of) -> List[Dict]:
+    """Mark findings that match a baseline entry (by path + rule +
+    stripped source line; each entry absorbs up to ``count`` findings,
+    default 1).  Returns the STALE entries — present in the baseline but
+    matching nothing, which means the underlying code was fixed and the
+    entry should be deleted."""
+    remaining: Dict[tuple, int] = {}
+    for e in entries:
+        key = _baseline_key(e["path"], e["rule"], e["line"])
+        remaining[key] = remaining.get(key, 0) + int(e.get("count", 1))
+    for f in findings:
+        key = _baseline_key(f.path, f.rule, line_text_of(f))
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            f.baselined = True
+    stale = []
+    for e in entries:
+        key = _baseline_key(e["path"], e["rule"], e["line"])
+        if remaining.get(key, 0) > 0:
+            remaining[key] = 0
+            stale.append(e)
+    return stale
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules"}
+
+
+def iter_py_files(targets: Sequence[str]) -> Iterator[str]:
+    for target in targets:
+        if os.path.isfile(target):
+            yield target
+            continue
+        if not os.path.isdir(target):
+            # a typo'd target silently reporting "clean" would be the
+            # worst kind of green CI — fail loudly (exit 2 via main)
+            raise FileNotFoundError(f"target does not exist: {target}")
+        for root, dirs, files in os.walk(target):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS
+                             and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+class RuleCrash(Exception):
+    """A rule blew up on a file: the run is unsound, exit 2 — the watch
+    predicate must see 'analyzer broken', not 'repo clean'."""
+
+
+def check_file(path: str, rules: Sequence[Rule], root: Optional[str] = None,
+               source: Optional[str] = None) -> List[Finding]:
+    """All (unsuppressed) findings for one file.  Raises RuleCrash when a
+    rule raises — callers decide whether that is fatal (CLI: exit 2)."""
+    relpath = path
+    if root is not None:
+        try:
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                relpath = rel
+        except ValueError:
+            pass
+    ctx = FileContext(path, source=source, relpath=relpath)
+    findings: List[Finding] = []
+    if ctx.syntax_error is not None:
+        findings.append(Finding(
+            path=ctx.relpath, line=ctx.syntax_error.lineno or 1, col=0,
+            rule="parse-error",
+            message=f"file does not parse: {ctx.syntax_error.msg}"))
+        return findings
+    for rule in rules:
+        try:
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f.line, rule.id):
+                    findings.append(f)
+        except Exception as e:
+            raise RuleCrash(
+                f"rule {rule.id!r} crashed on {path}: "
+                f"{type(e).__name__}: {e}") from e
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: List[Finding]
+    stale_baseline: List[Dict]
+    files: int
+    seconds: float
+    rules: List[str]
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+    def json_obj(self) -> Dict:
+        return {
+            "graftcheck": 1,
+            "rules": self.rules,
+            "files": self.files,
+            "seconds": round(self.seconds, 3),
+            "findings": [f.json_obj() for f in self.findings],
+            "counts": {"total": len(self.findings),
+                       "active": len(self.active),
+                       "baselined": len(self.baselined),
+                       "stale_baseline": len(self.stale_baseline)},
+            "exit": self.exit_code,
+        }
+
+
+def run(targets: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+        baseline_path: Optional[str] = BASELINE_DEFAULT,
+        root: Optional[str] = None) -> RunResult:
+    """Analyze ``targets`` (files or directories) and apply the baseline.
+    The library entry point — the CLI, the linter shim, and the tier-1
+    sweep test all come through here."""
+    from tools.graftcheck.rules import ALL_RULES
+
+    rules = list(rules if rules is not None else ALL_RULES)
+    root = root if root is not None else os.getcwd()
+    t0 = time.perf_counter()
+    findings: List[Finding] = []
+    line_texts: Dict[str, List[str]] = {}
+    nfiles = 0
+    for path in iter_py_files(targets):
+        nfiles += 1
+        fs = check_file(path, rules, root=root)
+        if fs:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                line_texts[fs[0].path] = f.read().splitlines()
+        findings.extend(fs)
+
+    def line_text_of(f: Finding) -> str:
+        lines = line_texts.get(f.path, [])
+        return lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+
+    entries = load_baseline(baseline_path) if baseline_path else []
+    stale = apply_baseline(findings, entries, line_text_of)
+    return RunResult(findings=findings, stale_baseline=stale, files=nfiles,
+                     seconds=time.perf_counter() - t0,
+                     rules=sorted(r.id for r in rules))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _update_baseline(result: RunResult, baseline_path: str,
+                     line_text_of=None) -> int:
+    """Rewrite the baseline from the current findings, keeping the
+    hand-written reasons of entries that still match.  New entries get an
+    empty reason — the committer must fill it in (the tier-1 test refuses
+    a baseline with unexplained entries)."""
+    old = {}
+    for e in load_baseline(baseline_path):
+        old[_baseline_key(e["path"], e["rule"], e["line"])] = \
+            e.get("reason", "")
+    counts: Dict[tuple, int] = {}
+    for f in result.findings:
+        text = f.line_source if hasattr(f, "line_source") else ""
+        key = (f.path, f.rule, text)
+        counts[key] = counts.get(key, 0) + 1
+    entries = []
+    for (path, rule, text), n in sorted(counts.items()):
+        entry = {"path": path.replace(os.sep, "/"), "rule": rule,
+                 "line": text,
+                 "reason": old.get((path.replace(os.sep, "/"), rule,
+                                    text.strip()), "")}
+        if n > 1:
+            entry["count"] = n
+        entries.append(entry)
+    save_baseline(baseline_path, entries)
+    print(f"graftcheck: baseline updated: {len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path}")
+    missing = sum(1 for e in entries if not e["reason"])
+    if missing:
+        print(f"graftcheck: {missing} entr"
+              f"{'y needs' if missing == 1 else 'ies need'} a reason "
+              f"before committing")
+    return 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="AST-based invariant analyzer "
+                    "(docs/guide/static-analysis.md)")
+    ap.add_argument("targets", nargs="*", default=["megatron_llm_tpu"],
+                    help="files or directories (default: megatron_llm_tpu)")
+    ap.add_argument("--json", action="store_true",
+                    help="one-line JSON summary on stdout")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline file (default: tools/graftcheck/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(preserves reasons of surviving entries)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def _main(argv: Optional[Sequence[str]]) -> int:
+    from tools.graftcheck.rules import ALL_RULES
+
+    args = make_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:24s} {rule.summary}")
+        return 0
+    rules = ALL_RULES
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        known = {r.id for r in ALL_RULES}
+        unknown = wanted - known
+        if unknown:
+            print(f"graftcheck: unknown rule(s): {sorted(unknown)}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+        rules = [r for r in ALL_RULES if r.id in wanted]
+    baseline = None if args.no_baseline else args.baseline
+
+    if args.update_baseline:
+        # findings need their source line for stable keys
+        result = run(args.targets, rules=rules, baseline_path=None)
+        texts: Dict[str, List[str]] = {}
+        for f in result.findings:
+            if f.path not in texts:
+                path = f.path if os.path.exists(f.path) else None
+                if path is None:
+                    texts[f.path] = []
+                else:
+                    with open(path, encoding="utf-8",
+                              errors="replace") as fh:
+                        texts[f.path] = fh.read().splitlines()
+            lines = texts[f.path]
+            f.line_source = (lines[f.line - 1].strip()
+                             if 1 <= f.line <= len(lines) else "")
+        return _update_baseline(result, args.baseline)
+
+    result = run(args.targets, rules=rules, baseline_path=baseline)
+    if args.json:
+        print(json.dumps(result.json_obj(), sort_keys=True))
+    else:
+        for f in result.active:
+            print(f.text())
+        for e in result.stale_baseline:
+            print(f"graftcheck: stale baseline entry (code was fixed — "
+                  f"delete it): {e['path']} [{e['rule']}] {e['line']!r}")
+        n = len(result.active)
+        print(f"graftcheck: {n} finding(s) "
+              f"({len(result.baselined)} baselined) in {result.files} "
+              f"files, {len(result.rules)} rules, "
+              f"{result.seconds:.1f}s")
+    return result.exit_code
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI entry: 0 clean / 1 findings / 2 internal error."""
+    try:
+        return _main(argv)
+    except SystemExit as e:  # argparse --help / usage errors
+        code = e.code if isinstance(e.code, int) else 2
+        return code
+    except RuleCrash as e:
+        print(f"graftcheck: internal error: {e}", file=sys.stderr)
+        traceback.print_exc()
+        return 2
+    except Exception as e:  # noqa: BLE001 — exit-code contract
+        print(f"graftcheck: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        traceback.print_exc()
+        return 2

@@ -1,0 +1,38 @@
+"""Rule registry.  Adding a rule: write a ``Rule`` subclass in a module
+here, instantiate it in ``ALL_RULES``, document it in
+docs/guide/static-analysis.md, and give it positive/negative/suppressed
+fixtures in tests/test_graftcheck.py.
+"""
+
+from __future__ import annotations
+
+from tools.graftcheck.rules.locks import LockDisciplineRule
+from tools.graftcheck.rules.recompile import RecompileHazardRule
+from tools.graftcheck.rules.rng import RngKeyReuseRule
+from tools.graftcheck.rules.shardmap import NoDirectShardMapRule
+from tools.graftcheck.rules.style import (
+    LineLengthRule,
+    TabsRule,
+    TodoOwnerRule,
+    TrailingWhitespaceRule,
+)
+from tools.graftcheck.rules.sync import ObsNoSyncRule, SyncInJitRule
+
+# ported from the regex linter (now scope-aware) ........ then the new
+# invariant analyzers, then lexical hygiene
+ALL_RULES = [
+    TodoOwnerRule(),
+    ObsNoSyncRule(),
+    NoDirectShardMapRule(),
+    SyncInJitRule(),
+    LockDisciplineRule(),
+    RngKeyReuseRule(),
+    RecompileHazardRule(),
+    LineLengthRule(),
+    TabsRule(),
+    TrailingWhitespaceRule(),
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"]

@@ -1,0 +1,220 @@
+"""lock-discipline: annotation-driven race detection for shared state.
+
+The threaded subsystems (generation/engine.py, data/prefetch.py,
+checkpointing.AsyncCheckpointSaver, observability/, resilience/
+watchdog.py) all follow the same convention: one lock per object, every
+shared attribute touched only while holding it.  The convention was
+enforced by review only — this rule makes it checkable:
+
+* In ``__init__``, annotate a shared attribute on its assignment line::
+
+      self._queue = deque()   # guarded by _lock
+
+  Multiple acceptable locks: ``# guarded by _lock, _work``.
+
+* A ``threading.Condition(self._lock)`` assignment makes the two names
+  aliases — ``with self._work:`` acquires ``_lock``, so either spelling
+  satisfies a guard on the other.
+
+* A method the CALLER must hold the lock for declares it on its ``def``
+  line::
+
+      def _retire(self, slot):  # holds _lock
+
+  Inside such a method, guarded accesses are legal; every CALL SITE of
+  the method must itself be under ``with self.<lock>:`` (or in another
+  ``holds`` method) — the rule checks both directions, which is what
+  makes it a race detector rather than a style check.
+
+Accesses in ``__init__`` are exempt (no concurrency before construction
+completes and the thread is started).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.core import FileContext, Finding, Rule, qualname
+
+_GUARDED_RE = re.compile(r"guarded by\s+([A-Za-z_][\w.,|\s]*)")
+_HOLDS_RE = re.compile(r"holds\s+([A-Za-z_][\w.,|\s]*)")
+
+
+def _lock_names(spec: str) -> Set[str]:
+    out = set()
+    for part in re.split(r"[,|]", spec):
+        name = part.strip()
+        if name.startswith("self."):
+            name = name[len("self."):]
+        if name:
+            out.add(name)
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Annotation state for one class: guarded attrs, lock alias groups,
+    and holds-annotated methods."""
+
+    def __init__(self) -> None:
+        self.guards: Dict[str, Set[str]] = {}   # attr -> acceptable locks
+        self.groups: Dict[str, Set[str]] = {}   # lock -> alias set (shared)
+        self.holds: Dict[str, Set[str]] = {}    # method -> locks held
+
+    def union(self, a: str, b: str) -> None:
+        ga = self.groups.setdefault(a, {a})
+        gb = self.groups.setdefault(b, {b})
+        if ga is gb:
+            return
+        ga |= gb
+        for name in gb:
+            self.groups[name] = ga
+
+    def expand(self, locks: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for lock in locks:
+            out |= self.groups.get(lock, {lock})
+        return out
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    summary = ("attrs annotated '# guarded by <lock>' accessed outside "
+               "'with self.<lock>:'")
+
+    # ---- model building ----
+
+    def _def_comment(self, ctx: FileContext, fn: ast.AST,
+                     pattern: re.Pattern) -> Set[str]:
+        """Annotation comment anywhere on the (possibly multi-line)
+        signature, from the ``def`` line to the line before the body."""
+        end = fn.body[0].lineno if fn.body else fn.lineno + 1
+        for line in range(fn.lineno, end + 1):
+            m = pattern.search(ctx.comment_on(line))
+            if m:
+                return _lock_names(m.group(1))
+        return set()
+
+    def _build(self, ctx: FileContext,
+               cls: ast.ClassDef) -> Optional[_ClassModel]:
+        model = _ClassModel()
+        init = None
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name == "__init__":
+                    init = stmt
+                held = self._def_comment(ctx, stmt, _HOLDS_RE)
+                if held:
+                    model.holds[stmt.name] = held
+        if init is not None:
+            for node in ast.walk(init):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                attrs = [a for a in (_self_attr(t) for t in targets) if a]
+                if not attrs:
+                    continue
+                # annotation on the assignment line, or on a comment line
+                # immediately above it (long assignments push it up)
+                m = _GUARDED_RE.search(ctx.comment_on(node.lineno))
+                if m is None:
+                    above = ctx.line_text(node.lineno - 1).strip()
+                    if above.startswith("#"):
+                        m = _GUARDED_RE.search(
+                            ctx.comment_on(node.lineno - 1))
+                if m:
+                    locks = _lock_names(m.group(1))
+                    for attr in attrs:
+                        model.guards[attr] = locks
+                # alias: self.Y = threading.Condition(self.X)
+                if isinstance(value, ast.Call) and (
+                        qualname(value.func) or "").endswith("Condition") \
+                        and value.args:
+                    inner = _self_attr(value.args[0])
+                    if inner is not None:
+                        for attr in attrs:
+                            model.union(attr, inner)
+        if not model.guards and not model.holds:
+            return None
+        return model
+
+    # ---- checking ----
+
+    def _held_here(self, ctx: FileContext, node: ast.AST, method: ast.AST,
+                   model: _ClassModel, required: Set[str]) -> bool:
+        """Is one of ``required`` (or an alias) held at ``node``?  Held =
+        lexically inside ``with self.<lock>:`` within the method, or the
+        method itself is annotated to hold it."""
+        acceptable = model.expand(required)
+        held = model.expand(model.holds.get(
+            getattr(method, "name", ""), set()))
+        if held & acceptable:
+            return True
+        for anc in ctx.ancestors(node):
+            if anc is method:
+                break
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in acceptable:
+                        return True
+        return False
+
+    def _check_method(self, ctx: FileContext, method: ast.AST,
+                      model: _ClassModel) -> Iterable[Finding]:
+        for node in ast.walk(method):
+            attr = _self_attr(node) if isinstance(node, ast.Attribute) \
+                else None
+            if attr is not None and attr in model.guards:
+                required = model.guards[attr]
+                if not self._held_here(ctx, node, method, model, required):
+                    locks = "/".join(sorted(required))
+                    yield self.finding(
+                        ctx, node,
+                        f"self.{attr} is '# guarded by {locks}' but "
+                        f"accessed outside 'with self.{locks}:' (method "
+                        f"{method.name}); annotate the method "
+                        f"'# holds {locks}' if its callers hold the lock")
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee is not None and callee in model.holds \
+                        and callee != method.name:
+                    required = model.holds[callee]
+                    if not self._held_here(ctx, node, method, model,
+                                           required):
+                        locks = "/".join(sorted(required))
+                        yield self.finding(
+                            ctx, node,
+                            f"self.{callee}() requires '# holds {locks}' "
+                            f"but is called without 'with self.{locks}:' "
+                            f"(method {method.name})")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            model = self._build(ctx, cls)
+            if model is None:
+                continue
+            for stmt in cls.body:
+                if not isinstance(stmt,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name == "__init__":
+                    continue
+                yield from self._check_method(ctx, stmt, model)

@@ -1,0 +1,244 @@
+"""recompile-hazard: call patterns that silently re-trace or re-compile.
+
+Compilation is the one cost the serving/training hot paths must pay
+exactly once (the engine's tick is "compiled once per geometry" BY
+CONTRACT).  Four statically visible ways to break that:
+
+* ``id()`` used as (part of) a compiled-program cache key in a function
+  that also calls ``jax.jit`` — the literal PR-1 bug: CPython recycles a
+  freed object's id, so an id-keyed cache can serve a *different*
+  config's program, and a rebuilt-but-equal config recompiles instead of
+  hitting.  Key on content (``generation.config_fingerprint``).
+* a fresh ``lambda`` / dict / list / set / locally-defined closure passed
+  at a *static* argument position of a jitted callable — every call is a
+  new identity, so every call re-traces.
+* ``static_argnums`` naming a parameter whose default is an unhashable
+  literal — the first defaulted call raises ``TypeError: unhashable``.
+* ``jax.jit``/``cached_jit`` invoked inside a loop — re-traces (or at
+  minimum re-hashes and re-dispatches) per iteration; hoist it out.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.core import FileContext, Finding, Rule, qualname
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+               ast.SetComp)
+_FRESH_IDENTITY = _UNHASHABLE + (ast.Lambda,)
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node when ``node`` is ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)``; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fqn = qualname(node.func)
+    if fqn in _JIT_NAMES:
+        return node
+    if fqn in _PARTIAL_NAMES and node.args \
+            and qualname(node.args[0]) in _JIT_NAMES:
+        return node
+    return None
+
+
+def _static_argnums(call: ast.Call) -> Tuple[List[int], List[str]]:
+    """Literal static_argnums / static_argnames of a jit call."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, int):
+                        nums.append(elt.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        names.append(elt.value)
+    return nums, names
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    summary = ("id()-keyed jit caches, fresh unhashable static args, "
+               "jit in a loop")
+
+    # ---- (a) static params with unhashable defaults ----
+
+    def _check_decorated(self, ctx: FileContext,
+                         fn: ast.FunctionDef) -> Iterable[Finding]:
+        for dec in fn.decorator_list:
+            call = _jit_call(dec)
+            if call is None:
+                continue
+            nums, names = _static_argnums(call)
+            if not nums and not names:
+                continue
+            args = fn.args
+            params = args.posonlyargs + args.args
+            # defaults align with the TAIL of the positional params
+            defaults: Dict[str, ast.AST] = {}
+            for p, d in zip(params[len(params) - len(args.defaults):],
+                            args.defaults):
+                defaults[p.arg] = d
+            for p, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    defaults[p.arg] = d
+            static_names = set(names)
+            for i in nums:
+                if 0 <= i < len(params):
+                    static_names.add(params[i].arg)
+            for name in sorted(static_names):
+                d = defaults.get(name)
+                if d is not None and isinstance(d, _UNHASHABLE):
+                    yield self.finding(
+                        ctx, d,
+                        f"static arg '{name}' of jitted '{fn.name}' has "
+                        f"an unhashable default — the first defaulted "
+                        f"call raises TypeError (statics are dict keys)")
+
+    # ---- (b) fresh identities at static call positions ----
+
+    def _jitted_names(self, ctx: FileContext) -> Dict[str, List[int]]:
+        """name -> static positions, for ``f = jax.jit(g,
+        static_argnums=...)`` bindings."""
+        out: Dict[str, List[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = _jit_call(node.value)
+            if call is None:
+                continue
+            nums, _names = _static_argnums(call)
+            if not nums:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = nums
+        return out
+
+    def _local_defs(self, fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add(sub.name)
+        return out
+
+    def _check_static_callsites(self, ctx: FileContext
+                                ) -> Iterable[Finding]:
+        jitted = self._jitted_names(ctx)
+        if not jitted:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module)):
+                continue
+            local = self._local_defs(fn) \
+                if not isinstance(fn, ast.Module) else set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not isinstance(node.func, ast.Name) \
+                        or node.func.id not in jitted:
+                    continue
+                for pos in jitted[node.func.id]:
+                    if pos >= len(node.args):
+                        continue
+                    arg = node.args[pos]
+                    if isinstance(arg, _FRESH_IDENTITY):
+                        yield self.finding(
+                            ctx, arg,
+                            f"fresh {type(arg).__name__.lower()} at "
+                            f"static position {pos} of jitted "
+                            f"'{node.func.id}' — a new identity every "
+                            f"call means a re-trace every call")
+                    elif isinstance(arg, ast.Name) and arg.id in local:
+                        yield self.finding(
+                            ctx, arg,
+                            f"locally-defined function '{arg.id}' at "
+                            f"static position {pos} of jitted "
+                            f"'{node.func.id}' — a new closure object "
+                            f"per enclosing call re-traces every time")
+
+    # ---- (c) id()-keyed caches next to jit ----
+
+    def _check_id_keyed(self, ctx: FileContext,
+                        fn: ast.AST) -> Iterable[Finding]:
+        has_jit = False
+        id_calls: List[ast.Call] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if _jit_call(node) is not None or (
+                        qualname(node.func) or "").endswith("cached_jit"):
+                    has_jit = True
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "id":
+                    id_calls.append(node)
+        if has_jit:
+            for call in id_calls:
+                yield self.finding(
+                    ctx, call,
+                    "id() near a jit call — an id()-keyed program cache "
+                    "serves stale executables after GC recycles the id "
+                    "and misses on equal-but-rebuilt configs (the PR-1 "
+                    "cached_jit bug); key on content "
+                    "(generation.config_fingerprint)")
+
+    # ---- (d) jit inside a loop ----
+
+    def _check_jit_in_loop(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            call = _jit_call(node)
+            if call is None and not (
+                    isinstance(node, ast.Call)
+                    and (qualname(node.func) or "").endswith("cached_jit")):
+                continue
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    break
+                if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                    yield self.finding(
+                        ctx, node,
+                        "jit construction inside a loop — re-traces (and "
+                        "re-hashes statics) every iteration; hoist the "
+                        "jitted callable out of the loop")
+                    break
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        # nested functions are walked by both their own def and every
+        # enclosing scope — dedupe on (line, col, message)
+        seen: Set[Tuple[int, int, str]] = set()
+
+        def emit(fs):
+            for f in fs:
+                key = (f.line, f.col, f.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                yield from emit(self._check_decorated(ctx, node))
+        yield from emit(self._check_static_callsites(ctx))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from emit(self._check_id_keyed(ctx, node))
+        yield from emit(self._check_jit_in_loop(ctx))

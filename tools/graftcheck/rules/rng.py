@@ -1,0 +1,206 @@
+"""rng-key-reuse: a PRNG key consumed twice without an intervening split.
+
+``jax.random`` functions are deterministic in the key: sampling twice
+with the same key yields the SAME numbers, and splitting the same key
+twice yields the same children.  The engine's bitwise-resume guarantee
+(PR 7: preempted requests continue their exact sampling stream) hangs on
+pinned-key discipline — every consumption either rebinds the name
+(``key, sub = jax.random.split(key)``) or is the key's last use.  Silent
+reuse produces correlated samples that no test catches: the numbers look
+random, they are just not independent.
+
+The rule tracks plain local names within one function, in source order:
+
+* names bound from ``PRNGKey``/``key``/``split``/``fold_in`` results and
+  parameters named ``key``/``rng``/``*_key`` are tracked;
+* any ``jax.random.*`` call except ``fold_in`` (deriving many keys from
+  one base with distinct data is the documented fan-out idiom) consumes
+  the key names it is passed;
+* rebinding a name un-consumes it; ``if``/``else`` branches are analyzed
+  independently and merged conservatively (consumed only if consumed on
+  every path); loop bodies are analyzed twice so a consumption that is
+  fresh on iteration 1 but reuses on iteration 2 is caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftcheck.core import FileContext, Finding, Rule, qualname
+
+_KEY_PARAM_RE = re.compile(r"(^|_)(key|rng)$")
+# producers whose results are key-typed (assignments from these start
+# tracking the bound names)
+_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data"}
+# random-module functions that do NOT consume their key argument
+_NON_CONSUMING = {"fold_in", "PRNGKey", "key", "wrap_key_data",
+                  "key_data", "key_impl", "default_prng_impl"}
+
+
+def _random_aliases(tree: ast.AST) -> Set[str]:
+    """Module spellings that mean jax.random in this file."""
+    aliases = {"jax.random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(a.asname or "random")
+    return aliases
+
+
+class RngKeyReuseRule(Rule):
+    id = "rng-key-reuse"
+    summary = "same PRNG key consumed twice with no split/rebind between"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        self._aliases = _random_aliases(ctx.tree)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                state: Dict[str, bool] = {}  # name -> consumed?
+                args = node.args
+                params = (args.posonlyargs + args.args + args.kwonlyargs)
+                for p in params:
+                    if _KEY_PARAM_RE.search(p.arg):
+                        state[p.arg] = False
+                self._block(ctx, node.body, state, findings, seen)
+        findings.sort(key=lambda f: (f.line, f.col))
+        yield from findings
+
+    # ---- helpers ----
+
+    def _random_fname(self, call: ast.Call) -> str:
+        qn = qualname(call.func)
+        if qn is None:
+            return ""
+        mod, _, fname = qn.rpartition(".")
+        if mod in self._aliases:
+            return fname
+        return ""
+
+    def _value_produces_key(self, value: ast.AST) -> bool:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call) \
+                    and self._random_fname(sub) in _PRODUCERS:
+                return True
+        return False
+
+    def _target_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in target.elts:
+                out.extend(self._target_names(elt))
+            return out
+        return []
+
+    # ---- interpretation ----
+
+    def _expr(self, ctx: FileContext, node: ast.AST, state: Dict[str, bool],
+              findings: List[Finding], seen: Set[Tuple[int, str]]) -> None:
+        """Walk an expression in evaluation order, consuming tracked keys
+        passed to consuming jax.random calls."""
+        for child in ast.iter_child_nodes(node):
+            # nested lambdas/comprehensions get no cross-scope tracking
+            if isinstance(child, ast.Lambda):
+                continue
+            self._expr(ctx, child, state, findings, seen)
+        if isinstance(node, ast.Call):
+            fname = self._random_fname(node)
+            if fname and fname not in _NON_CONSUMING:
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in state:
+                        if state[arg.id]:
+                            key = (node.lineno, arg.id)
+                            if key not in seen:
+                                seen.add(key)
+                                findings.append(self.finding(
+                                    ctx, node,
+                                    f"PRNG key '{arg.id}' consumed again "
+                                    f"without an intervening split/rebind"
+                                    f" — identical randomness (jax keys "
+                                    f"are pure values; split first)"))
+                        else:
+                            state[arg.id] = True
+
+    def _block(self, ctx: FileContext, stmts: List[ast.stmt],
+               state: Dict[str, bool], findings: List[Finding],
+               seen: Set[Tuple[int, str]]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # analyzed as their own scope by check()
+            if isinstance(stmt, ast.If):
+                s_body, s_else = dict(state), dict(state)
+                self._block(ctx, stmt.body, s_body, findings, seen)
+                self._block(ctx, stmt.orelse, s_else, findings, seen)
+                for name in set(s_body) | set(s_else):
+                    state[name] = (s_body.get(name, False)
+                                   and s_else.get(name, False))
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    self._expr(ctx, stmt.iter, state, findings, seen)
+                else:
+                    self._expr(ctx, stmt.test, state, findings, seen)
+                body_state = dict(state)
+                # two passes: pass 2 starts from pass 1's end state, so a
+                # key consumed once per iteration without a rebind inside
+                # the loop shows up as reuse
+                self._block(ctx, stmt.body, body_state, findings, seen)
+                self._block(ctx, stmt.body, body_state, findings, seen)
+                self._block(ctx, stmt.orelse, body_state, findings, seen)
+                for name in body_state:
+                    state[name] = state.get(name, False) \
+                        or body_state[name]
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._expr(ctx, item.context_expr, state, findings,
+                               seen)
+                self._block(ctx, stmt.body, state, findings, seen)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._block(ctx, stmt.body, state, findings, seen)
+                for handler in stmt.handlers:
+                    h_state = dict(state)
+                    self._block(ctx, handler.body, h_state, findings, seen)
+                self._block(ctx, stmt.orelse, state, findings, seen)
+                self._block(ctx, stmt.finalbody, state, findings, seen)
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._expr(ctx, stmt.value, state, findings, seen)
+                names: List[str] = []
+                for t in stmt.targets:
+                    names.extend(self._target_names(t))
+                produces = self._value_produces_key(stmt.value)
+                for name in names:
+                    if produces:
+                        state[name] = False       # fresh key material
+                    elif name in state:
+                        del state[name]           # rebound to a non-key
+                continue
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._expr(ctx, stmt.value, state, findings, seen)
+                names = self._target_names(stmt.target)
+                produces = self._value_produces_key(stmt.value)
+                for name in names:
+                    if produces:
+                        state[name] = False
+                    elif name in state:
+                        del state[name]
+                continue
+            # everything else: evaluate contained expressions in order
+            self._expr(ctx, stmt, state, findings, seen)

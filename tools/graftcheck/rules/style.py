@@ -1,0 +1,62 @@
+"""Lexical hygiene rules carried over from the old line-scanner.
+
+These are the only rules that still look at raw lines — length, tabs and
+trailing whitespace are not syntactic properties.  ``todo-owner`` is the
+first beneficiary of the AST port: the old regex flagged the word TODO
+anywhere on a line, including inside string literals; the new rule only
+reads real comment tokens.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from tools.graftcheck.core import FileContext, Finding, Rule
+
+MAX_LEN = 100
+_TODO_RE = re.compile(r"\bTODO(?!\()")
+
+
+class LineLengthRule(Rule):
+    id = "line-length"
+    summary = f"lines longer than {MAX_LEN} characters"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for lineno, line in enumerate(ctx.lines, 1):
+            if len(line) > MAX_LEN:
+                yield self.finding(
+                    ctx, lineno, f"line too long ({len(line)} chars)")
+
+
+class TabsRule(Rule):
+    id = "tabs"
+    summary = "tab characters (this repo indents with spaces)"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for lineno, line in enumerate(ctx.lines, 1):
+            if "\t" in line:
+                yield self.finding(ctx, lineno, "tab character")
+
+
+class TrailingWhitespaceRule(Rule):
+    id = "trailing-whitespace"
+    summary = "trailing whitespace"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for lineno, line in enumerate(ctx.lines, 1):
+            if line != line.rstrip():
+                yield self.finding(ctx, lineno, "trailing whitespace")
+
+
+class TodoOwnerRule(Rule):
+    id = "todo-owner"
+    summary = "TODO comments without an owner — use TODO(name)"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # comment tokens only: to-do text inside a string literal is
+        # data, not a work item (the old regex couldn't tell them apart)
+        for lineno, text in sorted(ctx.comments.items()):
+            if _TODO_RE.search(text):
+                yield self.finding(
+                    ctx, lineno, "TODO without owner — use TODO(name)")

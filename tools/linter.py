@@ -1,22 +1,20 @@
-"""Minimal project linter (reference tools/linter.py analog).
+"""Thin compatibility shim over tools/graftcheck (the AST analyzer).
 
-Checks: line length, tabs, trailing whitespace, TODO-without-owner, and
-the observability no-device-sync rule: files under an ``observability``
-package directory must never call ``jax.device_get`` or
-``block_until_ready`` (nor mention them — a commented-out sync is one
-uncomment away).  Observability instruments the async training loop's
-overlap; an instrument that syncs the device destroys the thing it
-measures, and the PR-2 bitwise-loss guarantee with it.
+The regex line-scanner that used to live here is gone — every rule it
+enforced (line hygiene, TODO owners, the observability no-device-sync
+rule, the direct-shard_map ban) now runs as a scope-aware AST rule in
+``tools/graftcheck`` (docs/guide/static-analysis.md), alongside the new
+invariant analyzers (sync-in-traced-code, lock discipline, RNG key
+reuse, recompile hazards).  This shim keeps the old entry points alive:
 
-Plus the shard_map import rule: the pinned jax 0.4.37 has no
-``jax.shard_map`` (only ``jax.experimental.shard_map`` with a different
-signature), so every module must import shard_map (and get_abstract_mesh /
-axis_index) from ``megatron_llm_tpu/parallel/compat.py`` — the one module
-allowed to touch jax's own spellings.  A direct import compiles fine on
-newer jax and breaks the pinned container, which is exactly how the
-original 8-failure gap regressed in.
-
-    python tools/linter.py megatron_llm_tpu tools tasks tests
+* ``python tools/linter.py megatron_llm_tpu tools tasks tests`` — same
+  CLI, same exit codes (0 clean / 1 issues);
+* ``lint_file(path)`` — per-file check returning the issue count,
+  printing ``path:line: message`` diagnostics;
+* the legacy regexes (``SHARD_MAP_RE`` …) — still exported because
+  existing tests sweep the repo with them; they are the *lexical*
+  under-approximation of the AST rules (strings/docstrings false-
+  positive there, which is exactly why graftcheck exists).
 """
 
 from __future__ import annotations
@@ -24,6 +22,13 @@ from __future__ import annotations
 import os
 import re
 import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.graftcheck import core as _core  # noqa: E402
+from tools.graftcheck.rules import ALL_RULES  # noqa: E402
 
 MAX_LEN = 100
 TODO_RE = re.compile(r"#\s*TODO(?!\()")
@@ -55,58 +60,49 @@ def _is_compat(path: str) -> bool:
 
 
 def _strip_comment(line: str) -> str:
-    # good enough for a line-based linter: drop an inline # comment (the
+    # good enough for a line-based sweep: drop an inline # comment (the
     # rule targets code; '#' inside strings is rare in this codebase and
     # a false NEGATIVE there only relaxes the rule for prose)
     return line.split("#", 1)[0]
 
 
 def lint_file(path: str) -> int:
+    """Analyze one file with the full graftcheck rule set (baseline and
+    ``# graftcheck: noqa`` suppressions applied); prints legacy-style
+    ``path:line: message`` lines and returns the issue count."""
+    try:
+        findings = _core.check_file(path, ALL_RULES, root=_REPO)
+    except _core.RuleCrash as e:
+        print(f"{path}:1: graftcheck internal error: {e}")
+        return 1
+    entries = _core.load_baseline(_core.BASELINE_DEFAULT)
+    if entries:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            lines = []
+
+        def line_text_of(f):
+            return (lines[f.line - 1]
+                    if 1 <= f.line <= len(lines) else "")
+
+        _core.apply_baseline(findings, entries, line_text_of)
     issues = 0
-    no_sync = _in_observability(path)
-    check_shard_map = not _is_compat(path)
-    with open(path, encoding="utf-8", errors="replace") as f:
-        for lineno, line in enumerate(f, 1):
-            stripped = line.rstrip("\n")
-            if len(stripped) > MAX_LEN:
-                print(f"{path}:{lineno}: line too long ({len(stripped)} chars)")
-                issues += 1
-            if "\t" in stripped:
-                print(f"{path}:{lineno}: tab character")
-                issues += 1
-            if stripped != stripped.rstrip():
-                print(f"{path}:{lineno}: trailing whitespace")
-                issues += 1
-            if TODO_RE.search(stripped):
-                print(f"{path}:{lineno}: TODO without owner — use TODO(name)")
-                issues += 1
-            if no_sync and DEVICE_SYNC_RE.search(stripped):
-                print(f"{path}:{lineno}: device sync in observability/ — "
-                      f"instruments must never sync the device "
-                      f"(megatron_llm_tpu/observability/__init__.py)")
-                issues += 1
-            if check_shard_map and SHARD_MAP_RE.search(
-                    _strip_comment(stripped)):
-                print(f"{path}:{lineno}: direct jax shard_map import/use — "
-                      f"go through megatron_llm_tpu/parallel/compat.py "
-                      f"(jax 0.4.37 has no jax.shard_map; see that module)")
-                issues += 1
+    for f in findings:
+        if f.baselined:
+            continue
+        print(f"{f.path}:{f.line}: {f.message}")
+        issues += 1
     return issues
 
 
 def main(argv):
     targets = argv or ["megatron_llm_tpu"]
-    total = 0
-    for target in targets:
-        if os.path.isfile(target):
-            total += lint_file(target)
-            continue
-        for root, _dirs, files in os.walk(target):
-            for name in files:
-                if name.endswith(".py"):
-                    total += lint_file(os.path.join(root, name))
-    print(f"{total} issue(s)")
-    return 1 if total else 0
+    rc = _core.main(list(targets))
+    # legacy contract: 0 clean, 1 issues (an internal graftcheck error is
+    # still a non-zero failure — callers treated any non-zero as "fix it")
+    return rc
 
 
 if __name__ == "__main__":

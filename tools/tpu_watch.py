@@ -62,6 +62,20 @@ def _kernel_check_on_tpu(tail: str) -> bool:
     return "backend: tpu" in tail or "backend: TPU" in tail
 
 
+def _graftcheck_ran(out: str) -> bool:
+    """Did the analyzer RUN (clean or with findings)?  graftcheck --json
+    prints a one-line summary and exits 0/1; a crash exits 2 with no
+    summary.  'Ran' counts as captured either way — findings are the
+    evidence; only a crash (no parseable summary) should be retried."""
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return rec.get("graftcheck") == 1
+    return False
+
+
 def _any_line_on_tpu(out: str) -> bool:
     """Multi-line JSON emitters (mfu_sweep): captured iff ANY row ran on
     TPU — a mid-sweep tunnel drop still leaves valid rows."""
@@ -86,6 +100,16 @@ JOBS = [
     ("micro_capture", [sys.executable, "tools/tpu_micro_capture.py"],
      False, _bench_on_tpu),
     ("bench_stock", [sys.executable, "bench.py"], False, _bench_on_tpu),
+    # ISSUE 8: static analysis right after the evidence beachhead — it is
+    # seconds, needs no TPU, and a tree that violates its own invariants
+    # should not burn the rest of a tunnel-up window benchmarking.  Exit
+    # codes: 0 clean / 1 findings / 2 internal error (the
+    # resilience_smoke convention); the predicate treats 0/1 as captured
+    # and only a crash (no JSON summary) as retryable.
+    ("graftcheck",
+     [sys.executable, "-m", "tools.graftcheck", "megatron_llm_tpu",
+      "tools", "tasks", "tests", "--json"],
+     True, _graftcheck_ran),
     ("kernel_check", [sys.executable, "tools/tpu_kernel_check.py", "--quick"],
      True, _kernel_check_on_tpu),
     # VERDICT round-4 item 4 promoted the sweep above the decode pair: the
