@@ -31,6 +31,18 @@ Two modes:
   high-priority p99 TTFT speedup of ``slo`` over ``fcfs`` (priority-class
   reordering + preemption-by-page-release).  Gate: >= 2x.
 
+* ``--mode spec`` (ISSUE 9): speculative decoding on vs off on the same
+  greedy traffic at each occupancy level.  The draft is a 1-layer
+  same-width model and the target is its identity extension
+  (speculative/draft.extend_params_identity) so greedy acceptance is
+  provably 100% on random-init weights — the honest way to measure the
+  *mechanics* (draft-loop cost, fused verify, multi-token ticks) rather
+  than a particular model pair's agreement; the measured acceptance rate
+  rides along in the evidence.  Rows report decode tok/s, tokens per
+  tick, and per-request p50/p99 latency for both arms.  Headline:
+  decode tok/s speedup at concurrency 1 — the latency-bound shape
+  speculative decoding exists for.  Gate: >= 1.3x.
+
 Same tunnel-hardening contract as bench.py: backend probed in a bounded
 subprocess; off-TPU the headline is 0 with the run riding under
 ``cpu_sanity`` (a CPU timing is not a TPU measurement); TPU measurements
@@ -59,6 +71,31 @@ from bench import (  # noqa: E402
 METRIC = "engine_decode_tok_s_llama470m_c8_1chip"
 METRIC_PREFIX = "engine_prefix_prefill_reduction_llama470m_c8_1chip"
 METRIC_SLO = "engine_slo_hi_p99_ttft_speedup_llama470m_1chip"
+METRIC_SPEC = "engine_spec_decode_speedup_llama470m_c1_1chip"
+
+# every mode decodes greedily with termination disabled: runs are
+# workload-shaped, never content-shaped
+GREEDY_KW = dict(top_k=1, termination_id=0, use_eod_for_termination=False)
+
+
+def make_engine(cfg, params, **engine_kw):
+    """THE engine construction point shared by every bench mode — one
+    place to thread geometry/policy/spec knobs, so modes can't drift
+    apart in setup."""
+    from megatron_llm_tpu.generation import ContinuousBatchingEngine
+
+    return ContinuousBatchingEngine(cfg, params, None, **engine_kw)
+
+
+def run_workload(eng, jobs, timeout: float = 600.0):
+    """Submit ``(prompt, gen, kwargs)`` jobs, drive the engine to idle on
+    this thread, wait on every future; returns the request objects (their
+    ttft/latency telemetry is the modes' raw material)."""
+    reqs = [eng.submit(p, g, **kw) for p, g, kw in jobs]
+    eng.run_until_idle()
+    for r in reqs:
+        r.result(timeout=timeout)
+    return reqs
 
 
 def _requests(num: int, prompt: int, gen: int, vocab: int, seed: int = 0):
@@ -75,22 +112,14 @@ def bench_engine(cfg, params, concurrency: int, prompt: int, gen: int,
     import jax
     import numpy as np
 
-    from megatron_llm_tpu.generation import (
-        ContinuousBatchingEngine,
-        generate_tokens,
-    )
+    from megatron_llm_tpu.generation import generate_tokens
 
     prompts = _requests(concurrency, prompt, gen, vocab)
 
     def run_engine():
-        eng = ContinuousBatchingEngine(
-            cfg, params, None, max_slots=max(concurrency, 1),
-            max_seq=prompt + gen)
-        reqs = [eng.submit(p, gen, top_k=1, termination_id=0,
-                           use_eod_for_termination=False) for p in prompts]
-        eng.run_until_idle()
-        for r in reqs:
-            r.result(timeout=600)
+        eng = make_engine(cfg, params, max_slots=max(concurrency, 1),
+                          max_seq=prompt + gen)
+        run_workload(eng, [(p, gen, dict(GREEDY_KW)) for p in prompts])
         return eng
 
     # warm the compile caches (prefill bucket + tick), then time
@@ -147,30 +176,23 @@ def bench_shared_prefix(cfg, params, concurrency: int, shared_len: int,
 
     import numpy as np
 
-    from megatron_llm_tpu.generation import ContinuousBatchingEngine
-
     rng = np.random.default_rng(1)
     shared = [int(t) for t in rng.integers(1, vocab, shared_len)]
     tails = [[int(t) for t in rng.integers(1, vocab, tail_len)]
              for _ in range(concurrency)]
 
     def run(prefix_cache: bool) -> dict:
-        eng = ContinuousBatchingEngine(
-            cfg, params, None, max_slots=concurrency,
-            max_seq=shared_len + tail_len + gen, prefix_cache=prefix_cache)
-        kw = dict(top_k=1, termination_id=0, use_eod_for_termination=False)
+        eng = make_engine(cfg, params, max_slots=concurrency,
+                          max_seq=shared_len + tail_len + gen,
+                          prefix_cache=prefix_cache)
         # warm the cache (and the compile caches) with one full request
-        warm = eng.submit(shared + tails[0], gen, **kw)
-        eng.run_until_idle()
-        warm.result(timeout=600)
+        run_workload(eng, [(shared + tails[0], gen, dict(GREEDY_KW))])
         pt0 = eng.prefill_tokens_computed
         hit0, miss0 = eng.prefix_hit_tokens, eng.prefix_miss_tokens
         t0 = time.perf_counter()
-        reqs = [eng.submit(shared + t, gen, **kw) for t in tails]
-        eng.run_until_idle()
+        reqs = run_workload(
+            eng, [(shared + t, gen, dict(GREEDY_KW)) for t in tails])
         wall = time.perf_counter() - t0
-        for r in reqs:
-            r.result(timeout=600)
         ttfts = [r.ttft for r in reqs]
         hit = eng.prefix_hit_tokens - hit0
         miss = eng.prefix_miss_tokens - miss0
@@ -229,23 +251,19 @@ def bench_slo(cfg, params, slots: int, n_hi: int, n_lo: int,
 
     import numpy as np
 
-    from megatron_llm_tpu.generation import (
-        ContinuousBatchingEngine,
-        RequestShed,
-    )
+    from megatron_llm_tpu.generation import RequestShed
 
     rng = np.random.default_rng(7)
     lo_prompts = [[int(t) for t in rng.integers(1, vocab, prompt_len)]
                   for _ in range(n_lo)]
     hi_prompts = [[int(t) for t in rng.integers(1, vocab, prompt_len)]
                   for _ in range(n_hi)]
-    kw = dict(top_k=1, termination_id=0, use_eod_for_termination=False)
+    kw = dict(GREEDY_KW)
 
     def run(policy: str) -> dict:
-        eng = ContinuousBatchingEngine(
-            cfg, params, None, max_slots=slots,
-            max_seq=prompt_len + max(gen_hi, gen_lo),
-            sched_policy=policy)
+        eng = make_engine(cfg, params, max_slots=slots,
+                          max_seq=prompt_len + max(gen_hi, gen_lo),
+                          sched_policy=policy)
         lo = [eng.submit(p, gen_lo, priority=2, seed=i, **kw)
               for i, p in enumerate(lo_prompts)]
         # drive until every slot decodes batch traffic (true overload)
@@ -312,23 +330,106 @@ def bench_slo(cfg, params, slots: int, n_hi: int, n_lo: int,
     }
 
 
+def bench_spec(cfg, params, draft, levels, prompt, gen, vocab,
+               spec_k: int, reps: int) -> dict:
+    """Speculative decoding on/off on identical greedy traffic per level.
+
+    Both arms run the SAME prompts through engines sharing compiled
+    programs; the on-arm's emitted tokens are asserted equal to the
+    off-arm's (the losslessness contract, cheap to re-check here)."""
+    import numpy as np
+
+    def run(c: int, spec_on: bool) -> dict:
+        prompts = _requests(c, prompt, gen, vocab, seed=11)
+        ekw = dict(max_slots=c, max_seq=prompt + gen)
+        if spec_on:
+            ekw.update(spec_k=spec_k, spec_draft=draft, spec_adaptive=False)
+        best = None
+        for _ in range(max(reps, 1) + 1):  # first rep warms the compiles
+            eng = make_engine(cfg, params, **ekw)
+            t0 = time.perf_counter()
+            reqs = run_workload(
+                eng, [(p, gen, dict(GREEDY_KW)) for p in prompts])
+            wall = time.perf_counter() - t0
+            if best is None or wall < best[0]:
+                best = (wall, eng, reqs)
+        wall, eng, reqs = best
+        lat_ms = sorted(1e3 * r.latency for r in reqs)
+        row = {
+            "spec": spec_on,
+            "wall_s": round(wall, 4),
+            "decode_tok_s": round(c * gen / wall, 1),
+            "ticks": eng.ticks,
+            "tok_per_tick": round(eng.ticked_tokens / max(eng.ticks, 1), 3),
+            "latency_p50_ms": round(_percentile(lat_ms, 50), 2),
+            "latency_p99_ms": round(_percentile(lat_ms, 99), 2),
+        }
+        if spec_on:
+            stats = eng.spec_stats()
+            row["acceptance_rate"] = stats["acceptance_rate"]
+        row["_tokens"] = [r.generated for r in reqs]
+        return row
+
+    # compile-warm both arms' programs on a throwaway pass, timed for the
+    # bench-contract budget fields
+    t0 = time.perf_counter()
+    run(levels[0], False)
+    run(levels[0], True)
+    compile_s = time.perf_counter() - t0
+
+    rows = []
+    for c in levels:
+        off = run(c, False)
+        on = run(c, True)
+        assert on.pop("_tokens") == off.pop("_tokens"), (
+            "speculative decode emitted different tokens — losslessness "
+            "violated")
+        rows.append({
+            "concurrency": c,
+            "speedup": round(on["decode_tok_s"]
+                             / max(off["decode_tok_s"], 1e-9), 2),
+            "on": on,
+            "off": off,
+        })
+    by_c = {r["concurrency"]: r for r in rows}
+    headline = by_c.get(1, rows[0])
+    return {
+        "prompt_len": prompt,
+        "gen_len": gen,
+        "spec_k": spec_k,
+        "speedup_c1": headline["speedup"],
+        "speedup_ok": headline["speedup"] >= 1.3,
+        "acceptance_rate": headline["on"]["acceptance_rate"],
+        "compile_time_s": round(compile_s, 1),
+        "step_time_s": round(
+            headline["on"]["wall_s"] / max(headline["on"]["ticks"], 1), 6),
+        "rows": rows,
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
     prefix_mode = args.mode == "shared_prefix"
     slo_mode = args.mode == "slo"
+    spec_mode = args.mode == "spec"
+    draft_layers = 2
     if probe_backend(args.probe_timeout) == "cpu":
         from megatron_llm_tpu.utils.platform import pin_cpu_platform
 
         pin_cpu_platform()
         # CPU sanity shape: small enough for tier-1 time, big enough that
-        # the >=3x batching / >=2x prefill-reuse / >=2x slo-TTFT gates
-        # are real measurements, not noise
+        # the >=3x batching / >=2x prefill-reuse / >=2x slo-TTFT / >=1.3x
+        # spec gates are real measurements, not noise
         layers, args.prompt, args.gen, args.reps = 2, 32, 24, 1
         hidden, heads, ffn, vocab = 256, 4, 512, 1024
         args.shared, args.tail = 96, 8
         args.slots, args.n_hi, args.n_lo = 2, 6, 6
         args.gen_lo, args.ttft_slo = 48, 250.0
+        if spec_mode:
+            # the target must out-depth the 1-layer draft by enough that
+            # drafting is visibly cheaper than verifying
+            layers, args.gen, draft_layers = 4, 48, 1
 
     import jax
 
@@ -356,6 +457,28 @@ def _run(args, finished):
             c = levels[-1]
             row = bench_shared_prefix(cfg, params, c, args.shared,
                                       args.tail, args.gen, vocab)
+        elif spec_mode:
+            from megatron_llm_tpu.generation import DraftModel
+            from megatron_llm_tpu.generation.speculative import (
+                extend_params_identity,
+            )
+
+            dcfg = make_config(
+                "llama2", num_layers=draft_layers, hidden_size=hidden,
+                num_attention_heads=heads, num_attention_heads_kv=heads,
+                ffn_hidden_size=ffn, vocab_size=vocab,
+                seq_length=max(2048, seq_need),
+                max_position_embeddings=max(2048, seq_need),
+                params_dtype=cfg.training.params_dtype,
+                use_flash_attn=cfg.training.use_flash_attn,
+                micro_batch_size=1, global_batch_size=1, train_iters=1,
+            )
+            dparams = init_model_params(dcfg, jax.random.PRNGKey(1))
+            params = extend_params_identity(dcfg, dparams, cfg,
+                                            jax.random.PRNGKey(0))
+            row = bench_spec(cfg, params, DraftModel(dcfg, dparams),
+                             levels, args.prompt, args.gen, vocab,
+                             args.spec_k, args.reps)
         elif slo_mode:
             row = bench_slo(cfg, params, args.slots, args.n_hi, args.n_lo,
                             args.prompt, args.gen, args.gen_lo, vocab,
@@ -364,7 +487,25 @@ def _run(args, finished):
             rows = [bench_engine(cfg, params, c, args.prompt, args.gen,
                                  vocab, args.reps) for c in levels]
 
-    if slo_mode:
+    if spec_mode:
+        result = {
+            "metric": METRIC_SPEC,
+            "value": row["speedup_c1"],
+            "unit": "x",
+            "speedup_ok": row["speedup_ok"],
+            "acceptance_rate": row["acceptance_rate"],
+            "spec_k": row["spec_k"],
+            "draft_layers": draft_layers,
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in ("prompt_len", "gen_len")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_spec"
+    elif slo_mode:
         by = {r["policy"]: r for r in row["rows"]}
         result = {
             "metric": METRIC_SLO,
@@ -423,11 +564,15 @@ def _run(args, finished):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("occupancy", "shared_prefix", "slo"),
+    ap.add_argument("--mode",
+                    choices=("occupancy", "shared_prefix", "slo", "spec"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
-                         "shared_prefix uses the last level")
+                         "shared_prefix uses the last level, spec sweeps "
+                         "all of them (headline at c=1)")
+    ap.add_argument("--spec_k", type=int, default=4,
+                    help="speculation depth cap (spec mode)")
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--gen", type=int, default=128)
     ap.add_argument("--shared", type=int, default=256,
@@ -449,9 +594,12 @@ def main():
     ap.add_argument("--watchdog", type=float, default=1500.0)
     args = ap.parse_args()
 
-    metric = {"shared_prefix": METRIC_PREFIX, "slo": METRIC_SLO}.get(
-        args.mode, METRIC)
-    unit = "x" if args.mode in ("shared_prefix", "slo") else "tok/s"
+    if args.mode == "spec" and args.concurrency == "1,4,8":
+        args.concurrency = "1,2,4,8"
+    metric = {"shared_prefix": METRIC_PREFIX, "slo": METRIC_SLO,
+              "spec": METRIC_SPEC}.get(args.mode, METRIC)
+    unit = ("x" if args.mode in ("shared_prefix", "slo", "spec")
+            else "tok/s")
     finished = threading.Event()
 
     def on_timeout():

@@ -8,6 +8,12 @@ exporter).  Zero simulated data latency: the hot-loop regime where
 per-step host work is smallest and instrumentation overhead is therefore
 proportionally LARGEST — the honest worst case.
 
+Trace-cost budgets (ROADMAP item 4): the evidence line's
+``overhead_pct`` and ``instrument_cost_us_per_step`` fields are judged
+by ``bench.apply_budgets`` (generous drift ceilings, violations stamp
+``error`` so the tpu_watch predicate rejects the line) — a tracer
+regression fails loudly instead of creeping across evidence files.
+
 Gate (ISSUE 4 acceptance): overhead < 3% steps/sec (``overhead_pct`` in
 the line; the slow-lane test in tests/test_observability.py asserts it).
 The bitwise loss-trajectory equality of the two modes is asserted in the

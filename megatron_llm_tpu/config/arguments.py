@@ -435,6 +435,17 @@ class InferenceConfig:
     sched_aging_s: float = 5.0
     sched_quota: Optional[str] = None
     sched_preemption: bool = True
+    # speculative decoding (generation/speculative/, ISSUE 9): --spec_k is
+    # the speculation-depth cap (0 = off, today's one-token tick);
+    # --spec_draft names the draft model — "family:key=val,..." builds a
+    # random-init config (smoke), "...@/ckpt/dir" loads params from a
+    # checkpoint; --spec_adaptive shrinks the per-slot depth on a low
+    # acceptance EMA.  Greedy speculative decode is bitwise-identical to
+    # spec_k=0; sampled decode matches the target distribution exactly
+    # (docs/guide/serving.md "Speculative decoding")
+    spec_k: int = 0
+    spec_draft: Optional[str] = None
+    spec_adaptive: bool = True
 
 
 @dataclass

@@ -20,9 +20,11 @@ from megatron_llm_tpu.generation.scheduling import (
     SchedulerPolicy,
     get_policy,
 )
+from megatron_llm_tpu.generation.speculative import DraftModel, resolve_draft
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "DraftModel",
     "EngineOverloaded",
     "EngineRequest",
     "InferenceEngine",
@@ -33,6 +35,7 @@ __all__ = [
     "beam_search",
     "generate_tokens",
     "get_policy",
+    "resolve_draft",
     "sample",
     "sample_per_slot",
     "score_tokens",

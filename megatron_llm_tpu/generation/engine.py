@@ -73,6 +73,17 @@ resident, keep its batch full, and never compute the same prefix twice.
   per-priority queue bounds gate the classes independently, and the slo
   policy sheds requests whose deadline is already unmeetable.
 
+* **Speculative decoding** (generation/speculative/, ``--spec_k`` +
+  ``--spec_draft``): a small draft model proposes up to k tokens per
+  tick, the target verifies all k+1 positions in ONE forward (the k+1
+  query positions flattened into the batch so every op is the decode
+  tick's shape — per-row bits are batch-size invariant, which is what
+  makes greedy speculation BITWISE-identical to ``spec_k=0``), and a
+  lossless acceptance rule emits 1..k+1 tokens.  Draft K/V lives in the
+  SAME pool (one page id addresses both caches), so block tables,
+  refcounts, the commitment ledger, the prefix trie, COW and
+  preemption-by-page-release all govern both models unchanged.
+
 Threading: ``submit`` may be called from any thread (e.g. concurrent HTTP
 handlers — generation/server.py); device work happens on whichever thread
 drives :meth:`step`, either the built-in background loop (:meth:`start`) or
@@ -152,10 +163,18 @@ class PagedKVPool:
       (``cached``): reusable by a future match, reclaimable by
       ``evict_hook`` (PrefixCache.evict, LRU leaf-first) when ``alloc``
       outruns the free list.
+
+    With ``draft_cfg`` (speculative decoding, generation/speculative/),
+    the pool carries a SECOND pair of device arrays shaped by the draft
+    model — same ``num_pages``, same page ids.  A page id then addresses
+    both models' K/V for the same token positions: one block table, one
+    refcount, one commitment ledger and one prefix trie govern both
+    caches, so admission/preemption accounting stays deadlock-proof with
+    zero new allocator states.
     """
 
     def __init__(self, cfg, num_pages: int, page_size: int, dtype=None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, draft_cfg=None):
         m = cfg.model
         dtype = dtype or _compute_dtype(cfg)
         shape = (m.num_layers, num_pages, page_size,
@@ -180,6 +199,24 @@ class PagedKVPool:
                                 if mesh is not None else None)
             self.k = jnp.zeros(shape, dtype)
             self.v = jnp.zeros(shape, dtype)
+        self.draft_cfg = draft_cfg
+        self.draft_k = self.draft_v = None
+        if draft_cfg is not None:
+            dm = draft_cfg.model
+            ddtype = _compute_dtype(draft_cfg)
+            dshape = (dm.num_layers, num_pages, page_size,
+                      dm.num_attention_heads_kv, dm.kv_channels)
+            if tp > 1:
+                assert dm.num_attention_heads_kv % tp == 0, (
+                    f"draft kv heads {dm.num_attention_heads_kv} not "
+                    f"divisible by tp {tp}")
+                self.draft_k = jax.device_put(
+                    jnp.zeros(dshape, ddtype), self.kv_sharding)
+                self.draft_v = jax.device_put(
+                    jnp.zeros(dshape, ddtype), self.kv_sharding)
+            else:
+                self.draft_k = jnp.zeros(dshape, ddtype)
+                self.draft_v = jnp.zeros(dshape, ddtype)
         self.num_pages = num_pages
         self.page_size = page_size
         self.refcounts = np.zeros((num_pages,), np.int32)
@@ -379,11 +416,15 @@ class EngineRequest:
     _hit_tokens: int = dataclasses.field(default=0, repr=False)
     _t_submit: float = dataclasses.field(default=0.0, repr=False)
     _t_first: float = dataclasses.field(default=0.0, repr=False)
+    _t_done: float = dataclasses.field(default=0.0, repr=False)
     _seqno: int = dataclasses.field(default=0, repr=False)
     _preemptions: int = dataclasses.field(default=0, repr=False)
     # PRNG key resolved at FIRST activation and pinned: a preempted
     # request resumes the same sampling stream (fold_in(key, _step))
     _key: Optional[np.ndarray] = dataclasses.field(default=None, repr=False)
+    # speculative decoding: acceptance EMA drives the per-slot adaptive
+    # depth (starts optimistic; shrinks when the draft keeps missing)
+    _spec_ema: float = dataclasses.field(default=1.0, repr=False)
 
     def result(self, timeout: Optional[float] = None):
         """Wait for completion; returns (full token list, gen log-probs)."""
@@ -409,6 +450,13 @@ class EngineRequest:
             return None
         return self._t_first - self._t_submit
 
+    @property
+    def latency(self) -> Optional[float]:
+        """Seconds from submit to retirement (bench telemetry)."""
+        if self._t_done == 0.0:
+            return None
+        return self._t_done - self._t_submit
+
 
 class ContinuousBatchingEngine:
     """Shared-tick decode over a prefix-cached paged pool."""
@@ -423,6 +471,9 @@ class ContinuousBatchingEngine:
                  page_watermark: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  sched_policy=None,
+                 spec_k: Optional[int] = None,
+                 spec_draft=None,
+                 spec_adaptive: Optional[bool] = None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -498,10 +549,49 @@ class ContinuousBatchingEngine:
             if part.strip():
                 prio, bound = part.split(":")
                 self._quota[int(prio)] = int(bound)
+        # speculative decoding (generation/speculative/): a draft model
+        # proposes spec_k tokens per tick, the target verifies all of them
+        # in one flattened-batch forward, and a lossless acceptance rule
+        # keeps the longest agreed prefix.  spec_k=0 is today's one-token
+        # tick, byte for byte (the spec path never compiles).
+        self.spec_k = spec_k if spec_k is not None else getattr(
+            inf, "spec_k", 0)
+        self.spec_adaptive = (spec_adaptive if spec_adaptive is not None
+                              else getattr(inf, "spec_adaptive", True))
+        self.draft_cfg = self.draft_params = None
+        if self.spec_k:
+            from megatron_llm_tpu.generation.speculative import (
+                DraftModel,
+                check_draft_compat,
+                resolve_draft,
+            )
+
+            draft = (spec_draft if spec_draft is not None
+                     else getattr(inf, "spec_draft", None))
+            if draft is None:
+                raise ValueError(
+                    "spec_k > 0 requires a draft model (--spec_draft)")
+            assert self.prefill_chunk, (
+                "speculative decoding requires chunked prefill "
+                "(prefill_chunk > 0): draft K/V is populated through the "
+                "block-table prefill path")
+            if isinstance(draft, str):
+                draft = resolve_draft(draft, cfg)
+            elif isinstance(draft, tuple):
+                draft = DraftModel(*draft)
+            check_draft_compat(cfg, draft.cfg, max_seq=self.max_seq)
+            draft_params = draft.params
+            if mesh is not None:
+                from megatron_llm_tpu.parallel.tp import param_shardings
+
+                draft_params = jax.device_put(
+                    draft_params, param_shardings(mesh, draft_params))
+            self.draft_cfg, self.draft_params = draft.cfg, draft_params
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
-        self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh)
+        self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh,
+                                draft_cfg=self.draft_cfg)
         # the prefix cache needs the block-table prefill path: a monolithic
         # dense prefill recomputes and rewrites the whole prompt, shared
         # pages included
@@ -543,6 +633,7 @@ class ContinuousBatchingEngine:
         self._stopping = False  # guarded by _lock
 
         self._tick_fn = None
+        self._spec_tick_fn = None
         self._prefill_fns: Dict[Tuple[int, bool], object] = {}
         self._chunk_fns: Dict[Tuple[int, int, bool], object] = {}
         self._copy_fn = None
@@ -561,6 +652,12 @@ class ContinuousBatchingEngine:
         self.preemptions = 0
         self.shed_requests = 0
         self.deadline_misses = 0
+        # speculative-decoding telemetry (bench_decode --mode spec +
+        # /health spec payload)
+        self.spec_ticks = 0
+        self.spec_draft_tokens = 0     # drafts proposed (sum of k_eff)
+        self.spec_accepted_tokens = 0  # drafts the target accepted
+        self.spec_emitted_tokens = 0   # tokens emitted by spec ticks
         # submit order, stable policy tie-break — guarded by _lock
         self._seqno = 0
         # decode-tick wall EMA — guarded by _lock
@@ -618,6 +715,30 @@ class ContinuousBatchingEngine:
             "mlt_engine_deadline_miss_total",
             help="retired requests that missed a declared deadline",
             labels={"kind": "tpot"})
+        # speculative-decoding instruments, registered only when the spec
+        # path can run (mlt_engine_spec_* stays absent from scrapes of
+        # non-speculating engines)
+        self._m_spec_draft = self._m_spec_accepted = None
+        self._m_spec_ratio = self._m_spec_len = None
+        if self.spec_k:
+            self._m_spec_draft = reg.counter(
+                "mlt_engine_spec_draft_tokens_total",
+                help="draft tokens proposed to the verifier")
+            self._m_spec_accepted = reg.counter(
+                "mlt_engine_spec_accepted_tokens_total",
+                help="draft tokens the target model accepted")
+            self._m_spec_ratio = reg.histogram(
+                "mlt_engine_spec_acceptance_ratio",
+                help="per-slot-tick accepted/drafted fraction",
+                buckets=[0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                         0.875, 1.0])
+            self._m_spec_len = reg.histogram(
+                "mlt_engine_spec_accepted_length",
+                help="tokens emitted per slot per speculative tick",
+                buckets=[float(i) for i in range(1, self.spec_k + 2)])
+            reg.gauge("mlt_engine_spec_k",
+                      help="speculation depth cap (--spec_k)"
+                      ).set(self.spec_k)
         reg.gauge("mlt_engine_sched_policy_info",
                   help="active scheduling policy (value always 1)",
                   labels={"policy": self.policy.name}).set(1)
@@ -695,6 +816,31 @@ class ContinuousBatchingEngine:
             donate_argnums=(1, 2))
         return self._tick_fn
 
+    def _spec_tick(self):
+        """The fused draft-k-then-verify tick (speculative/verify.py):
+        one compiled program drafts ``spec_k`` tokens per slot, verifies
+        all k+1 positions in a single flattened-batch target forward, and
+        applies the lossless acceptance rule.  Cache key carries the
+        DRAFT config fingerprint too — engines speculating with different
+        drafts must not share executables."""
+        if self._spec_tick_fn is not None:
+            return self._spec_tick_fn
+        from megatron_llm_tpu.generation.speculative.verify import (
+            make_spec_tick_fn,
+        )
+
+        statics = ("engine_spec_tick", self.max_slots, self.pages_per_seq,
+                   self.page_size, self.pool.num_pages,
+                   str(self.pool.k.dtype), self.spec_k,
+                   gen.config_fingerprint(self.draft_cfg),
+                   str(self.pool.draft_k.dtype), self._mesh_statics)
+        self._spec_tick_fn = gen.cached_jit(
+            self.cfg, "engine_spec_tick", statics,
+            lambda: make_spec_tick_fn(self.cfg, self.draft_cfg, self.spec_k,
+                                      tp=self._tp),
+            donate_argnums=(2, 3, 4, 5))
+        return self._spec_tick_fn
+
     def _prefill(self, s_pre: int, with_log_probs: bool):
         """Monolithic dense prefill (the ``prefill_chunk=0`` legacy path):
         one dense-cache forward over the bucketed prompt, scattered into the
@@ -747,6 +893,7 @@ class ContinuousBatchingEngine:
         if fn is not None:
             return fn
         cfg = self.cfg
+        draft_cfg = self.draft_cfg
 
         def chunk(params, tokens, start, bt, pool_k, pool_v, targets):
             out, (pool_k, pool_v) = model_forward(
@@ -762,11 +909,34 @@ class ContinuousBatchingEngine:
                 return pool_k, pool_v, lp[0]
             return pool_k, pool_v
 
+        def chunk_spec(params, draft_params, tokens, start, bt,
+                       pool_k, pool_v, draft_k, draft_v, targets):
+            # target chunk plus the DRAFT model's chunk through the same
+            # block table: a speculating engine keeps both caches filled
+            # for every prefilled page, so trie-matched pages (prefix hits,
+            # preemption resume) carry valid draft K/V too
+            res = chunk(params, tokens, start, bt, pool_k, pool_v, targets)
+            _, (draft_k, draft_v) = model_forward(
+                draft_cfg, draft_params, tokens,
+                position_ids=start[:, None] + jnp.arange(rows)[None, :],
+                rope_cache=make_rope_cache(draft_cfg),
+                kv_caches=(draft_k, draft_v),
+                paged=PagedState(bt, start),
+                logits_postprocess=False,
+            )
+            return res[:2] + (draft_k, draft_v) + res[2:]
+
         statics = ("engine_prefill_chunk", rows, kv_pages, with_log_probs,
                    self.page_size, self.pool.num_pages,
                    str(self.pool.k.dtype), self._mesh_statics)
-        fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
-                            lambda: chunk, donate_argnums=(4, 5))
+        if self.spec_k:
+            statics += ("spec", gen.config_fingerprint(draft_cfg))
+            fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
+                                lambda: chunk_spec,
+                                donate_argnums=(5, 6, 7, 8))
+        else:
+            fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
+                                lambda: chunk, donate_argnums=(4, 5))
         self._chunk_fns[key] = fn
         return fn
 
@@ -781,10 +951,24 @@ class ContinuousBatchingEngine:
             pool_v = pool_v.at[:, dst].set(pool_v[:, src])
             return pool_k, pool_v
 
+        def copy_spec(pool_k, pool_v, draft_k, draft_v, src, dst):
+            # COW must clone the page in BOTH caches: the refeed tick
+            # rewrites the draft K/V at the same position too
+            pool_k, pool_v = copy(pool_k, pool_v, src, dst)
+            draft_k, draft_v = copy(draft_k, draft_v, src, dst)
+            return pool_k, pool_v, draft_k, draft_v
+
         statics = ("engine_copy_page", self.pool.num_pages, self.page_size,
                    str(self.pool.k.dtype), self._mesh_statics)
-        self._copy_fn = gen.cached_jit(self.cfg, "engine_copy_page", statics,
-                                       lambda: copy, donate_argnums=(0, 1))
+        if self.spec_k:
+            statics += ("spec", gen.config_fingerprint(self.draft_cfg))
+            self._copy_fn = gen.cached_jit(
+                self.cfg, "engine_copy_page", statics, lambda: copy_spec,
+                donate_argnums=(0, 1, 2, 3))
+        else:
+            self._copy_fn = gen.cached_jit(
+                self.cfg, "engine_copy_page", statics, lambda: copy,
+                donate_argnums=(0, 1))
         return self._copy_fn
 
     # -- request lifecycle -------------------------------------------------
@@ -1105,9 +1289,16 @@ class ContinuousBatchingEngine:
             src, dst = matched[-1], fresh[0]
             # device copy OUTSIDE the lock (driver thread; serialized with
             # ticks via _drive_lock), then drop our ref on the shared page
-            self.pool.k, self.pool.v = self._copy_page()(
-                self.pool.k, self.pool.v, self._asarray(np.int32(src)),
-                self._asarray(np.int32(dst)))
+            if self.spec_k:
+                (self.pool.k, self.pool.v, self.pool.draft_k,
+                 self.pool.draft_v) = self._copy_page()(
+                    self.pool.k, self.pool.v, self.pool.draft_k,
+                    self.pool.draft_v, self._asarray(np.int32(src)),
+                    self._asarray(np.int32(dst)))
+            else:
+                self.pool.k, self.pool.v = self._copy_page()(
+                    self.pool.k, self.pool.v, self._asarray(np.int32(src)),
+                    self._asarray(np.int32(dst)))
         with self._lock:
             if cow:
                 # block-table order: kept shared pages, the private COW
@@ -1242,6 +1433,7 @@ class ContinuousBatchingEngine:
             self._ema_retire_s = (dt if self._ema_retire_s is None
                                   else 0.7 * self._ema_retire_s + 0.3 * dt)
         self._last_retire_t = now
+        req._t_done = now
         ttft = req.ttft
         missed = False
         if ttft is not None:
@@ -1273,6 +1465,87 @@ class ContinuousBatchingEngine:
         if not req.use_eod_for_termination or req.termination_id is None:
             return False
         return tok == req.termination_id
+
+    # -- speculative decoding ----------------------------------------------
+
+    def _apply_spec_locked(self, active, k_eff, emit_np, lp_np, acc_np,
+                           m_np, now) -> int:  # holds _lock
+        """Fold one speculative tick's results into the slots: append each
+        row's emitted block (truncating at stop tokens / length limits —
+        exactly where non-speculative decode would have stopped), advance
+        the host mirrors by the KEPT count, update acceptance EMAs and
+        spec telemetry, retire finished rows.  Returns tokens emitted
+        (the tick's slot-step count for throughput accounting — a spec
+        slot reports k-token progress, so SLO/tpot math sees real token
+        timestamps, not tick counts)."""
+        emitted = 0
+        publishing = obs_registry.publishing()
+        for i in active:
+            req = self._slots[i]
+            k_i = int(k_eff[i])
+            m_i = int(m_np[i])
+            took = 0
+            done = False
+            for t in range(m_i):
+                tok = int(emit_np[i, t])
+                req.generated.append(tok)
+                req.log_probs.append(float(lp_np[i, t]))
+                took += 1
+                done = (self._stopped_by_token(req, tok)
+                        or len(req.generated) >= req.max_new_tokens
+                        or len(req.prompt) + len(req.generated)
+                        >= self.max_seq)
+                if done:
+                    break
+            if req._step == 0:
+                req._t_first = now
+            req._step += took
+            self._positions[i] += took
+            self._tokens[i] = int(emit_np[i, took - 1])
+            self._steps[i] += took
+            emitted += took
+            self.spec_emitted_tokens += took
+            if k_i > 0:
+                a_i = int(acc_np[i])
+                self.spec_draft_tokens += k_i
+                self.spec_accepted_tokens += a_i
+                req._spec_ema = 0.7 * req._spec_ema + 0.3 * (a_i / k_i)
+                if publishing:
+                    self._m_spec_draft.inc(k_i)
+                    self._m_spec_accepted.inc(a_i)
+                    self._m_spec_ratio.observe(a_i / k_i)
+            if publishing:
+                self._m_spec_len.observe(took)
+            if took != m_i:
+                # a stop token cut the block short: the device mirror ran
+                # ahead of the kept sequence — force a re-upload
+                self._dirty = True
+            if done:
+                self._retire(i)
+        self.spec_ticks += 1
+        return emitted
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding snapshot for ``/health`` and the spec
+        bench (generation/server.py, bench_decode.py --mode spec)."""
+        if not self.spec_k:
+            return {"enabled": False}
+        with self._lock:
+            drafted = self.spec_draft_tokens
+            accepted = self.spec_accepted_tokens
+            emitted = self.spec_emitted_tokens
+            ticks = self.spec_ticks
+        return {
+            "enabled": True,
+            "spec_k": self.spec_k,
+            "adaptive": self.spec_adaptive,
+            "draft_layers": self.draft_cfg.model.num_layers,
+            "draft_tokens": drafted,
+            "accepted_tokens": accepted,
+            "acceptance_rate": round(accepted / drafted, 4) if drafted else None,
+            "emitted_tokens": emitted,
+            "tokens_per_tick": round(emitted / ticks, 3) if ticks else None,
+        }
 
     # -- chunked prefill scheduling ---------------------------------------
 
@@ -1319,12 +1592,25 @@ class ContinuousBatchingEngine:
         try:
             with obs_trace.span("engine-prefill-chunk", start=start,
                                 rows=rows, tp=self._tp):
-                out = self._chunk_prefill(rows, kv_pages,
-                                          req.return_log_probs)(
-                    self.params, self._asarray(tokens),
-                    self._asarray(np.asarray([start], np.int32)),
-                    self._asarray(bt),
-                    self.pool.k, self.pool.v, self._asarray(targets))
+                if self.spec_k:
+                    out = self._chunk_prefill(rows, kv_pages,
+                                              req.return_log_probs)(
+                        self.params, self.draft_params,
+                        self._asarray(tokens),
+                        self._asarray(np.asarray([start], np.int32)),
+                        self._asarray(bt), self.pool.k, self.pool.v,
+                        self.pool.draft_k, self.pool.draft_v,
+                        self._asarray(targets))
+                    (self.pool.k, self.pool.v, self.pool.draft_k,
+                     self.pool.draft_v) = out[:4]
+                    out = (self.pool.k, self.pool.v) + out[4:]
+                else:
+                    out = self._chunk_prefill(rows, kv_pages,
+                                              req.return_log_probs)(
+                        self.params, self._asarray(tokens),
+                        self._asarray(np.asarray([start], np.int32)),
+                        self._asarray(bt),
+                        self.pool.k, self.pool.v, self._asarray(targets))
             if req.return_log_probs:
                 self.pool.k, self.pool.v, lp = out
                 if req.prompt_log_probs is None:
@@ -1387,18 +1673,37 @@ class ContinuousBatchingEngine:
                 return did_prefill
             # on-demand paging: a row crossing into a page it doesn't own
             # yet gets one allocated now (commitment ledger guarantees this
-            # can't fail while the slot is in flight)
+            # can't fail while the slot is in flight).  A speculating slot
+            # writes up to k_eff positions past its own, so its horizon
+            # covers the whole verify block; k_eff itself is per-slot and
+            # per-tick — capped by --spec_k, the tokens the request still
+            # owes, and (adaptive mode) the acceptance EMA.  Writes past a
+            # row's k_eff land on the null page or above the accepted
+            # frontier — discarded by the acceptance mask, rewritten before
+            # ever being attended.
+            k_eff = np.zeros((self.max_slots,), np.int32)
             for i in list(active):
                 req = self._slots[i]
-                idx = int(self._positions[i]) // self.page_size
-                if self._block_tables[i][idx] == NULL_PAGE:
+                if self.spec_k:
+                    remaining = req.max_new_tokens - len(req.generated)
+                    k_i = min(self.spec_k, remaining - 1)
+                    if self.spec_adaptive:
+                        k_i = min(k_i, max(1, int(round(
+                            req._spec_ema * self.spec_k))))
+                    k_eff[i] = max(k_i, 0)
+                p0 = int(self._positions[i]) // self.page_size
+                p1 = (int(self._positions[i]) + int(k_eff[i])) \
+                    // self.page_size
+                for idx in range(p0, min(p1, self.pages_per_seq - 1) + 1):
+                    if self._block_tables[i][idx] != NULL_PAGE:
+                        continue
                     got = self.pool.alloc(1)
                     if got is None:  # ledger-unreachable; fail just the row
                         self._fail_locked(req, RuntimeError(
                             "KV pool exhausted for an in-flight slot — "
                             "commitment ledger violated"))
                         active.remove(i)
-                        continue
+                        break
                     self._block_tables[i][idx] = got[0]
                     req._pages.append(got[0])
                     self._committed -= 1
@@ -1418,14 +1723,30 @@ class ContinuousBatchingEngine:
             bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
 
         t_tick = time.monotonic()
-        with obs_trace.span("engine-tick", active=len(active),
-                            tp=self._tp):
-            (self.pool.k, self.pool.v, next_tok, logp,
-             new_pos, new_steps) = self._tick()(
-                self.params, self.pool.k, self.pool.v,
-                bt, pos, toks, keys, steps, temp, tk, tp)
-            next_np = np.asarray(next_tok)
-            logp_np = np.asarray(logp)
+        if self.spec_k:
+            with obs_trace.span("engine-spec-tick", active=len(active),
+                                k=self.spec_k, tp=self._tp):
+                (self.pool.k, self.pool.v, self.pool.draft_k,
+                 self.pool.draft_v, emit, emit_lp, acc, cnt,
+                 new_pos, next_tok, new_steps) = self._spec_tick()(
+                    self.params, self.draft_params,
+                    self.pool.k, self.pool.v,
+                    self.pool.draft_k, self.pool.draft_v,
+                    bt, pos, toks, keys, steps, temp, tk, tp,
+                    self._asarray(k_eff))
+                emit_np = np.asarray(emit)
+                lp_np = np.asarray(emit_lp)
+                acc_np = np.asarray(acc)
+                m_np = np.asarray(cnt)
+        else:
+            with obs_trace.span("engine-tick", active=len(active),
+                                tp=self._tp):
+                (self.pool.k, self.pool.v, next_tok, logp,
+                 new_pos, new_steps) = self._tick()(
+                    self.params, self.pool.k, self.pool.v,
+                    bt, pos, toks, keys, steps, temp, tk, tp)
+                next_np = np.asarray(next_tok)
+                logp_np = np.asarray(logp)
 
         now = time.monotonic()
         with self._lock:
@@ -1437,26 +1758,32 @@ class ContinuousBatchingEngine:
                 self._dev_state = (bt, new_pos, next_tok, keys, new_steps,
                                    temp, tk, tp)
             self.ticks += 1
-            self.ticked_tokens += len(active)
+            if self.spec_k:
+                emitted = self._apply_spec_locked(
+                    active, k_eff, emit_np, lp_np, acc_np, m_np, now)
+            else:
+                emitted = len(active)
+                for i in active:
+                    req = self._slots[i]
+                    tok = int(next_np[i])
+                    req.generated.append(tok)
+                    req.log_probs.append(float(logp_np[i]))
+                    req._step += 1
+                    if req._step == 1:
+                        req._t_first = now
+                    self._positions[i] += 1
+                    self._tokens[i] = tok
+                    self._steps[i] += 1
+                    done = (self._stopped_by_token(req, tok)
+                            or len(req.generated) >= req.max_new_tokens
+                            or len(req.prompt) + len(req.generated)
+                            >= self.max_seq)
+                    if done:
+                        self._retire(i)
+            self.ticked_tokens += emitted
             if obs_registry.publishing():
                 self._m_ticks.inc()
-                self._m_tokens.inc(len(active))
-            for i in active:
-                req = self._slots[i]
-                tok = int(next_np[i])
-                req.generated.append(tok)
-                req.log_probs.append(float(logp_np[i]))
-                req._step += 1
-                if req._step == 1:
-                    req._t_first = now
-                self._positions[i] += 1
-                self._tokens[i] = tok
-                self._steps[i] += 1
-                done = (self._stopped_by_token(req, tok)
-                        or len(req.generated) >= req.max_new_tokens
-                        or len(req.prompt) + len(req.generated) >= self.max_seq)
-                if done:
-                    self._retire(i)
+                self._m_tokens.inc(emitted)
             if obs_registry.publishing():
                 self._m_active.set(
                     sum(r is not None and r._phase == "decode"

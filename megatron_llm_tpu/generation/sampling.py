@@ -9,7 +9,7 @@ once, which matches how a generation server runs in practice).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -80,28 +80,25 @@ def sample(
     return samples.astype(jnp.int32)
 
 
-def sample_per_slot(
-    keys: jax.Array,         # [b, 2] uint32 — one PRNG key per row
+def filtered_logits_per_slot(
     logits: jax.Array,       # [b, v]
     *,
     top_k: jax.Array,        # [b] int32 (0 = off, 1 = greedy, >1 = filter)
     top_p: jax.Array,        # [b] fp32  (0 = off; ignored where top_k acts)
     temperature: jax.Array,  # [b] fp32  (ignored for greedy rows)
     vocab_size: Optional[int] = None,
-) -> jax.Array:
-    """One batched sampling step with *per-row* sampling params and keys.
+) -> Tuple[jax.Array, jax.Array]:
+    """The per-row filter pipeline :func:`sample_per_slot` samples from.
 
-    The continuous-batching engine decodes many requests in one tick, each
-    with its own (temperature, top_k, top_p) — so unlike :func:`sample`,
-    where the config is static and baked into the compiled program, here the
-    params are traced arrays and one program serves every mix.  Per-row keys
-    keep each request's sample stream a function of (its seed, its step
-    index) alone — independent of which slot it landed in or which other
-    requests share the tick.  Greedy rows (``top_k == 1``) reproduce
-    :func:`sample`'s greedy branch exactly: argmax over the vocab-masked
-    logits, no temperature.
-
-    Returns [b] int32 token ids.
+    Returns ``(filtered, greedy)``: the vocab-masked, temperature-scaled,
+    top-k/top-p-filtered fp32 logits [b, v] (softmax of a row is exactly
+    the categorical distribution a non-greedy slot draws from) and the
+    greedy argmax [b] over the vocab-masked RAW logits (no temperature —
+    :func:`sample`'s greedy branch).  The speculative-decoding verify step
+    (generation/speculative/verify.py) consumes both: draft/target
+    distributions for residual rejection sampling must be the SAME
+    distributions the non-speculative tick samples from, or acceptance
+    stops being lossless.
     """
     assert logits.ndim == 2, "expected [b, v] logits"
     b, v = logits.shape
@@ -142,6 +139,35 @@ def sample_per_slot(
     # (the common serving mix; greedy decode bench ticks hit this branch)
     filtered = jax.lax.cond(
         jnp.any((top_k > 1) | (top_p > 0)), apply_filters, lambda x: x, l32)
+    return filtered, greedy
+
+
+def sample_per_slot(
+    keys: jax.Array,         # [b, 2] uint32 — one PRNG key per row
+    logits: jax.Array,       # [b, v]
+    *,
+    top_k: jax.Array,        # [b] int32 (0 = off, 1 = greedy, >1 = filter)
+    top_p: jax.Array,        # [b] fp32  (0 = off; ignored where top_k acts)
+    temperature: jax.Array,  # [b] fp32  (ignored for greedy rows)
+    vocab_size: Optional[int] = None,
+) -> jax.Array:
+    """One batched sampling step with *per-row* sampling params and keys.
+
+    The continuous-batching engine decodes many requests in one tick, each
+    with its own (temperature, top_k, top_p) — so unlike :func:`sample`,
+    where the config is static and baked into the compiled program, here the
+    params are traced arrays and one program serves every mix.  Per-row keys
+    keep each request's sample stream a function of (its seed, its step
+    index) alone — independent of which slot it landed in or which other
+    requests share the tick.  Greedy rows (``top_k == 1``) reproduce
+    :func:`sample`'s greedy branch exactly: argmax over the vocab-masked
+    logits, no temperature.
+
+    Returns [b] int32 token ids.
+    """
+    filtered, greedy = filtered_logits_per_slot(
+        logits, top_k=top_k, top_p=top_p, temperature=temperature,
+        vocab_size=vocab_size)
     sampled = jax.vmap(lambda k, row: jax.random.categorical(k, row))(
         keys, filtered)
     return jnp.where(top_k == 1, greedy, sampled).astype(jnp.int32)

@@ -313,6 +313,10 @@ class MegatronServer:
                 # control-plane view: policy, per-priority queue depths,
                 # preemption/shed/deadline-miss totals, drain EMAs
                 info["scheduler"] = eng.scheduler_stats()
+            if hasattr(eng, "spec_stats"):
+                # speculative decoding: depth cap, acceptance rate,
+                # tokens per tick (generation/speculative/)
+                info["spec"] = eng.spec_stats()
         return info
 
     def metrics_text(self) -> str:

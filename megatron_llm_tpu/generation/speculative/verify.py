@@ -1,0 +1,265 @@
+"""The fused draft-then-verify tick and the lossless acceptance rule.
+
+One compiled program per engine geometry does all of:
+
+1. **Draft k tokens** — ``spec_k`` autoregressive s=1 forwards of the
+   draft model (a ``lax.scan``), each writing draft K/V through the SAME
+   block tables as the target and sampling with the slot's own filters
+   (temperature / top-k / top-p), so the proposal distribution ``q`` is
+   exactly the distribution a non-speculative draft-model decode would
+   sample from.
+
+2. **Verify k+1 positions in one target forward** — the key numerics
+   design: the verify does NOT run the ``s = k+1`` prefill-attention
+   path.  On the CPU fallback (and in general), a program with a
+   different query-span shape reassociates reductions differently and
+   drifts from the decode tick by a last-ulp — which would break the
+   bitwise-losslessness contract.  Instead the k+1 query positions are
+   **flattened into the batch dimension**: row ``(slot i, offset j)``
+   feeds one token at position ``pos_i + j`` with slot ``i``'s block
+   table — every op in the forward is then *structurally identical* to
+   the non-speculative decode tick (an s=1 paged decode, just with a
+   larger batch), and per-row bits are batch-size invariant.  The target
+   logits at each verified position are therefore bitwise what the
+   decode tick would have produced, and greedy speculative decode emits
+   bitwise-identical tokens AND log-probs (tests/test_speculative.py).
+   K/V writes land first (each row a distinct (page, offset) — rows of a
+   slot write consecutive positions, different slots own disjoint
+   writable pages), then every row attends causally ``<= its position``:
+   write-then-attend, exactly the decode tick's order.
+
+3. **Lossless acceptance** (:func:`speculative_acceptance`) — greedy
+   rows accept a draft token iff it equals the target argmax, and emit
+   the target argmax at the first mismatch (so the emitted stream IS the
+   greedy target stream, whatever the draft proposed); sampled rows run
+   standard residual rejection sampling: accept ``d_j`` with probability
+   ``min(1, p(d_j)/q(d_j))``, on rejection emit from the residual
+   ``max(p - q, 0)/Z``, and after k acceptances emit a bonus token from
+   ``p`` — the emitted distribution provably equals the target model's
+   (the classic speculative-sampling theorem; distribution-matched in
+   tests/test_speculative.py).
+
+Key discipline: every random draw derives from
+``base = fold_in(request_key, steps)`` (``steps`` = tokens emitted so
+far, strictly increasing, pinned across preemption) fanned out through
+*disjoint* streams — ``fold_in(fold_in(base, DRAFT_STREAM), j)`` for the
+j-th draft draw, one ``fold_in(base, ACCEPT_STREAM)`` key consumed for
+the k acceptance uniforms, ``fold_in(base, EMIT_STREAM)`` consumed for
+the single rejection/bonus draw.  No key is ever consumed twice
+(graftcheck's rng-key-reuse rule analyzes this module; the
+draft/verify-split reuse bug is pinned as a historical fixture in
+tests/test_graftcheck.py).
+
+Rejected-draft K/V (positions past the accepted frontier) is left in
+place: it is only ever attended by a query at an equal-or-later position,
+and every such query belongs to a later block that rewrites those
+positions first — write-then-attend makes stale speculative K/V
+unreachable by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.generation import generation as gen
+from megatron_llm_tpu.generation.sampling import (
+    NEG_INF,
+    filtered_logits_per_slot,
+)
+from megatron_llm_tpu.models.language_model import (
+    make_rope_cache,
+    model_forward,
+)
+from megatron_llm_tpu.ops.paged_attention import PagedState
+
+# disjoint key streams fanned out of the per-(request, step) base key
+DRAFT_STREAM = 1   # j-th draft sampling draw
+ACCEPT_STREAM = 2  # the k acceptance uniforms (one key, one draw of [k])
+EMIT_STREAM = 3    # the single rejection-residual / bonus draw
+
+
+def speculative_acceptance(
+    draft_toks: jax.Array,   # [b, K] int32 — proposed tokens d_1..d_K
+    q_filt: jax.Array,       # [b, K, v] fp32 — draft filtered logits per draw
+    t_filt: jax.Array,       # [b, K+1, v] fp32 — target filtered logits
+    t_greedy: jax.Array,     # [b, K+1] int32 — target argmax per position
+    greedy_row: jax.Array,   # [b] bool — slots decoding greedily (top_k == 1)
+    k_eff: jax.Array,        # [b] int32 — per-slot speculation depth (0..K)
+    u: jax.Array,            # [b, K] fp32 — acceptance uniforms in [0, 1)
+    emit_keys: jax.Array,    # [b, 2] uint32 — one consumed key per row
+):
+    """The lossless acceptance rule; pure so tests can drive it with
+    synthetic distributions.
+
+    Returns ``(accepted, counts, emit)``: per-slot accepted draft count
+    ``a`` in [0, k_eff], emitted token count ``m = a + 1``, and the
+    emitted tokens ``emit[b, K+1]`` (positions >= m are padding).  Row
+    semantics: ``emit[:, t] = d_{t+1}`` for ``t < a``; ``emit[:, a]`` is
+    the correction/bonus token — greedy: the target argmax at that
+    position; sampled: a residual-rejection draw (or a draw from the full
+    target distribution when every valid draft was accepted).
+    """
+    b, K = draft_toks.shape
+    p = jax.nn.softmax(t_filt, axis=-1)          # [b, K+1, v]
+    q = jax.nn.softmax(q_filt, axis=-1)          # [b, K, v]
+    p_d = jnp.take_along_axis(
+        p[:, :K], draft_toks[..., None], axis=-1)[..., 0]   # [b, K]
+    q_d = jnp.take_along_axis(
+        q, draft_toks[..., None], axis=-1)[..., 0]          # [b, K]
+    # u < min(1, p/q) without the division: q_d > 0 for any token the
+    # draft actually sampled, and u*q < p is the same event
+    acc_sampled = u * q_d < p_d
+    acc_greedy = draft_toks == t_greedy[:, :K]
+    acc = jnp.where(greedy_row[:, None], acc_greedy, acc_sampled)
+    acc &= jnp.arange(K)[None, :] < k_eff[:, None]
+    # longest accepted prefix (a rejection kills everything after it)
+    accepted = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    counts = accepted + 1
+
+    # correction/bonus token at index `accepted`
+    is_bonus = accepted >= k_eff   # every valid draft accepted
+    p_at = jnp.take_along_axis(
+        p, accepted[:, None, None], axis=1)[:, 0]           # [b, v]
+    q_at = jnp.take_along_axis(
+        q, jnp.minimum(accepted, K - 1)[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(
+        p_at - jnp.where(is_bonus[:, None], 0.0, q_at), 0.0)
+    z = resid.sum(axis=-1, keepdims=True)
+    # rejection implies p < q somewhere, so z > 0 up to float rounding;
+    # the guard keeps the all-rounded-to-zero corner a draw from p
+    resid = jnp.where(z > 0, resid, p_at)
+    resid_logits = jnp.where(resid > 0, jnp.log(resid), NEG_INF)
+    drawn = jax.vmap(lambda k_, row: jax.random.categorical(k_, row))(
+        emit_keys, resid_logits)
+    greedy_emit = jnp.take_along_axis(
+        t_greedy, accepted[:, None], axis=1)[:, 0]
+    emit_at = jnp.where(greedy_row, greedy_emit, drawn).astype(jnp.int32)
+
+    d_pad = jnp.concatenate(
+        [draft_toks, jnp.zeros((b, 1), jnp.int32)], axis=1)  # [b, K+1]
+    t_idx = jnp.arange(K + 1)[None, :]
+    emit = jnp.where(t_idx < accepted[:, None], d_pad,
+                     jnp.where(t_idx == accepted[:, None],
+                               emit_at[:, None], 0)).astype(jnp.int32)
+    return accepted, counts, emit
+
+
+def make_spec_tick_fn(cfg, draft_cfg, spec_k: int, *, tp: int = 1):
+    """Build the fused speculative tick the engine compiles once.
+
+    Signature of the returned function::
+
+        (params, draft_params, pool_k, pool_v, draft_k, draft_v,
+         block_tables, positions, tokens, req_keys, steps,
+         temperature, top_k, top_p, k_eff)
+        -> (pool_k, pool_v, draft_k, draft_v,
+            emit [b, K+1], emit_logp [b, K+1],
+            accepted [b], counts [b], new_pos, new_tok, new_steps)
+
+    ``k_eff`` caps each slot's ACCEPTED depth; the draft loop still runs
+    the static ``spec_k`` steps for every row (one compiled program),
+    rows past their ``k_eff`` just produce writes the acceptance mask
+    discards and later blocks overwrite-before-attend.
+    """
+    K = spec_k
+    assert K >= 1
+    vocab = cfg.model.vocab_size
+    scope_t = "verify-fwd" if tp == 1 else f"verify-fwd-tp{tp}"
+    scope_d = "draft-fwd" if tp == 1 else f"draft-fwd-tp{tp}"
+
+    def spec_tick(params, draft_params, pool_k, pool_v, draft_k, draft_v,
+                  block_tables, positions, tokens, req_keys, steps,
+                  temperature, top_k, top_p, k_eff):
+        b = tokens.shape[0]
+        rope_t = make_rope_cache(cfg)
+        rope_d = make_rope_cache(draft_cfg)
+        base = jax.vmap(jax.random.fold_in)(req_keys, steps)   # [b, 2]
+        greedy_row = top_k == 1
+
+        # ---- 1) draft k tokens (sequential s=1 draft forwards) ----
+        # The scan runs K+1 steps, not K: step j < K samples draft token
+        # d_{j+1}; the final step feeds d_K at position pos+K purely for
+        # its K/V WRITE (its sample is discarded).  Without it, an
+        # all-accepted-plus-bonus tick leaves a permanent hole in the
+        # draft cache at d_K's position — the next tick starts past it,
+        # the draft forever attends garbage there, and acceptance decays
+        # (the bug showed up as ~78% acceptance on a draft the target
+        # provably agrees with).
+        def draft_step(carry, j):
+            tok, dk, dv = carry
+            pos_j = positions + j
+            # rows past their own depth write to the NULL page: a clipped
+            # write at the end of the sequence budget would otherwise land
+            # inside the row's LAST real page and corrupt live K/V (the
+            # engine only allocates pages up to pos + k_eff)
+            bt_j = jnp.where((j <= k_eff)[:, None], block_tables, 0)
+            with jax.named_scope(scope_d):
+                logits, (dk, dv) = model_forward(
+                    draft_cfg, draft_params, tok[:, None],
+                    position_ids=pos_j[:, None], rope_cache=rope_d,
+                    kv_caches=(dk, dv),
+                    paged=PagedState(bt_j, pos_j))
+            filt, greedy = filtered_logits_per_slot(
+                logits[:, -1], top_k=top_k, top_p=top_p,
+                temperature=temperature, vocab_size=vocab)
+            keys_j = jax.vmap(lambda kb: jax.random.fold_in(
+                jax.random.fold_in(kb, DRAFT_STREAM), j))(base)
+            drawn = jax.vmap(lambda k_, row: jax.random.categorical(k_, row))(
+                keys_j, filt)
+            nxt = jnp.where(greedy_row, greedy, drawn).astype(jnp.int32)
+            return (nxt, dk, dv), (nxt, filt)
+
+        (_, draft_k, draft_v), (draft_seq, q_seq) = jax.lax.scan(
+            draft_step, (tokens, draft_k, draft_v), jnp.arange(K + 1))
+        draft_toks = jnp.moveaxis(draft_seq[:K], 0, 1)   # [b, K]
+        q_filt = jnp.moveaxis(q_seq[:K], 0, 1)           # [b, K, v]
+
+        # ---- 2) target verify: k+1 positions flattened into the batch ----
+        S = K + 1
+        block = jnp.concatenate([tokens[:, None], draft_toks], axis=1)
+        flat_tok = block.reshape(b * S)
+        flat_pos = (positions[:, None]
+                    + jnp.arange(S)[None, :]).reshape(b * S)
+        # same null-page routing as the draft loop: verify rows past a
+        # slot's depth are discarded by the acceptance mask, and their
+        # writes must never clip into a live page at the budget edge
+        live = (jnp.arange(S)[None, :] <= k_eff[:, None]).reshape(b * S)
+        flat_bt = jnp.where(live[:, None],
+                            jnp.repeat(block_tables, S, axis=0), 0)
+        with jax.named_scope(scope_t):
+            logits, (pool_k, pool_v) = model_forward(
+                cfg, params, flat_tok[:, None],
+                position_ids=flat_pos[:, None], rope_cache=rope_t,
+                kv_caches=(pool_k, pool_v),
+                paged=PagedState(flat_bt, flat_pos))
+        t_logits = logits[:, 0].reshape(b, S, -1)      # [b, K+1, v_padded]
+
+        rep = lambda x: jnp.repeat(x, S, axis=0)  # noqa: E731
+        t_filt_flat, t_greedy_flat = filtered_logits_per_slot(
+            t_logits.reshape(b * S, -1), top_k=rep(top_k), top_p=rep(top_p),
+            temperature=rep(temperature), vocab_size=vocab)
+        t_filt = t_filt_flat.reshape(b, S, -1)
+        t_greedy = t_greedy_flat.reshape(b, S)
+
+        # ---- 3) lossless acceptance ----
+        u = jax.vmap(lambda kb: jax.random.uniform(
+            jax.random.fold_in(kb, ACCEPT_STREAM), (K,)))(base)
+        emit_keys = jax.vmap(
+            lambda kb: jax.random.fold_in(kb, EMIT_STREAM))(base)
+        accepted, counts, emit = speculative_acceptance(
+            draft_toks, q_filt, t_filt, t_greedy, greedy_row, k_eff,
+            u, emit_keys)
+
+        # reported per-token log-probs come from the RAW target logits,
+        # exactly like the non-speculative tick's gather
+        emit_logp = gen._gather_token_log_probs(t_logits, emit)
+
+        new_pos = positions + counts
+        new_steps = steps + counts
+        new_tok = jnp.take_along_axis(
+            emit, (counts - 1)[:, None], axis=1)[:, 0]
+        return (pool_k, pool_v, draft_k, draft_v, emit, emit_logp,
+                accepted, counts, new_pos, new_tok, new_steps)
+
+    return spec_tick

@@ -259,6 +259,94 @@ def test_committed_slo_evidence_is_valid():
     assert "error" not in rec
 
 
+def test_spec_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode spec (ISSUE 9) reuses the off-TPU contract:
+    headline 0, the spec-on/off comparison + acceptance rate ride under
+    cpu_sanity with the budget fields populated."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_spec_decode_speedup_llama470m_c1_1chip",
+        "value": 1.7, "unit": "x", "backend": "cpu",
+        "speedup_ok": True, "acceptance_rate": 1.0, "spec_k": 4,
+        "compile_time_s": 5.0, "step_time_s": 0.013,
+        "rows": [{"concurrency": 1, "speedup": 1.7,
+                  "on": {"decode_tok_s": 350.0, "acceptance_rate": 1.0},
+                  "off": {"decode_tok_s": 206.0}}],
+    }, tag="engine_decode_spec")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["speedup_ok"] is True
+    assert line["cpu_sanity"]["acceptance_rate"] == 1.0
+    assert line["budgets"]["compile_time_s"]["value"] == 5.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "engine_spec", "value": 2.1,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_spec")
+    assert bench.load_last_tpu(tag="engine_decode_spec")["value"] == 2.1
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_spec_bench_in_watch_jobs():
+    """ISSUE 9: the speculative-decoding bench is in the tunnel-up capture
+    list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_spec" in by_name
+    cmd, bounded, pred = by_name["bench_decode_spec"]
+    assert "--mode" in cmd and "spec" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_spec_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_spec_cpu_sanity.json)
+    satisfies the contract: headline 0 off-TPU, >= 1.3x decode tok/s at
+    concurrency 1 with the acceptance rate alongside, budgets populated,
+    and the line is one an error-rejecting watch predicate accepts."""
+    import json as _json
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "BENCH_decode_spec_cpu_sanity.json"
+    rec = _json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["speedup_ok"] is True
+    assert sanity["acceptance_rate"] is not None
+    by_c = {r["concurrency"]: r for r in sanity["rows"]}
+    assert by_c[1]["speedup"] >= 1.3
+    for row in by_c.values():
+        assert {"decode_tok_s", "latency_p50_ms",
+                "latency_p99_ms"} <= set(row["on"])
+        assert "acceptance_rate" in row["on"]
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+    # the watch predicate's contract: an error-stamped line of this very
+    # shape must be rejected (not captured as evidence)
+    stamped = dict(rec)
+    stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
+    assert not _bench_on_tpu(json.dumps(stamped))
+
+
+def test_trace_cost_budget_on_observability_line(evidence_dir):
+    """ROADMAP item 4 leftover: the observability evidence line carries
+    tracer-cost budget verdicts — within limits it annotates, a tracer
+    regression stamps ``error`` the watch predicate rejects."""
+    ok = bench.cpu_contract_line({
+        "metric": "train_observability_overhead_llama470m_1chip",
+        "value": 1.9, "unit": "steps/s", "backend": "cpu",
+        "overhead_pct": 1.2, "instrument_cost_us_per_step": 110.0,
+    }, tag="observability")
+    assert ok["budgets"]["instrument_cost_us_per_step"]["budget"] == 2000.0
+    assert ok["budgets"]["overhead_pct"]["budget"] == 10.0
+    assert "error" not in ok
+
+    drifted = bench.cpu_contract_line({
+        "metric": "train_observability_overhead_llama470m_1chip",
+        "value": 1.9, "unit": "steps/s", "backend": "cpu",
+        "overhead_pct": 1.2, "instrument_cost_us_per_step": 5000.0,
+    }, tag="observability")
+    assert "instrument_cost_us_per_step" in drifted["error"]
+    assert not _bench_on_tpu(json.dumps(drifted))
+
+
 def test_resilience_smoke_in_watch_jobs():
     """ISSUE 3: the resilience chaos smoke is in the tunnel-up capture
     list.  Unlike the bench jobs it IS bounded by --job_timeout: its

@@ -335,6 +335,39 @@ def test_historic_pinned_key_reuse():
     assert not [f for f in findings_for(ok) if f.rule == "rng-key-reuse"]
 
 
+def test_historic_spec_draft_verify_key_reuse():
+    """ISSUE 9: the speculative tick derives draft-sampling keys, an
+    acceptance-uniform key, and the rejection-residual key from one
+    per-(request, step) base.  The buggy shape — the rejection sampler
+    re-consuming the key the acceptance uniforms already consumed — makes
+    the residual draw perfectly correlated with the accept/reject coin,
+    which silently biases the 'lossless' output distribution.  The rule
+    must flag the reuse; the shipped disjoint-fold_in fan-out
+    (speculative/verify.py) must stay clean."""
+    bad = (
+        "import jax\n"
+        "def accept_and_emit(base_key, k, resid_logits):\n"
+        "    u = jax.random.uniform(base_key, (k,))\n"
+        "    tok = jax.random.categorical(base_key, resid_logits)\n"
+        "    return u, tok\n"
+    )
+    hits = [f for f in findings_for(bad) if f.rule == "rng-key-reuse"]
+    assert [f.line for f in hits] == [4]
+    # the shipped shape: one fold_in per stream, each derived key
+    # consumed exactly once
+    ok = (
+        "import jax\n"
+        "ACCEPT_STREAM, EMIT_STREAM = 2, 3\n"
+        "def accept_and_emit(base_key, k, resid_logits):\n"
+        "    u = jax.random.uniform("
+        "jax.random.fold_in(base_key, ACCEPT_STREAM), (k,))\n"
+        "    tok = jax.random.categorical("
+        "jax.random.fold_in(base_key, EMIT_STREAM), resid_logits)\n"
+        "    return u, tok\n"
+    )
+    assert not [f for f in findings_for(ok) if f.rule == "rng-key-reuse"]
+
+
 def test_docstring_prose_never_false_positives():
     """The _strip_comment bug class, pinned: the old line scanner
     flagged forbidden spellings inside string literals and observability

@@ -375,6 +375,97 @@ def test_sample_per_slot_temperature_is_ignored_for_greedy():
     assert int(out[0]) == 1
 
 
+def test_sample_per_slot_temperature_to_zero_approaches_greedy():
+    """temperature -> 0 collapses the categorical onto the argmax: every
+    sampled row must equal the greedy pick whatever its key (the edge the
+    speculative verify's acceptance distributions inherit)."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(6, 32)) * 2, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(6))
+    out = sample_per_slot(
+        keys, logits, top_k=jnp.zeros((6,), jnp.int32),
+        top_p=jnp.zeros((6,), jnp.float32),
+        temperature=jnp.full((6,), 1e-4, jnp.float32))
+    assert np.array_equal(np.asarray(out),
+                          np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+def test_sample_per_slot_top_k_1_is_exact_argmax():
+    """top_k=1 rows are EXACTLY argmax over the vocab-masked logits — no
+    key dependence, no temperature, padding never wins.  Greedy
+    speculative acceptance compares against this value bitwise."""
+    rng = np.random.default_rng(4)
+    logits = np.asarray(rng.normal(size=(4, 32)) * 2, np.float32)
+    logits[:, 30:] = 50.0  # padding region would win without the mask
+    logits = jnp.asarray(logits)
+    outs = []
+    for seed in (0, 7):
+        keys = jax.vmap(jax.random.PRNGKey)(seed + jnp.arange(4))
+        outs.append(np.asarray(sample_per_slot(
+            keys, logits, top_k=jnp.ones((4,), jnp.int32),
+            top_p=jnp.zeros((4,), jnp.float32),
+            temperature=jnp.asarray([1.0, 0.2, 5.0, 1.0]),
+            vocab_size=30)))
+    assert np.array_equal(outs[0], outs[1])  # keys are irrelevant
+    assert np.all(outs[0] < 30)              # padding masked
+    masked = jnp.where(jnp.arange(32)[None, :] >= 30, -1e10, logits)
+    assert np.array_equal(outs[0], np.asarray(jnp.argmax(masked, axis=-1)))
+
+
+def test_sample_per_slot_per_row_key_independence_under_fold_in():
+    """The engine derives row keys as fold_in(request_key, step): rows
+    sharing LOGITS but folded with different data must draw independently,
+    the same (key, data) pair must redraw identically wherever the row
+    sits, and reusing a consumed key reproduces the draw — the reuse
+    hazard the speculative verify avoids with disjoint fold_in streams
+    (graftcheck rng-key-reuse)."""
+    rng = np.random.default_rng(5)
+    row = rng.normal(size=(1, 64)).astype(np.float32)
+    logits = jnp.asarray(np.repeat(row, 8, axis=0))
+    base = jax.random.PRNGKey(42)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(8))
+    kw = dict(top_k=jnp.zeros((8,), jnp.int32),
+              top_p=jnp.zeros((8,), jnp.float32),
+              temperature=jnp.full((8,), 1.5, jnp.float32))
+    out = np.asarray(sample_per_slot(keys, logits, **kw))
+    # identical logits, distinct fold_in data -> not one collapsed draw
+    assert len(set(out.tolist())) > 1
+    # same fold_in data in a different slot -> identical draw
+    perm = jnp.asarray([3, 0, 6, 1, 7, 2, 5, 4])
+    out_p = np.asarray(sample_per_slot(
+        keys[perm], logits, **kw))
+    assert np.array_equal(out_p, out[np.asarray(perm)])
+    # a REUSED key replays its draw exactly (why streams must be disjoint)
+    twice = jnp.concatenate([keys[:1], keys[:1]], axis=0)
+    out_r = np.asarray(sample_per_slot(
+        twice, logits[:2], top_k=kw["top_k"][:2], top_p=kw["top_p"][:2],
+        temperature=kw["temperature"][:2]))
+    assert out_r[0] == out_r[1]
+
+
+def test_filtered_logits_per_slot_is_the_sampler_distribution():
+    """softmax(filtered_logits_per_slot(...)) IS the categorical the
+    sampler draws from: drawing from the returned logits with the same
+    keys reproduces sample_per_slot exactly.  The speculative rejection
+    sampler's p and q hang on this equivalence."""
+    from megatron_llm_tpu.generation.sampling import filtered_logits_per_slot
+
+    rng = np.random.default_rng(6)
+    logits = jnp.asarray(rng.normal(size=(5, 40)) * 3, jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(5))
+    top_k = jnp.asarray([1, 4, 0, 0, 2], jnp.int32)
+    top_p = jnp.asarray([0.0, 0.0, 0.8, 0.0, 0.0], jnp.float32)
+    temp = jnp.asarray([1.0, 0.7, 1.3, 2.0, 1.0], jnp.float32)
+    filtered, greedy = filtered_logits_per_slot(
+        logits, top_k=top_k, top_p=top_p, temperature=temp, vocab_size=38)
+    manual = jnp.where(
+        top_k == 1, greedy,
+        jax.vmap(lambda k, r: jax.random.categorical(k, r))(keys, filtered))
+    out = sample_per_slot(keys, logits, top_k=top_k, top_p=top_p,
+                          temperature=temp, vocab_size=38)
+    assert np.array_equal(np.asarray(manual), np.asarray(out))
+
+
 # ---------------------------------------------------------------------------
 # cached_jit regression (satellite: id(cfg) keying)
 # ---------------------------------------------------------------------------

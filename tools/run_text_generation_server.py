@@ -24,6 +24,14 @@ and slo policies reorder admission, preempt low-value decodes by page
 release (resume is bitwise through the prefix cache), and shed requests
 whose deadline is already unmeetable.  ``--sched_aging_s`` bounds
 starvation, ``--sched_quota "0:64,2:16"`` bounds queue depth per class.
+
+Speculative decoding (``--spec_k N --spec_draft
+"llama2:num_layers=2,...[@/ckpt/dir]"``, generation/speculative/): a
+draft model proposes N tokens per tick and the target verifies them in
+one forward — losslessly (greedy output is bitwise-identical to
+``--spec_k 0``; sampled output matches the target distribution).  The
+draft's K/V shares the engine's paged pool; ``/health`` exposes the
+live acceptance rate under ``spec``.
 """
 
 from __future__ import annotations
@@ -109,6 +117,9 @@ def main():
     kind = "legacy" if args.legacy_engine else "continuous-batching"
     if not args.legacy_engine:
         kind += f", sched={engine.policy.name}"
+        if engine.spec_k:
+            kind += (f", spec_k={engine.spec_k} "
+                     f"(draft {engine.draft_cfg.model.num_layers}L)")
     print(f"serving ({kind}) on http://{args.host}:{args.port}/api",
           flush=True)
     server.run(args.host, args.port)

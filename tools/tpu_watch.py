@@ -146,6 +146,12 @@ JOBS = [
     ("bench_decode_slo",
      [sys.executable, "bench_decode.py", "--mode", "slo"],
      False, _bench_on_tpu),
+    # ISSUE 9: speculative decoding — spec on/off decode tok/s, per-request
+    # p50/p99 latency and acceptance rate across occupancy levels
+    # (bench_decode.py --mode spec, engine_decode_spec evidence)
+    ("bench_decode_spec",
+     [sys.executable, "bench_decode.py", "--mode", "spec"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
