@@ -43,6 +43,21 @@ Two modes:
   decode tok/s speedup at concurrency 1 — the latency-bound shape
   speculative decoding exists for.  Gate: >= 1.3x.
 
+* ``--mode router`` (ISSUE 10): a 2-replica fleet (each a real
+  continuous-batching engine behind a real MegatronServer on an ephemeral
+  port) fronted by the cross-replica router (serving/router/), on the
+  fleet version of the shared-prefix workload: G prompt groups, each
+  sharing a long system prompt with distinct tails.  The same traffic runs
+  through ``prefix_affinity`` (consistent hashing on the prompt prefix)
+  and ``round_robin``; each arm reports the FLEET-wide prefix-hit rate and
+  client-observed mean/p99 TTFT (non-streaming replicas deliver the whole
+  body at first byte, so time-to-response is the TTFT the client sees).
+  After the comparison, one replica is killed mid-run (listening socket
+  closed) under continued traffic: the failover section must show zero
+  dropped requests and the breaker ejecting the dead replica.  Gate:
+  prefix_affinity beats round_robin on BOTH fleet hit rate and mean TTFT,
+  and the failover drops nothing.
+
 Same tunnel-hardening contract as bench.py: backend probed in a bounded
 subprocess; off-TPU the headline is 0 with the run riding under
 ``cpu_sanity`` (a CPU timing is not a TPU measurement); TPU measurements
@@ -72,19 +87,21 @@ METRIC = "engine_decode_tok_s_llama470m_c8_1chip"
 METRIC_PREFIX = "engine_prefix_prefill_reduction_llama470m_c8_1chip"
 METRIC_SLO = "engine_slo_hi_p99_ttft_speedup_llama470m_1chip"
 METRIC_SPEC = "engine_spec_decode_speedup_llama470m_c1_1chip"
+METRIC_ROUTER = "router_prefix_affinity_ttft_speedup_llama470m_2rep_1chip"
 
 # every mode decodes greedily with termination disabled: runs are
 # workload-shaped, never content-shaped
 GREEDY_KW = dict(top_k=1, termination_id=0, use_eod_for_termination=False)
 
 
-def make_engine(cfg, params, **engine_kw):
+def make_engine(cfg, params, tokenizer=None, **engine_kw):
     """THE engine construction point shared by every bench mode — one
     place to thread geometry/policy/spec knobs, so modes can't drift
-    apart in setup."""
+    apart in setup.  Router mode passes a tokenizer (its traffic arrives
+    as HTTP text); the direct-submit modes run tokenless."""
     from megatron_llm_tpu.generation import ContinuousBatchingEngine
 
-    return ContinuousBatchingEngine(cfg, params, None, **engine_kw)
+    return ContinuousBatchingEngine(cfg, params, tokenizer, **engine_kw)
 
 
 def run_workload(eng, jobs, timeout: float = 600.0):
@@ -407,12 +424,200 @@ def bench_spec(cfg, params, draft, levels, prompt, gen, vocab,
     }
 
 
+class _CharTok:
+    """Deterministic char-level tokenizer for the router fleet (the wire
+    carries text; 1 char == 1 token keeps prefix lengths exact)."""
+
+    eod = 0
+    bos = 1
+
+    def __init__(self, vocab: int):
+        self._n = vocab
+
+    @property
+    def vocab_size(self):
+        return self._n
+
+    def tokenize(self, text):
+        return [2 + (ord(c) % (self._n - 2)) for c in text]
+
+    def detokenize(self, ids):
+        return "".join(chr(97 + (int(i) % 26)) for i in ids if i >= 2)
+
+
+def bench_router(cfg, params, n_replicas: int, groups: int, per_group: int,
+                 shared_len: int, tail_len: int, gen: int, vocab: int,
+                 slots: int, client_concurrency: int = 4) -> dict:
+    """Fleet shared-prefix workload: prefix_affinity vs round_robin, then
+    a mid-run replica kill under the affinity arm (see module doc)."""
+    import random
+    import string
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from megatron_llm_tpu.generation.server import MegatronServer
+    from megatron_llm_tpu.observability.registry import get_registry
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    rng = random.Random(3)
+    letters = string.ascii_letters + string.digits
+    shareds = ["".join(rng.choice(letters) for _ in range(shared_len))
+               for _ in range(groups)]
+    tails = [["".join(rng.choice(letters) for _ in range(tail_len))
+              for _ in range(per_group)] for _ in range(groups)]
+    gen_kw = {"tokens_to_generate": gen, "top_k": 1}
+
+    def put(base_url: str, prompt: str):
+        req = urllib.request.Request(
+            base_url + "/api",
+            data=json.dumps({"prompts": [prompt], **gen_kw}).encode(),
+            headers={"Content-Type": "application/json"}, method="PUT")
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                resp.read()
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            code = e.code
+        except urllib.error.URLError:
+            code = 0
+        return code, time.perf_counter() - t0
+
+    # the pool must be able to hold several groups' cached prefixes PLUS
+    # the active slots' commitments, or LRU eviction silently turns the
+    # workload into a cache-thrash benchmark (page_size from cfg.inference)
+    ps = cfg.inference.page_size
+    pages_per_seq = -(-(shared_len + tail_len + gen + 1) // ps)
+    pool_pages = (groups + slots) * (pages_per_seq + 1) + 16
+
+    def spawn_fleet(policy: str):
+        engines, servers, urls = [], [], []
+        for _ in range(n_replicas):
+            eng = make_engine(cfg, params, tokenizer=_CharTok(vocab),
+                              max_slots=slots, num_pages=pool_pages,
+                              max_seq=shared_len + tail_len + gen + 1)
+            srv = MegatronServer(eng)
+            port = srv.start_background(port=0)  # ephemeral: no port races
+            engines.append(eng)
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{port}")
+        kwargs = (dict(prefix_chars=shared_len)
+                  if policy == "prefix_affinity" else {})
+        router = RouterServer(urls, policy=policy, policy_kwargs=kwargs,
+                              poll_interval=0.25, forward_timeout_s=600.0)
+        rport = router.start_background()
+        return engines, servers, urls, router, f"http://127.0.0.1:{rport}"
+
+    def run_arm(policy: str) -> dict:
+        engines, servers, urls, router, base = spawn_fleet(policy)
+        try:
+            # warm: one request per group (compiles + seeds each group's
+            # prefix wherever this policy lands it — same procedure both
+            # arms, so neither gets a head start)
+            t0 = time.perf_counter()
+            for g in range(groups):
+                code, _ = put(base, shareds[g] + tails[g][0])
+                assert code == 200, f"warm request failed: {code}"
+            warm_s = time.perf_counter() - t0
+            hit0 = sum(e.prefix_hit_tokens for e in engines)
+            miss0 = sum(e.prefix_miss_tokens for e in engines)
+            pre0 = sum(e.prefill_tokens_computed for e in engines)
+            ticks0 = sum(e.ticks for e in engines)
+            jobs = [(shareds[g] + tails[g][r])
+                    for r in range(1, per_group)
+                    for g in range(groups)]
+            # deterministic shuffle: real arrivals are not group-aligned,
+            # and an interleave that happens to alternate groups in fleet
+            # parity would hand round_robin accidental affinity
+            random.Random(11).shuffle(jobs)
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=client_concurrency) as ex:
+                results = list(ex.map(lambda p: put(base, p), jobs))
+            wall = time.perf_counter() - t0
+            assert all(c == 200 for c, _ in results), (
+                f"measured-phase failures: {[c for c, _ in results]}")
+            lat = sorted(t for _, t in results)
+            hit = sum(e.prefix_hit_tokens for e in engines) - hit0
+            miss = sum(e.prefix_miss_tokens for e in engines) - miss0
+            ticks = sum(e.ticks for e in engines) - ticks0
+            arm = {
+                "policy": policy,
+                "n_requests": len(jobs),
+                "fleet_hit_rate": round(hit / max(hit + miss, 1), 4),
+                "prefill_tokens_computed":
+                    sum(e.prefill_tokens_computed for e in engines) - pre0,
+                "ttft_mean_ms": round(1e3 * sum(lat) / len(lat), 2),
+                "ttft_p99_ms": round(1e3 * _percentile(lat, 99), 2),
+                "wall_s": round(wall, 4),
+                "decode_tok_s": round(len(jobs) * gen / wall, 1),
+                "warm_s": round(warm_s, 2),
+                "ticks": ticks,
+                "per_replica_ticks": [e.ticks for e in engines],
+            }
+            if policy != "prefix_affinity":
+                return arm
+            # ---- failover: kill the busiest replica mid-run -------------
+            victim = max(range(n_replicas),
+                         key=lambda i: engines[i].ticks)
+            reg = get_registry()
+            fo0 = reg.counter("mlt_router_failovers_total").value
+            servers[victim].stop()  # socket closed: connects now refused
+            fo_jobs = [(shareds[g] + tails[g][0] + "X")
+                       for g in range(groups) for _ in range(2)]
+            with ThreadPoolExecutor(max_workers=client_concurrency) as ex:
+                fo_results = list(ex.map(lambda p: put(base, p), fo_jobs))
+            dropped = sum(c != 200 for c, _ in fo_results)
+            arm["failover"] = {
+                "killed": urls[victim],
+                "requests": len(fo_jobs),
+                "dropped": dropped,
+                "failovers": int(
+                    reg.counter("mlt_router_failovers_total").value - fo0),
+                "killed_state": router.registry.get(urls[victim]).state,
+                "ok": dropped == 0,
+            }
+            return arm
+        finally:
+            router.stop()
+            for srv in servers:
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+
+    t0 = time.perf_counter()
+    rr = run_arm("round_robin")  # first arm also eats the compiles
+    compile_s = time.perf_counter() - t0
+    aff = run_arm("prefix_affinity")
+    speedup = rr["ttft_mean_ms"] / max(aff["ttft_mean_ms"], 1e-9)
+    hit_gain = aff["fleet_hit_rate"] - rr["fleet_hit_rate"]
+    return {
+        "n_replicas": n_replicas,
+        "groups": groups,
+        "per_group": per_group,
+        "shared_len": shared_len,
+        "tail_len": tail_len,
+        "gen_len": gen,
+        "ttft_mean_speedup": round(speedup, 2),
+        "fleet_hit_rate_gain": round(hit_gain, 4),
+        "speedup_ok": (speedup >= 1.05 and hit_gain > 0
+                       and aff["failover"]["ok"]),
+        "failover": aff["failover"],
+        "compile_time_s": round(compile_s, 1),
+        "step_time_s": round(aff["wall_s"] / max(aff["ticks"], 1), 6),
+        "rows": [rr, aff],
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
     prefix_mode = args.mode == "shared_prefix"
     slo_mode = args.mode == "slo"
     spec_mode = args.mode == "spec"
+    router_mode = args.mode == "router"
     draft_layers = 2
     if probe_backend(args.probe_timeout) == "cpu":
         from megatron_llm_tpu.utils.platform import pin_cpu_platform
@@ -426,6 +631,15 @@ def _run(args, finished):
         args.shared, args.tail = 96, 8
         args.slots, args.n_hi, args.n_lo = 2, 6, 6
         args.gen_lo, args.ttft_slo = 48, 250.0
+        if router_mode:
+            # prefill-heavy fleet shape: the shared prefix dominates each
+            # request (384 prefix tokens vs 8 generated), so WHERE a
+            # request lands (cache hot vs cold) is what the TTFT measures;
+            # 6 prompt families keep the hash ring's split of groups
+            # across 2 replicas near-even
+            args.shared, args.tail, args.gen = 384, 8, 8
+            args.groups, args.per_group = 6, 6
+            args.slots = 4
         if spec_mode:
             # the target must out-depth the 1-layer draft by enough that
             # drafting is visibly cheaper than verifying
@@ -453,7 +667,11 @@ def _run(args, finished):
     with global_mesh(mesh):
         params = init_model_params(cfg, jax.random.PRNGKey(0))
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        if prefix_mode:
+        if router_mode:
+            row = bench_router(cfg, params, args.replicas, args.groups,
+                               args.per_group, args.shared, args.tail,
+                               args.gen, vocab, args.slots)
+        elif prefix_mode:
             c = levels[-1]
             row = bench_shared_prefix(cfg, params, c, args.shared,
                                       args.tail, args.gen, vocab)
@@ -487,7 +705,26 @@ def _run(args, finished):
             rows = [bench_engine(cfg, params, c, args.prompt, args.gen,
                                  vocab, args.reps) for c in levels]
 
-    if spec_mode:
+    if router_mode:
+        result = {
+            "metric": METRIC_ROUTER,
+            "value": row["ttft_mean_speedup"],
+            "unit": "x",
+            "speedup_ok": row["speedup_ok"],
+            "fleet_hit_rate_gain": row["fleet_hit_rate_gain"],
+            "failover": row["failover"],
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in
+                         ("n_replicas", "groups", "per_group", "shared_len",
+                          "tail_len", "gen_len")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_router"
+    elif spec_mode:
         result = {
             "metric": METRIC_SPEC,
             "value": row["speedup_c1"],
@@ -565,7 +802,8 @@ def _run(args, finished):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode",
-                    choices=("occupancy", "shared_prefix", "slo", "spec"),
+                    choices=("occupancy", "shared_prefix", "slo", "spec",
+                             "router"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
@@ -589,6 +827,13 @@ def main():
                     help="batch-request generation length (slo mode)")
     ap.add_argument("--ttft_slo", type=float, default=2000.0,
                     help="interactive TTFT deadline in ms (slo mode)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size (router mode)")
+    ap.add_argument("--groups", type=int, default=4,
+                    help="shared-prefix prompt families (router mode)")
+    ap.add_argument("--per_group", type=int, default=6,
+                    help="requests per prompt family incl. the warm one "
+                         "(router mode)")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--probe_timeout", type=float, default=120.0)
     ap.add_argument("--watchdog", type=float, default=1500.0)
@@ -597,8 +842,9 @@ def main():
     if args.mode == "spec" and args.concurrency == "1,4,8":
         args.concurrency = "1,2,4,8"
     metric = {"shared_prefix": METRIC_PREFIX, "slo": METRIC_SLO,
-              "spec": METRIC_SPEC}.get(args.mode, METRIC)
-    unit = ("x" if args.mode in ("shared_prefix", "slo", "spec")
+              "spec": METRIC_SPEC, "router": METRIC_ROUTER}.get(
+                  args.mode, METRIC)
+    unit = ("x" if args.mode in ("shared_prefix", "slo", "spec", "router")
             else "tok/s")
     finished = threading.Event()
 

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import json
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
@@ -157,6 +159,16 @@ class MegatronServer:
         # (enqueue + future); a server-level lock would undo the batching
         self.batching = hasattr(engine, "submit")
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # replica identity for the cross-replica router (serving/router/):
+        # replica_id survives for the process lifetime, so a router sees a
+        # restart as an id change; seq orders /health payloads so a stale
+        # poll can never overwrite a fresher view; uptime_s is the
+        # restart-detection cross-check (it must only move forward for the
+        # same replica_id).  Schema: docs/guide/serving.md "/health payload".
+        self.replica_id = uuid.uuid4().hex
+        self._t_start = time.monotonic()
+        self._health_seq = 0  # guarded by _seq_lock
+        self._seq_lock = threading.Lock()
 
     def handle_request(self, payload):
         """Core PUT /api logic; returns (status_code, response dict)."""
@@ -283,9 +295,21 @@ class MegatronServer:
         return Handler
 
     def health(self) -> dict:
-        """Liveness + engine occupancy + prefix-cache state (continuous-
-        batching engines only)."""
-        info = {"status": "ok", "batching": self.batching}
+        """Liveness + replica identity + engine occupancy + prefix-cache
+        state (continuous-batching engines only).  The full payload schema
+        lives in docs/guide/serving.md ("/health payload") — keep the two
+        in sync; the router's ReplicaView (serving/router/registry.py) is
+        the consumer."""
+        with self._seq_lock:
+            self._health_seq += 1
+            seq = self._health_seq
+        info = {
+            "status": "ok",
+            "batching": self.batching,
+            "replica_id": self.replica_id,
+            "seq": seq,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+        }
         eng = self.engine
         if self.batching:
             with eng._lock:
@@ -304,6 +328,7 @@ class MegatronServer:
                     prefix_hit_tokens=eng.prefix_hit_tokens,
                     prefix_miss_tokens=eng.prefix_miss_tokens,
                     ticks=eng.ticks,
+                    page_size=eng.page_size,
                 )
             mesh = getattr(eng, "mesh", None)
             info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}
@@ -346,22 +371,39 @@ class MegatronServer:
         if self.batching and hasattr(self.engine, "start"):
             self.engine.start()  # background scheduler drives shared ticks
 
-    def run(self, host: str = "0.0.0.0", port: int = 5000):
-        self._start_engine()
+    def bind(self, host: str = "0.0.0.0", port: int = 5000) -> int:
+        """Bind the listening socket (without serving) and return the bound
+        port — with ``port=0`` the OS picks a free one, which is how local
+        fleets (tests, bench_decode --mode router) avoid port races.  Call
+        ``serve()`` afterwards to block."""
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        return self._httpd.server_address[1]
+
+    def serve(self):
+        """Serve on the socket from ``bind()`` (blocking)."""
+        assert self._httpd is not None, "call bind() first"
+        self._start_engine()
         self._httpd.serve_forever()
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000):
+        self.bind(host, port)
+        self.serve()
 
     def start_background(self, host: str = "127.0.0.1", port: int = 5000):
         """Run in a daemon thread (used by tests); returns the bound port."""
+        bound = self.bind(host, port)
         self._start_engine()
-        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
-        return self._httpd.server_address[1]
+        return bound
 
     def stop(self):
         if self._httpd is not None:
             self._httpd.shutdown()
+            # close the listening socket too: new connections must be
+            # REFUSED (a router fails over on that), not sit in a backlog
+            # nobody will ever accept
+            self._httpd.server_close()
             self._httpd = None
         if self.batching and hasattr(self.engine, "stop"):
             self.engine.stop()

@@ -1,0 +1,8 @@
+"""Serving tiers above a single generation-server process.
+
+``serving.router`` is the cross-replica request router: it fronts N
+generation-server replicas (tools/run_text_generation_server.py), polls
+their ``/health`` control plane, and load-balances ``PUT /api`` traffic
+across them (tools/run_router.py, docs/guide/serving.md
+"Cross-replica routing").
+"""

@@ -1,0 +1,71 @@
+"""Cross-replica request router (tools/run_router.py fronts it).
+
+Four parts, one module each:
+
+* registry.py — ReplicaView parsing, circuit-breaker lifecycle
+  (healthy/suspect/ejected/draining), background /health pollers
+* policy.py + policies.py — the RouterPolicy interface and the four
+  policies: round_robin, least_loaded, prefix_affinity, slo_aware
+* proxy.py — the forwarding data plane: timeouts, failover, bounded
+  Retry-After-honoring retries, never-retry-partial-streams
+* server.py — the HTTP tier: PUT /api, GET /health (fleet summary),
+  GET /metrics, POST /admin/drain
+
+Guide: docs/guide/serving.md "Cross-replica routing".
+"""
+
+from megatron_llm_tpu.serving.router.policies import (  # noqa: F401
+    LeastLoadedPolicy,
+    PrefixAffinityPolicy,
+    RoundRobinPolicy,
+    SloAwarePolicy,
+    prefix_key,
+)
+from megatron_llm_tpu.serving.router.policy import (  # noqa: F401
+    FleetOverloaded,
+    RouteRequest,
+    RouterPolicy,
+    available_router_policies,
+    get_router_policy,
+    register_router_policy,
+)
+from megatron_llm_tpu.serving.router.proxy import (  # noqa: F401
+    ForwardingProxy,
+    ForwardOutcome,
+)
+from megatron_llm_tpu.serving.router.registry import (  # noqa: F401
+    DRAINING,
+    EJECTED,
+    HEALTHY,
+    SUSPECT,
+    HealthPoller,
+    Replica,
+    ReplicaRegistry,
+    ReplicaView,
+)
+from megatron_llm_tpu.serving.router.server import RouterServer  # noqa: F401
+
+__all__ = [
+    "DRAINING",
+    "EJECTED",
+    "HEALTHY",
+    "SUSPECT",
+    "FleetOverloaded",
+    "ForwardOutcome",
+    "ForwardingProxy",
+    "HealthPoller",
+    "LeastLoadedPolicy",
+    "PrefixAffinityPolicy",
+    "Replica",
+    "ReplicaRegistry",
+    "ReplicaView",
+    "RoundRobinPolicy",
+    "RouteRequest",
+    "RouterPolicy",
+    "RouterServer",
+    "SloAwarePolicy",
+    "available_router_policies",
+    "get_router_policy",
+    "prefix_key",
+    "register_router_policy",
+]

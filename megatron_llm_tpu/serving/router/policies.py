@@ -1,0 +1,192 @@
+"""The four routing policies: round_robin, least_loaded, prefix_affinity,
+slo_aware.
+
+Each consumes :class:`ReplicaView` snapshots only (serving/router/
+registry.py) and returns a preference-ordered candidate list; the proxy
+walks it for failover.  Policy matrix + tuning guidance:
+docs/guide/serving.md "Cross-replica routing".
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+from typing import List, Optional, Sequence, Tuple
+
+from megatron_llm_tpu.serving.router.policy import (
+    FleetOverloaded,
+    RouteRequest,
+    RouterPolicy,
+    register_router_policy,
+)
+from megatron_llm_tpu.serving.router.registry import ReplicaView
+
+__all__ = [
+    "LeastLoadedPolicy",
+    "PrefixAffinityPolicy",
+    "RoundRobinPolicy",
+    "SloAwarePolicy",
+    "prefix_key",
+]
+
+
+@register_router_policy
+class RoundRobinPolicy(RouterPolicy):
+    """Baseline: rotate through the routable fleet in stable order.
+
+    The cursor advances per routed request, not per fleet position, so a
+    replica leaving and rejoining does not skew the rotation."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        # itertools.count.__next__ is atomic under the GIL — the only
+        # policy-internal state any of the four keeps
+        self._cursor = itertools.count()
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        k = next(self._cursor) % len(views)
+        return list(views[k:]) + list(views[:k])
+
+
+def _drain_order(views: Sequence[ReplicaView]) -> List[ReplicaView]:
+    """Ascending predicted-backlog order: queue-depth x drain-EMA, ties
+    broken by occupancy then stable fleet order (enumerate keeps the sort
+    deterministic when scores tie exactly)."""
+    return [v for _, _, _, v in sorted(
+        (v.drain_score(), v.load, i, v) for i, v in enumerate(views))]
+
+
+@register_router_policy
+class LeastLoadedPolicy(RouterPolicy):
+    """Send each request to the replica with the least predicted backlog
+    seconds (its queue depth scaled by its own retirement EMA — a replica
+    that drains twice as fast carries twice the queue for the same
+    score)."""
+
+    name = "least_loaded"
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        return _drain_order(views)
+
+
+def prefix_key(text: str, prefix_chars: int) -> bytes:
+    """Affinity key: hash of the request's leading ``prefix_chars``
+    characters.  Page-ALIGNED affinity (token-exact page boundaries) lives
+    in each replica's radix trie; the router only needs requests sharing a
+    system prompt to agree on a key, and a fixed character horizon ~=
+    4 chars/token x the fleet page size does that without a tokenizer.
+    Requests shorter than the horizon hash what they have — identical
+    short prompts still co-locate."""
+    return hashlib.sha256(text[:prefix_chars].encode(
+        "utf-8", errors="replace")).digest()
+
+
+@register_router_policy
+class PrefixAffinityPolicy(RouterPolicy):
+    """Consistent hashing on the prompt-prefix key with a bounded-load
+    escape valve.
+
+    The hash ring carries ``vnodes`` points per replica keyed on
+    ``replica_id`` (NOT the url: a restarted replica gets a new id and so
+    a new ring position — its cache died with the old process, and the
+    re-deal costs nothing that wasn't already lost).  A request walks the
+    ring clockwise from its prefix key; the first routable replica wins —
+    so every request sharing a system prompt lands where that prompt's KV
+    pages already sit (generation/engine.py prefix cache).
+
+    Bounded load (the "power of the ring, limits of the hotspot" rule): if
+    the ring choice's depth exceeds ``load_factor`` x the fleet mean
+    (minimum ``min_headroom`` over the mean, so tiny fleets don't spill on
+    a depth-1 difference), the request spills to the least-loaded replica
+    instead — a hot prefix saturating one replica degrades to load
+    balancing rather than hotspotting.  Failover order after the primary:
+    the remaining replicas in drain order, so a dead primary's traffic
+    spreads by load, not ring adjacency alone."""
+
+    name = "prefix_affinity"
+
+    def __init__(self, *, prefix_chars: int = 256, vnodes: int = 64,
+                 load_factor: float = 1.25, min_headroom: int = 2):
+        if prefix_chars < 1 or vnodes < 1 or load_factor < 1.0:
+            raise ValueError("prefix_chars/vnodes >= 1, load_factor >= 1.0")
+        self.prefix_chars = prefix_chars
+        self.vnodes = vnodes
+        self.load_factor = load_factor
+        self.min_headroom = min_headroom
+
+    def _ring(self, views: Sequence[ReplicaView]
+              ) -> Tuple[List[int], List[ReplicaView]]:
+        points: List[Tuple[int, int, ReplicaView]] = []
+        for i, v in enumerate(views):
+            ident = v.replica_id or v.url
+            for n in range(self.vnodes):
+                h = hashlib.sha256(f"{ident}:{n}".encode()).digest()
+                points.append((int.from_bytes(h[:8], "big"), i, v))
+        points.sort()
+        return [p[0] for p in points], [p[2] for p in points]
+
+    def _ring_choice(self, request: RouteRequest,
+                     views: Sequence[ReplicaView]) -> ReplicaView:
+        keys, owners = self._ring(views)
+        key = int.from_bytes(
+            prefix_key(request.prefix_text, self.prefix_chars)[:8], "big")
+        return owners[bisect.bisect_right(keys, key) % len(owners)]
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        chosen = self._ring_choice(request, views)
+        rest = _drain_order([v for v in views if v is not chosen])
+        mean_depth = sum(v.depth for v in views) / len(views)
+        bound = max(self.load_factor * mean_depth,
+                    mean_depth + self.min_headroom)
+        if rest and chosen.depth > bound:
+            # hot prefix: spill to the least-loaded replica; the ring
+            # choice stays second so affinity resumes once it cools
+            return [rest[0], chosen] + rest[1:]
+        return [chosen] + rest
+
+
+@register_router_policy
+class SloAwarePolicy(RouterPolicy):
+    """Pick the replica whose predicted wait meets the request's TTFT
+    deadline; 503 the request with the fleet-minimum Retry-After when none
+    can.
+
+    ``margin`` discounts the deadline (a prediction exactly at the
+    deadline misses it after forward + prefill cost).  Deadline-less
+    requests degrade to least_loaded — predicted wait IS the drain order
+    then.  The returned order is ascending predicted wait over the
+    *feasible* set, then the infeasible ones (failover may still prefer a
+    live slow replica over a dead fast one)."""
+
+    name = "slo_aware"
+
+    def __init__(self, *, margin: float = 0.8):
+        if not 0.0 < margin <= 1.0:
+            raise ValueError("margin must be in (0, 1]")
+        self.margin = margin
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        ranked = sorted(
+            (v.predicted_wait_s(), i, v) for i, v in enumerate(views))
+        if request.ttft_deadline_ms is None:
+            return [v for _, _, v in ranked]
+        budget_s = request.ttft_deadline_ms / 1e3 * self.margin
+        feasible = [(w, i, v) for w, i, v in ranked if w <= budget_s]
+        if not feasible:
+            waits = {v.url: round(w, 3) for w, _, v in ranked}
+            soonest = max(ranked[0][0], 0.05)
+            raise FleetOverloaded(
+                f"no replica predicts TTFT within "
+                f"{request.ttft_deadline_ms:.0f}ms "
+                f"(fleet-min predicted wait {soonest:.3f}s)",
+                retry_after=min(max(soonest, 1.0), 60.0),
+                info={"predicted_wait_s": waits,
+                      "ttft_deadline_ms": request.ttft_deadline_ms})
+        infeasible = [(w, i, v) for w, i, v in ranked if w > budget_s]
+        return [v for _, _, v in feasible + infeasible]

@@ -1,0 +1,123 @@
+"""RouterPolicy — the routing-decision interface, mirroring the
+SchedulerPolicy shape (generation/scheduling/policy.py): decisions on
+immutable snapshots, a name registry behind ``--policy <name>``, and the
+mechanisms (forwarding, breaker bookkeeping, metrics) kept out of the
+policies entirely.
+
+A policy answers ONE question: given a request and the current routable
+:class:`ReplicaView` snapshots, in what order should the proxy try
+replicas?  Returning an *ordered list* (not a single choice) is what
+makes failover a data-plane mechanism rather than a policy concern — the
+proxy walks the list, skipping replicas that fail mid-request.
+
+A policy may instead raise :class:`FleetOverloaded` when, by its own
+criteria, no replica should take the request now (slo_aware does this
+when no replica's predicted wait meets the TTFT deadline); the router
+maps it to a structured 503 carrying the fleet-minimum Retry-After.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Type
+
+from megatron_llm_tpu.serving.router.registry import ReplicaView
+
+__all__ = [
+    "FleetOverloaded",
+    "RouteRequest",
+    "RouterPolicy",
+    "available_router_policies",
+    "get_router_policy",
+    "register_router_policy",
+]
+
+
+class FleetOverloaded(RuntimeError):
+    """No replica should take this request right now.
+
+    ``retry_after`` is the fleet-minimum drain estimate (the soonest any
+    replica predicts it could serve), ``info`` the per-replica predictions
+    behind it — the router serializes both into the 503 body so a client
+    sees *why* and *when to come back*, same contract as the single-replica
+    EngineOverloaded/RequestShed 503s."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0,
+                 info: Optional[dict] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.info = info or {}
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteRequest:
+    """What a policy may know about a request before forwarding it.
+
+    ``prefix_text`` is the first prompt's text (affinity input);
+    ``ttft_deadline_ms``/``priority`` are the scheduling fields the
+    replicas already accept (generation/server.py validation)."""
+
+    prefix_text: str = ""
+    n_prompts: int = 1
+    priority: int = 1
+    ttft_deadline_ms: Optional[float] = None
+
+    @staticmethod
+    def from_payload(payload: dict) -> "RouteRequest":
+        prompts = payload.get("prompts")
+        if not isinstance(prompts, list) or not prompts:
+            prompts = [""]
+        first = prompts[0] if isinstance(prompts[0], str) else ""
+        pri = payload.get("priority", 1)
+        ttft = payload.get("ttft_deadline_ms")
+        return RouteRequest(
+            prefix_text=first,
+            n_prompts=len(prompts),
+            priority=pri if isinstance(pri, int) else 1,
+            ttft_deadline_ms=(float(ttft) if isinstance(ttft, (int, float))
+                              and not isinstance(ttft, bool) else None),
+        )
+
+
+class RouterPolicy:
+    """Base policy; subclasses order candidates.  Policies must be
+    side-effect free with respect to the fleet — they see snapshots and
+    return an order; internal counters (round_robin's cursor) are the only
+    state they may keep."""
+
+    name = "base"
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        """Routable views in the order the proxy should try them.  ``views``
+        arrives in stable fleet order and is never empty (the router
+        answers "no healthy replicas" 503s before consulting the policy)."""
+        return list(views)
+
+
+# ---------------------------------------------------------------------------
+# Registry (the SchedulerPolicy registration idiom)
+# ---------------------------------------------------------------------------
+
+_ROUTER_POLICIES: Dict[str, Type[RouterPolicy]] = {}
+
+
+def register_router_policy(cls: Type[RouterPolicy]) -> Type[RouterPolicy]:
+    """Class decorator: make ``cls`` reachable as --policy <name>."""
+    if not cls.name or cls.name == "base":
+        raise ValueError("router policy classes must set a unique `name`")
+    _ROUTER_POLICIES[cls.name] = cls
+    return cls
+
+
+def get_router_policy(name: str) -> Type[RouterPolicy]:
+    try:
+        return _ROUTER_POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown router policy {name!r}; available: "
+            f"{', '.join(sorted(_ROUTER_POLICIES))}") from None
+
+
+def available_router_policies() -> List[str]:
+    return sorted(_ROUTER_POLICIES)

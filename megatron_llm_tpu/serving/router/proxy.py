@@ -1,0 +1,204 @@
+"""Forwarding proxy: the router's data plane.
+
+Walks a policy's candidate list forwarding ``PUT /api`` bodies verbatim.
+The failure semantics are the whole point:
+
+* **Connect-phase failure** (refused / DNS / timeout before any response
+  byte): the replica never saw a parseable request — safe to fail over.
+  The failure is reported into the registry breaker
+  (``record_forward_failure``) so the data plane ejects a dead replica
+  without waiting for the next poll tick, and the replica is excluded
+  for the remainder of THIS request.
+* **Response-phase failure** (status line received, then the body dies):
+  the replica may have executed the generation — a retry would re-run a
+  non-idempotent request (burn pages/compute, and for seeded sampling
+  produce a second stream).  Never retried: surfaced as a structured 502.
+* **503 from a replica** (EngineOverloaded / RequestShed): honored, not
+  hammered — the replica's ``Retry-After`` is recorded, the proxy tries
+  the next candidate, and only when every candidate is saturated does it
+  back off (bounded by ``max_retries`` rounds, sleeping the fleet-minimum
+  Retry-After capped at ``backoff_cap_s``) before re-walking the 503'd
+  replicas.  Exhaustion returns an aggregated 503 whose Retry-After is
+  the fleet minimum.
+* **4xx / 200**: terminal either way — forwarded verbatim (a validation
+  error on replica A is a validation error on replica B too).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from megatron_llm_tpu.serving.router.registry import ReplicaRegistry
+
+__all__ = ["ForwardOutcome", "ForwardingProxy"]
+
+
+class ForwardOutcome:
+    """What the router handler needs to answer the client: status, JSON-
+    encodable body (or raw bytes), optional Retry-After, the replica that
+    answered, and the failure trail for observability."""
+
+    def __init__(self, status: int, body: bytes,
+                 replica_url: Optional[str] = None,
+                 retry_after: Optional[float] = None,
+                 attempts: int = 1,
+                 failovers: int = 0,
+                 retries: int = 0):
+        self.status = status
+        self.body = body
+        self.replica_url = replica_url
+        self.retry_after = retry_after
+        self.attempts = attempts
+        self.failovers = failovers
+        self.retries = retries
+
+
+def _err_body(msg: str, **extra) -> bytes:
+    return json.dumps({"error": msg, **extra}).encode()
+
+
+class ForwardingProxy:
+    """Forward one request body along a candidate list (see module doc)."""
+
+    def __init__(self, registry: ReplicaRegistry, *,
+                 timeout_s: float = 300.0,
+                 max_retries: int = 2,
+                 backoff_cap_s: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.registry = registry
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_cap_s = backoff_cap_s
+        self._sleep = sleep  # injectable so tests don't wall-clock wait
+
+    # ---- single attempt -------------------------------------------------
+
+    def _attempt(self, url: str, body: bytes
+                 ) -> Tuple[str, int, bytes, Optional[float]]:
+        """One forward to one replica.
+
+        Returns (kind, status, body, retry_after) with kind in
+        {'ok', 'overloaded', 'terminal', 'connect_fail', 'partial'}."""
+        req = urllib.request.Request(
+            url.rstrip("/") + "/api", data=body,
+            headers={"Content-Type": "application/json"}, method="PUT")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+        except urllib.error.HTTPError as e:
+            # a status line arrived — the replica spoke; read its body
+            # (itself a response-phase read that may die)
+            try:
+                data = e.read()
+            except Exception:
+                return ("partial", 502,
+                        _err_body(f"replica {url} dropped mid-error-body"),
+                        None)
+            if e.code == 503:
+                ra = e.headers.get("Retry-After")
+                try:
+                    retry_after = float(ra) if ra is not None else None
+                except ValueError:
+                    retry_after = None
+                if retry_after is None:
+                    try:
+                        retry_after = float(
+                            json.loads(data).get("retry_after", 1.0))
+                    except (ValueError, AttributeError):
+                        retry_after = 1.0
+                return ("overloaded", 503, data, retry_after)
+            return ("terminal", e.code, data, None)
+        except (urllib.error.URLError, socket.timeout, ConnectionError,
+                OSError) as e:
+            # no status line: the request never started executing
+            return ("connect_fail", 0,
+                    _err_body(f"{type(e).__name__}: {e}"), None)
+        with resp:
+            try:
+                data = resp.read()
+            except (http.client.IncompleteRead, ConnectionError,
+                    socket.timeout, OSError) as e:
+                # response-phase death AFTER the replica accepted the
+                # request: non-idempotent, never retried (module doc)
+                return ("partial", 502,
+                        _err_body(
+                            f"replica {url} dropped mid-response "
+                            f"({type(e).__name__}); not retried — the "
+                            f"generation may have executed"), None)
+            return ("ok", resp.status, data, None)
+
+    # ---- candidate walk -------------------------------------------------
+
+    def forward(self, candidate_urls: Sequence[str],
+                body: bytes) -> ForwardOutcome:
+        """Walk candidates with failover, then bounded Retry-After-honoring
+        retry rounds over the saturated ones."""
+        from megatron_llm_tpu.observability.trace import span
+
+        excluded: set = set()   # connect-failed: out for this request
+        attempts = failovers = retries = 0
+        saturated: List[Tuple[str, float]] = []
+        last_503: Optional[Tuple[bytes, float]] = None
+
+        def walk(urls: Sequence[str]) -> Optional[ForwardOutcome]:
+            nonlocal attempts, failovers, last_503
+            saturated.clear()
+            for url in urls:
+                if url in excluded:
+                    continue
+                attempts += 1
+                with span("router-forward", url=url):
+                    kind, status, data, ra = self._attempt(url, body)
+                if kind == "ok" or kind == "terminal":
+                    return ForwardOutcome(
+                        status, data, replica_url=url, attempts=attempts,
+                        failovers=failovers, retries=retries)
+                if kind == "partial":
+                    return ForwardOutcome(
+                        status, data, replica_url=url, attempts=attempts,
+                        failovers=failovers, retries=retries)
+                if kind == "connect_fail":
+                    excluded.add(url)
+                    failovers += 1
+                    self.registry.record_forward_failure(
+                        url, data.decode(errors="replace"))
+                    continue
+                # overloaded: remember for the retry rounds
+                saturated.append((url, ra if ra is not None else 1.0))
+                last_503 = (data, ra if ra is not None else 1.0)
+            return None
+
+        out = walk(candidate_urls)
+        rounds = 0
+        while out is None and saturated and rounds < self.max_retries:
+            rounds += 1
+            retries += 1
+            # honor the fleet-minimum Retry-After (bounded: a router thread
+            # sleeping 60s per 503 would be its own outage)
+            self._sleep(min(min(ra for _, ra in saturated),
+                            self.backoff_cap_s))
+            out = walk([u for u, _ in saturated])
+        if out is not None:
+            return out
+        if last_503 is not None:
+            data, ra = last_503
+            if saturated:  # aggregate: the soonest any replica reopens
+                ra = min(r for _, r in saturated)
+            try:
+                parsed = json.loads(data)
+            except ValueError:
+                parsed = {"error": "fleet saturated"}
+            parsed.setdefault("error", "fleet saturated")
+            parsed["fleet_saturated"] = True
+            return ForwardOutcome(
+                503, json.dumps(parsed).encode(), retry_after=ra,
+                attempts=attempts, failovers=failovers, retries=retries)
+        return ForwardOutcome(
+            502, _err_body("no replica reachable",
+                           tried=list(dict.fromkeys(candidate_urls))),
+            attempts=attempts, failovers=failovers, retries=retries)

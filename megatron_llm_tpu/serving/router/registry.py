@@ -1,0 +1,415 @@
+"""Replica registry + health poller + circuit breaker.
+
+The router's picture of the fleet is built entirely from each replica's
+``GET /health`` payload (generation/server.py — schema documented in
+docs/guide/serving.md "/health payload").  One background poller thread
+per replica scrapes it on an interval and parses it into a
+:class:`ReplicaView` — an immutable, staleness-tracked snapshot that the
+routing policies consume.  Nothing here talks to the data plane; forward
+failures are *reported into* the registry by the proxy
+(serving/router/proxy.py) and feed the same breaker.
+
+Circuit-breaker lifecycle (per replica)::
+
+    HEALTHY --consecutive failures >= suspect_after--> SUSPECT
+    SUSPECT --consecutive failures >= eject_after----> EJECTED
+    SUSPECT/EJECTED --successful poll----------------> HEALTHY
+    any state --operator drain(True)-----------------> DRAINING (sticky)
+
+SUSPECT replicas still route (their view may just be stale); EJECTED
+replicas receive no traffic but keep being probed at a slower cadence
+(``recovery_interval``) until a probe succeeds.  DRAINING is an operator
+decision (POST /admin/drain on the router): the replica finishes what it
+has but gets no new requests, and only an operator undrain brings it
+back — poll results never override it.
+
+Restart + reordering detection: a payload whose ``replica_id`` differs
+from the last seen one is a replica restart (new process) — the breaker
+resets and the per-replica ``seq`` tracking starts over.  A payload with
+the *same* ``replica_id`` but ``seq`` <= the last applied one is stale or
+reordered (overlapping polls racing) and is discarded rather than
+overwriting a fresher view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DRAINING",
+    "EJECTED",
+    "HEALTHY",
+    "SUSPECT",
+    "HealthPoller",
+    "Replica",
+    "ReplicaRegistry",
+    "ReplicaView",
+]
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+EJECTED = "ejected"
+DRAINING = "draining"
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaView:
+    """One parsed ``/health`` payload, frozen at fetch time.
+
+    Policies only ever see these snapshots (never live Replica objects),
+    mirroring the SchedulerPolicy/SchedulerState contract from
+    generation/scheduling/policy.py: decisions on immutable state, the
+    registry applies the consequences under its own locks."""
+
+    url: str
+    fetched_at: float               # time.monotonic() when parsed
+    replica_id: str = ""
+    seq: int = 0
+    uptime_s: float = 0.0
+    active_slots: int = 0
+    max_slots: int = 1
+    queued: int = 0
+    prefilling: int = 0
+    free_pages: int = 0
+    total_pages: int = 0
+    pages_cached: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_miss_tokens: int = 0
+    page_size: int = 0
+    ticks: int = 0
+    # scheduler control-plane payload (engine.scheduler_stats())
+    policy: str = ""
+    retry_after_s: Optional[float] = None
+    ema_tick_s: Optional[float] = None
+    ema_retire_s: Optional[float] = None
+    queued_by_priority: Tuple[Tuple[str, int], ...] = ()
+    # speculative decoding payload (engine.spec_stats()), when present
+    spec_acceptance: Optional[float] = None
+
+    @staticmethod
+    def parse(url: str, payload: dict,
+              now: Optional[float] = None) -> "ReplicaView":
+        """Build a view from a ``/health`` JSON payload; absent fields keep
+        conservative defaults so a pre-router replica still routes."""
+        now = time.monotonic() if now is None else now
+        sched = payload.get("scheduler") or {}
+        spec = payload.get("spec") or {}
+
+        def _ms(key):
+            v = sched.get(key)
+            return None if v is None else float(v) / 1e3
+
+        return ReplicaView(
+            url=url,
+            fetched_at=now,
+            replica_id=str(payload.get("replica_id", "")),
+            seq=int(payload.get("seq", 0)),
+            uptime_s=float(payload.get("uptime_s", 0.0)),
+            active_slots=int(payload.get("active_slots", 0)),
+            max_slots=max(int(payload.get("max_slots", 1)), 1),
+            queued=int(payload.get("queued", 0)),
+            prefilling=int(payload.get("prefilling", 0)),
+            free_pages=int(payload.get("free_pages", 0)),
+            total_pages=int(payload.get("total_pages", 0)),
+            pages_cached=int(payload.get("pages_cached", 0)),
+            prefix_hit_tokens=int(payload.get("prefix_hit_tokens", 0)),
+            prefix_miss_tokens=int(payload.get("prefix_miss_tokens", 0)),
+            page_size=int(payload.get("page_size", 0)),
+            ticks=int(payload.get("ticks", 0)),
+            policy=str(sched.get("policy", "")),
+            retry_after_s=(None if sched.get("retry_after_s") is None
+                           else float(sched["retry_after_s"])),
+            ema_tick_s=_ms("ema_tick_ms"),
+            ema_retire_s=_ms("ema_retire_ms"),
+            queued_by_priority=tuple(
+                sorted((str(k), int(v)) for k, v in
+                       (sched.get("queued_by_priority") or {}).items())),
+            spec_acceptance=(None if spec.get("acceptance_rate") is None
+                             else float(spec["acceptance_rate"])),
+        )
+
+    # ---- derived signals the policies share -----------------------------
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return (time.monotonic() if now is None else now) - self.fetched_at
+
+    @property
+    def depth(self) -> int:
+        """Requests ahead of a new arrival: queued + occupied slots."""
+        return self.queued + self.active_slots
+
+    @property
+    def load(self) -> float:
+        """Occupancy fraction; > 1 means a backlog beyond the slots."""
+        return self.depth / self.max_slots
+
+    def drain_score(self) -> float:
+        """Predicted seconds of work ahead of a new arrival: queue depth x
+        the replica's retirement EMA (tick EMA as a coarse floor before the
+        first retirement — the same fallback engine._drain_eta uses).  With
+        no timing signal yet, depth alone still orders replicas."""
+        per = self.ema_retire_s if self.ema_retire_s is not None \
+            else self.ema_tick_s
+        return self.depth * (per if per is not None else 1.0)
+
+    def predicted_wait_s(self) -> float:
+        """Predicted TTFT floor for a new arrival: a free slot costs about
+        one tick; a backlog costs its drain estimate (the replica's own
+        Retry-After figure when it published one)."""
+        if self.queued == 0 and self.active_slots < self.max_slots:
+            return self.ema_tick_s if self.ema_tick_s is not None else 0.0
+        if self.retry_after_s is not None:
+            return self.retry_after_s
+        return self.drain_score()
+
+
+class Replica:
+    """One fleet member: breaker state + freshest accepted view."""
+
+    def __init__(self, url: str, *, suspect_after: int = 1,
+                 eject_after: int = 3):
+        assert 1 <= suspect_after <= eject_after
+        self.url = url
+        self.suspect_after = suspect_after
+        self.eject_after = eject_after
+        self._lock = threading.Lock()
+        self._state = HEALTHY  # guarded by _lock
+        self._draining = False  # guarded by _lock
+        self._failures = 0  # consecutive poll/forward failures — guarded by _lock
+        self._view: Optional[ReplicaView] = None  # guarded by _lock
+        self._last_error: Optional[str] = None  # guarded by _lock
+        self._restarts = 0  # replica_id changes observed — guarded by _lock
+        self._stale_discards = 0  # reordered payloads dropped — guarded by _lock
+
+    # ---- breaker transitions (all under _lock) --------------------------
+
+    def _advance_failure_locked(self) -> None:  # holds _lock
+        self._failures += 1
+        if self._draining:
+            return  # drain is sticky; keep counting for the fleet summary
+        if self._failures >= self.eject_after:
+            self._state = EJECTED
+        elif self._failures >= self.suspect_after:
+            self._state = SUSPECT
+
+    def record_failure(self, error: str) -> str:
+        """A failed poll or forward; returns the resulting state."""
+        with self._lock:
+            self._last_error = error
+            self._advance_failure_locked()
+            return self._state
+
+    def record_view(self, view: ReplicaView) -> bool:
+        """Apply a successful poll.  Returns False when the payload was
+        discarded as stale/reordered (same replica, seq not newer)."""
+        with self._lock:
+            prev = self._view
+            if prev is not None and prev.replica_id and view.replica_id:
+                if view.replica_id != prev.replica_id:
+                    self._restarts += 1  # new process behind the same url
+                elif view.seq <= prev.seq:
+                    self._stale_discards += 1
+                    return False
+            self._view = view
+            self._failures = 0
+            self._last_error = None
+            if not self._draining:
+                self._state = HEALTHY
+            return True
+
+    def drain(self, on: bool = True) -> None:
+        """Operator drain: no new traffic until undrained.  Poll results
+        keep refreshing the view but cannot clear the state."""
+        with self._lock:
+            self._draining = on
+            if on:
+                self._state = DRAINING
+            else:
+                # re-enter through the breaker: healthy iff recently polled
+                self._state = HEALTHY if self._failures == 0 else (
+                    EJECTED if self._failures >= self.eject_after else SUSPECT)
+
+    # ---- snapshots ------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def view(self) -> Optional[ReplicaView]:
+        with self._lock:
+            return self._view
+
+    def routable(self, max_staleness_s: Optional[float] = None) -> bool:
+        """May this replica receive new traffic?  HEALTHY/SUSPECT with a
+        view no older than ``max_staleness_s`` (None = any view)."""
+        with self._lock:
+            if self._state not in (HEALTHY, SUSPECT):
+                return False
+            if self._view is None:
+                return False
+            if max_staleness_s is not None \
+                    and self._view.age_s() > max_staleness_s:
+                return False
+            return True
+
+    def summary(self) -> dict:
+        """Fleet-summary row for the router's own /health."""
+        with self._lock:
+            v = self._view
+            return {
+                "url": self.url,
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "last_error": self._last_error,
+                "restarts": self._restarts,
+                "stale_discards": self._stale_discards,
+                "replica_id": v.replica_id if v else None,
+                "seq": v.seq if v else None,
+                "view_age_s": round(v.age_s(), 3) if v else None,
+                "queued": v.queued if v else None,
+                "active_slots": v.active_slots if v else None,
+                "pages_cached": v.pages_cached if v else None,
+            }
+
+
+class ReplicaRegistry:
+    """The fleet: replicas keyed by base url, with routable-view snapshots
+    for the policies and failure reporting for the proxy."""
+
+    def __init__(self, urls: List[str], *, suspect_after: int = 1,
+                 eject_after: int = 3, max_staleness_s: float = 10.0):
+        if not urls:
+            raise ValueError("a router needs at least one replica url")
+        self.max_staleness_s = max_staleness_s
+        self._lock = threading.Lock()
+        # url -> Replica; insertion order is the stable fleet order that
+        # round_robin and the hash ring key on — guarded by _lock
+        self._replicas: Dict[str, Replica] = {
+            u: Replica(u, suspect_after=suspect_after,
+                       eject_after=eject_after)
+            for u in urls}
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, url: str) -> Replica:
+        with self._lock:
+            return self._replicas[url]
+
+    def routable_views(self) -> List[ReplicaView]:
+        """Fresh views of every replica currently accepting traffic, in
+        stable fleet order — the policies' input."""
+        views = []
+        for rep in self.replicas():
+            if rep.routable(self.max_staleness_s):
+                v = rep.view
+                if v is not None:
+                    views.append(v)
+        return views
+
+    def record_forward_failure(self, url: str, error: str) -> None:
+        """The data plane could not reach ``url`` — same breaker as a
+        failed poll, so repeated forward failures eject without waiting
+        for the next poll interval."""
+        try:
+            rep = self.get(url)
+        except KeyError:
+            return
+        rep.record_failure(error)
+
+    def drain(self, url: str, on: bool = True) -> bool:
+        try:
+            rep = self.get(url)
+        except KeyError:
+            return False
+        rep.drain(on)
+        return True
+
+    def summary(self) -> dict:
+        reps = self.replicas()
+        states = [r.state for r in reps]
+        return {
+            "replicas": [r.summary() for r in reps],
+            "fleet": {s: states.count(s)
+                      for s in (HEALTHY, SUSPECT, EJECTED, DRAINING)},
+            "routable": sum(r.routable(self.max_staleness_s) for r in reps),
+        }
+
+
+def fetch_health(url: str, timeout_s: float) -> dict:
+    """One /health scrape (also the poller's probe)."""
+    with urllib.request.urlopen(url.rstrip("/") + "/health",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class HealthPoller:
+    """One daemon thread per replica scraping /health on an interval.
+
+    EJECTED replicas are probed at ``recovery_interval`` (slower — they
+    are likely down, and hammering them helps nobody); everything else at
+    ``interval``.  A parse failure counts as a poll failure: a replica
+    answering garbage should trip the breaker, not crash the router."""
+
+    def __init__(self, registry: ReplicaRegistry, *, interval: float = 1.0,
+                 recovery_interval: Optional[float] = None,
+                 timeout_s: float = 5.0,
+                 fetch: Callable[[str, float], dict] = fetch_health,
+                 on_poll: Optional[Callable[[Replica, bool], None]] = None):
+        self.registry = registry
+        self.interval = interval
+        self.recovery_interval = recovery_interval or max(interval * 5, 5.0)
+        self.timeout_s = timeout_s
+        self._fetch = fetch
+        self._on_poll = on_poll  # observability hook (router server)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def poll_once(self, rep: Replica) -> bool:
+        """Scrape one replica now; returns success.  Exposed for tests and
+        for the router's synchronous warm-up poll."""
+        from megatron_llm_tpu.observability.trace import span
+
+        try:
+            with span("router-poll", url=rep.url):
+                payload = self._fetch(rep.url, self.timeout_s)
+            if not isinstance(payload, dict):
+                raise ValueError("health payload is not a JSON object")
+            rep.record_view(ReplicaView.parse(rep.url, payload))
+            ok = True
+        except Exception as e:  # any failure shape trips the breaker
+            rep.record_failure(f"{type(e).__name__}: {e}")
+            ok = False
+        if self._on_poll is not None:
+            self._on_poll(rep, ok)
+        return ok
+
+    def _loop(self, rep: Replica) -> None:
+        while not self._stop.is_set():
+            self.poll_once(rep)
+            wait = (self.recovery_interval if rep.state == EJECTED
+                    else self.interval)
+            if self._stop.wait(wait):
+                return
+
+    def start(self) -> None:
+        assert not self._threads, "poller already started"
+        for rep in self.registry.replicas():
+            t = threading.Thread(target=self._loop, args=(rep,),
+                                 name=f"health-poll:{rep.url}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []

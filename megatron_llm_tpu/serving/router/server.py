@@ -1,0 +1,303 @@
+"""RouterServer: the HTTP tier gluing registry + policy + proxy together.
+
+Endpoints (one port, same layout as the replica server so dashboards and
+probes point at either tier identically):
+
+* ``PUT /api`` — route + forward.  The body is forwarded verbatim; the
+  router only *reads* ``prompts[0]``/``priority``/``ttft_deadline_ms``
+  for the routing decision, so the wire contract stays the replica's.
+* ``GET /health`` — fleet summary (per-replica breaker state, view age,
+  queue/pages snapshot, restart counts) + router identity.
+* ``GET /metrics`` — Prometheus text: per-replica up/queue/pages gauges
+  refreshed at scrape time, routing-decision / retry / failover / shed
+  counters, per-replica TTFT histograms (non-streaming replicas deliver
+  the whole body at first byte, so time-to-response IS time-to-first-
+  token as the client experiences it).
+* ``POST /admin/drain`` / ``POST /admin/undrain`` — operator drain
+  (body: ``{"replica": "<url>"}``); the breaker keeps polling a draining
+  replica but no new traffic reaches it.
+
+Tracer spans (observability/trace.py): ``router-route`` around the
+policy decision, ``router-forward`` per attempt (proxy.py), and
+``router-poll`` per scrape (registry.py) — a Perfetto dump of a router
+process shows the poll cadence against the forward latency.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from megatron_llm_tpu.observability.registry import get_registry
+from megatron_llm_tpu.observability.trace import span
+from megatron_llm_tpu.serving.router.policy import (
+    FleetOverloaded,
+    RouteRequest,
+    RouterPolicy,
+    get_router_policy,
+)
+from megatron_llm_tpu.serving.router.proxy import ForwardingProxy
+from megatron_llm_tpu.serving.router.registry import (
+    HealthPoller,
+    Replica,
+    ReplicaRegistry,
+)
+
+__all__ = ["RouterServer"]
+
+# TTFT through a router spans ~ms (warm single-tick) to minutes (cold
+# compile on a fresh replica) — wider-than-default buckets on both ends
+_TTFT_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+                 60.0, 300.0, float("inf"))
+
+
+class RouterServer:
+    """Front N generation-server replicas with one routing policy."""
+
+    def __init__(self, replica_urls: List[str], *,
+                 policy: str = "least_loaded",
+                 policy_kwargs: Optional[dict] = None,
+                 poll_interval: float = 1.0,
+                 poll_timeout_s: float = 5.0,
+                 max_staleness_s: float = 10.0,
+                 suspect_after: int = 1,
+                 eject_after: int = 3,
+                 forward_timeout_s: float = 300.0,
+                 max_retries: int = 2):
+        self.router_id = uuid.uuid4().hex
+        self._t_start = time.monotonic()
+        self.registry = ReplicaRegistry(
+            replica_urls, suspect_after=suspect_after,
+            eject_after=eject_after, max_staleness_s=max_staleness_s)
+        self.policy: RouterPolicy = get_router_policy(policy)(
+            **(policy_kwargs or {}))
+        self.proxy = ForwardingProxy(
+            self.registry, timeout_s=forward_timeout_s,
+            max_retries=max_retries)
+        self.poller = HealthPoller(
+            self.registry, interval=poll_interval,
+            timeout_s=poll_timeout_s, on_poll=self._on_poll)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        reg = get_registry()
+        self._routed = reg.counter(
+            "mlt_router_requests_total",
+            "requests routed, by policy")
+        self._failovers = reg.counter(
+            "mlt_router_failovers_total",
+            "mid-request replica exclusions after connect failures")
+        self._retries = reg.counter(
+            "mlt_router_retries_total",
+            "Retry-After-honoring retry rounds over saturated replicas")
+        self._shed = reg.counter(
+            "mlt_router_shed_total",
+            "requests 503'd by the router itself (no routable replica / "
+            "slo_aware found none feasible)")
+        self._poll_failures = reg.counter(
+            "mlt_router_poll_failures_total", "failed /health scrapes")
+
+    # ---- observability hooks -------------------------------------------
+
+    def _on_poll(self, rep: Replica, ok: bool) -> None:
+        if not ok:
+            self._poll_failures.inc()
+        self._publish_replica_gauges(rep)
+
+    def _publish_replica_gauges(self, rep: Replica) -> None:
+        reg = get_registry()
+        labels = {"replica": rep.url}
+        state = rep.state
+        reg.gauge("mlt_router_replica_up",
+                  "1 = routable (healthy/suspect), 0 = ejected/draining",
+                  labels=labels).set(
+            1.0 if rep.routable(self.registry.max_staleness_s) else 0.0)
+        v = rep.view
+        if v is None:
+            return
+        reg.gauge("mlt_router_replica_queued", labels=labels).set(v.queued)
+        reg.gauge("mlt_router_replica_active_slots",
+                  labels=labels).set(v.active_slots)
+        reg.gauge("mlt_router_replica_pages_cached",
+                  labels=labels).set(v.pages_cached)
+        reg.gauge("mlt_router_replica_view_age_s", labels=labels).set(
+            round(v.age_s(), 3))
+        reg.gauge("mlt_router_replica_state_code",
+                  "0 healthy / 1 suspect / 2 ejected / 3 draining",
+                  labels=labels).set(
+            {"healthy": 0, "suspect": 1, "ejected": 2,
+             "draining": 3}.get(state, -1))
+
+    def _observe_ttft(self, replica_url: str, seconds: float) -> None:
+        get_registry().histogram(
+            "mlt_router_ttft_seconds",
+            "client-observed time-to-response per replica",
+            labels={"replica": replica_url},
+            buckets=_TTFT_BUCKETS).observe(seconds)
+
+    # ---- request handling ----------------------------------------------
+
+    def route(self, payload: dict, body: bytes):
+        """Decide + forward.  Returns (status, body_bytes, headers)."""
+        request = RouteRequest.from_payload(payload)
+        views = self.registry.routable_views()
+        if not views:
+            self._shed.inc()
+            fleet = self.registry.summary()["fleet"]
+            return 503, json.dumps({
+                "error": "no routable replica (fleet: %s)" % fleet,
+                "retry_after": 1.0, "fleet": fleet,
+            }).encode(), {"Retry-After": "1"}
+        try:
+            with span("router-route", policy=self.policy.name):
+                candidates = self.policy.order(request, views)
+        except FleetOverloaded as fo:
+            self._shed.inc()
+            return 503, json.dumps({
+                "error": str(fo), "retry_after": fo.retry_after,
+                "shed": True, **fo.info,
+            }).encode(), {"Retry-After": str(max(1, int(fo.retry_after)))}
+        t0 = time.monotonic()
+        out = self.proxy.forward([v.url for v in candidates], body)
+        if out.replica_url is not None and out.status == 200:
+            self._observe_ttft(out.replica_url, time.monotonic() - t0)
+        self._routed.inc()
+        if out.failovers:
+            self._failovers.inc(out.failovers)
+        if out.retries:
+            self._retries.inc(out.retries)
+        get_registry().counter(
+            "mlt_router_decisions_total",
+            "forwards that reached a replica, by policy and replica",
+            labels={"policy": self.policy.name,
+                    "replica": out.replica_url or "none"}).inc()
+        headers = {}
+        if out.status == 503 and out.retry_after is not None:
+            headers["Retry-After"] = str(max(1, int(out.retry_after)))
+        return out.status, out.body, headers
+
+    def health(self) -> dict:
+        info = self.registry.summary()
+        info.update(
+            status="ok",
+            role="router",
+            router_id=self.router_id,
+            policy=self.policy.name,
+            uptime_s=round(time.monotonic() - self._t_start, 3),
+        )
+        return info
+
+    def metrics_text(self) -> str:
+        # scrape-time pull, same idiom as the replica server: refresh the
+        # per-replica gauges from the registry's live breaker state
+        for rep in self.registry.replicas():
+            self._publish_replica_gauges(rep)
+        return get_registry().render()
+
+    def drain(self, url: str, on: bool) -> bool:
+        ok = self.registry.drain(url, on)
+        if ok:
+            self._publish_replica_gauges(self.registry.get(url))
+        return ok
+
+    # ---- HTTP plumbing --------------------------------------------------
+
+    def _make_handler(router):  # noqa: N805 — `router` is the enclosing object
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, data: bytes,
+                      content_type="application/json", headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_json(self, code: int, body: dict, headers=None):
+                self._send(code, json.dumps(body).encode(), headers=headers)
+
+            def do_PUT(self):
+                if self.path.rstrip("/") != "/api":
+                    return self._send_json(404, {"error": "not found"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) or b"{}"
+                    payload = json.loads(body)
+                except (ValueError, json.JSONDecodeError):
+                    return self._send_json(400, {"error": "invalid JSON"})
+                if not isinstance(payload, dict):
+                    return self._send_json(
+                        400, {"error": "request body must be a JSON object"})
+                try:
+                    code, data, headers = router.route(payload, body)
+                except Exception as e:  # route/forward must answer the client
+                    return self._send_json(500, {
+                        "error": f"router error: {type(e).__name__}: {e}"})
+                return self._send(code, data, headers=headers)
+
+            def do_POST(self):
+                path = self.path.rstrip("/")
+                if path in ("/admin/drain", "/admin/undrain"):
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                        url = payload["replica"]
+                    except (ValueError, KeyError, json.JSONDecodeError):
+                        return self._send_json(
+                            400, {"error": 'body must be {"replica": url}'})
+                    if not router.drain(url, on=path.endswith("/drain")):
+                        return self._send_json(
+                            404, {"error": f"unknown replica {url}"})
+                    return self._send_json(
+                        200, {"replica": url,
+                              "state": router.registry.get(url).state})
+                return self.do_PUT()  # /api convenience, replica parity
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/health":
+                    return self._send_json(200, router.health())
+                if path == "/metrics":
+                    return self._send(
+                        200, router.metrics_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                return self._send_json(404, {"error": "not found"})
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+        return Handler
+
+    def bind(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        """Bind (port 0 = ephemeral) and return the bound port."""
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        return self._httpd.server_address[1]
+
+    def serve(self):
+        assert self._httpd is not None, "call bind() first"
+        self.poller.start()
+        self._httpd.serve_forever()
+
+    def start_background(self, host: str = "127.0.0.1", port: int = 0,
+                         warm: bool = True) -> int:
+        """Bind + poll every replica once synchronously (``warm`` — the
+        first request must not race the first poll) + serve in a daemon
+        thread; returns the bound port."""
+        bound = self.bind(host, port)
+        if warm:
+            for rep in self.registry.replicas():
+                self.poller.poll_once(rep)
+        self.poller.start()
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return bound
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.poller.stop()

@@ -325,6 +325,81 @@ def test_committed_spec_evidence_is_valid():
     assert not _bench_on_tpu(json.dumps(stamped))
 
 
+def test_router_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode router (ISSUE 10) reuses the off-TPU
+    contract: headline 0, the prefix_affinity-vs-round_robin comparison +
+    failover record ride under cpu_sanity with the budget fields
+    populated, TPU evidence goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "router_prefix_affinity_ttft_speedup_llama470m_2rep_1chip",
+        "value": 1.3, "unit": "x", "backend": "cpu",
+        "speedup_ok": True, "fleet_hit_rate_gain": 0.23,
+        "failover": {"killed": "http://127.0.0.1:1", "requests": 12,
+                     "dropped": 0, "failovers": 2,
+                     "killed_state": "ejected", "ok": True},
+        "compile_time_s": 40.0, "step_time_s": 0.02,
+        "rows": [{"policy": "round_robin", "fleet_hit_rate": 0.75,
+                  "ttft_mean_ms": 369.0},
+                 {"policy": "prefix_affinity", "fleet_hit_rate": 0.98,
+                  "ttft_mean_ms": 328.0}],
+    }, tag="engine_decode_router")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["speedup_ok"] is True
+    assert line["cpu_sanity"]["failover"]["dropped"] == 0
+    assert line["budgets"]["compile_time_s"]["value"] == 40.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "router", "value": 1.8,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_router")
+    assert bench.load_last_tpu(tag="engine_decode_router")["value"] == 1.8
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_router_bench_in_watch_jobs():
+    """ISSUE 10: the cross-replica router bench is in the tunnel-up
+    capture list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_router" in by_name
+    cmd, bounded, pred = by_name["bench_decode_router"]
+    assert "--mode" in cmd and "router" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_router_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_router_cpu_sanity
+    .json) satisfies the acceptance bar: headline 0 off-TPU,
+    prefix_affinity beats round_robin on BOTH fleet prefix-hit rate and
+    mean TTFT, the mid-run kill dropped nothing and ejected the dead
+    replica, budgets populated without violations."""
+    from pathlib import Path
+
+    path = (Path(__file__).parent.parent
+            / "BENCH_decode_router_cpu_sanity.json")
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["speedup_ok"] is True
+    by = {r["policy"]: r for r in sanity["rows"]}
+    assert set(by) == {"round_robin", "prefix_affinity"}
+    aff, rr = by["prefix_affinity"], by["round_robin"]
+    assert aff["fleet_hit_rate"] > rr["fleet_hit_rate"]
+    assert aff["ttft_mean_ms"] < rr["ttft_mean_ms"]
+    assert aff["prefill_tokens_computed"] < rr["prefill_tokens_computed"]
+    fo = sanity["failover"]
+    assert fo["dropped"] == 0 and fo["ok"] is True
+    assert fo["failovers"] >= 1
+    assert fo["killed_state"] in ("suspect", "ejected")
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+    # an error-stamped line of this shape must be rejected by the watch
+    # evidence predicate, not captured
+    stamped = dict(rec)
+    stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
+    assert not _bench_on_tpu(json.dumps(stamped))
+
+
 def test_trace_cost_budget_on_observability_line(evidence_dir):
     """ROADMAP item 4 leftover: the observability evidence line carries
     tracer-cost budget verdicts — within limits it annotates, a tracer

@@ -573,6 +573,44 @@ def test_lock_rule_verifies_engine_annotations():
     raise AssertionError("engine class not found")
 
 
+def test_lock_rule_verifies_router_annotations():
+    """ISSUE 10: the router's cross-thread state (breaker fields on
+    Replica, the fleet dict on ReplicaRegistry, the server's /health seq
+    counter) is lock-annotated and really modeled by the rule — the repo
+    sweep's cleanliness over serving/router/ is not vacuous."""
+    import ast as ast_mod
+
+    from tools.graftcheck.rules.locks import LockDisciplineRule
+
+    rule = LockDisciplineRule()
+    expected = {
+        os.path.join(REPO, "megatron_llm_tpu", "serving", "router",
+                     "registry.py"): {
+            "Replica": ({"_state", "_failures", "_view", "_draining"},
+                        {"_advance_failure_locked"}),
+            "ReplicaRegistry": ({"_replicas"}, set()),
+        },
+        os.path.join(REPO, "megatron_llm_tpu", "generation",
+                     "server.py"): {
+            "MegatronServer": ({"_health_seq"}, set()),
+        },
+    }
+    for path, classes in expected.items():
+        ctx = core.FileContext(path)
+        found = set()
+        for node in ast_mod.walk(ctx.tree):
+            if isinstance(node, ast_mod.ClassDef) and node.name in classes:
+                guards, holds = classes[node.name]
+                model = rule._build(ctx, node)
+                assert model is not None, f"{node.name}: no lock model"
+                assert guards <= set(model.guards), (
+                    f"{node.name} missing guards: "
+                    f"{guards - set(model.guards)}")
+                assert holds <= set(model.holds)
+                found.add(node.name)
+        assert found == set(classes), f"{path}: missing {set(classes) - found}"
+
+
 def test_traced_functions_really_analyzed():
     """sync-in-jit resolves the engine's cached_jit builders — the four
     compiled programs are in the analyzed set (a resolution regression

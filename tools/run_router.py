@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Launch the cross-replica request router (serving/router/).
+
+Fronts N generation-server replicas (each a
+tools/run_text_generation_server.py process) behind one ``PUT /api``
+endpoint.  Background pollers scrape every replica's ``/health`` control
+plane; the chosen policy turns those views into a routing decision per
+request; the proxy forwards with failover, bounded Retry-After-honoring
+retries, and never retries a response that died mid-body.
+
+No jax, no model: the router is a pure control/data-plane process — it
+starts in milliseconds and can front replicas on other hosts.
+
+Example (2-replica local fleet, ephemeral ports)::
+
+    python tools/run_text_generation_server.py --random_init --port 0 &
+    python tools/run_text_generation_server.py --random_init --port 0 &
+    # note the two printed ports, then:
+    python tools/run_router.py --policy prefix_affinity \\
+        --replica http://127.0.0.1:PORT1 --replica http://127.0.0.1:PORT2
+
+Operator drain / undrain::
+
+    curl -X POST localhost:8000/admin/drain \\
+         -d '{"replica": "http://127.0.0.1:PORT1"}'
+
+Guide: docs/guide/serving.md "Cross-replica routing" (policy matrix,
+breaker lifecycle, flag and metric tables).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main(argv=None):
+    from megatron_llm_tpu.serving.router import available_router_policies
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--replica", action="append", default=[],
+                    help="replica base url (repeat per replica)")
+    ap.add_argument("--replicas",
+                    help="comma-separated replica base urls (alternative "
+                         "to repeating --replica)")
+    ap.add_argument("--policy", default="least_loaded",
+                    choices=available_router_policies())
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000,
+                    help="0 = ephemeral; the bound port is printed")
+    ap.add_argument("--poll_interval", type=float, default=1.0,
+                    help="seconds between /health scrapes per replica")
+    ap.add_argument("--poll_timeout", type=float, default=5.0)
+    ap.add_argument("--max_staleness", type=float, default=10.0,
+                    help="a view older than this makes its replica "
+                         "unroutable until the next successful poll")
+    ap.add_argument("--suspect_after", type=int, default=1,
+                    help="consecutive failures before healthy -> suspect")
+    ap.add_argument("--eject_after", type=int, default=3,
+                    help="consecutive failures before suspect -> ejected "
+                         "(recovery probes continue at 5x poll_interval)")
+    ap.add_argument("--forward_timeout", type=float, default=300.0,
+                    help="per-forward upstream timeout (covers a cold "
+                         "replica's first-request compile)")
+    ap.add_argument("--max_retries", type=int, default=2,
+                    help="retry rounds over saturated (503) replicas")
+    ap.add_argument("--affinity_prefix_chars", type=int, default=256,
+                    help="prefix_affinity: characters hashed into the "
+                         "affinity key (~4 chars/token x page size)")
+    ap.add_argument("--affinity_load_factor", type=float, default=1.25,
+                    help="prefix_affinity: spill the ring choice to the "
+                         "least-loaded replica when its depth exceeds "
+                         "this x the fleet mean")
+    ap.add_argument("--slo_margin", type=float, default=0.8,
+                    help="slo_aware: fraction of the TTFT deadline the "
+                         "predicted wait must fit in")
+    args = ap.parse_args(argv)
+
+    urls = list(args.replica)
+    if args.replicas:
+        urls += [u.strip() for u in args.replicas.split(",") if u.strip()]
+    if not urls:
+        ap.error("at least one --replica url is required")
+
+    policy_kwargs = {}
+    if args.policy == "prefix_affinity":
+        policy_kwargs = dict(prefix_chars=args.affinity_prefix_chars,
+                             load_factor=args.affinity_load_factor)
+    elif args.policy == "slo_aware":
+        policy_kwargs = dict(margin=args.slo_margin)
+
+    router = RouterServer(
+        urls, policy=args.policy, policy_kwargs=policy_kwargs,
+        poll_interval=args.poll_interval, poll_timeout_s=args.poll_timeout,
+        max_staleness_s=args.max_staleness,
+        suspect_after=args.suspect_after, eject_after=args.eject_after,
+        forward_timeout_s=args.forward_timeout,
+        max_retries=args.max_retries)
+    # bind BEFORE printing so --port 0 reports the real ephemeral port
+    port = router.bind(args.host, args.port)
+    print(f"routing (policy={args.policy}, {len(urls)} replicas) on "
+          f"http://{args.host}:{port}/api", flush=True)
+    try:
+        router.serve()
+    except KeyboardInterrupt:
+        router.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,7 +50,10 @@ def main():
     ap.add_argument("--tokenizer_type", default="HFTokenizer")
     ap.add_argument("--tokenizer_model", help="tokenizer name/path")
     ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--port", type=int, default=5000,
+                    help="0 = ephemeral: the OS picks a free port and the "
+                         "bound port is printed on startup (local fleets "
+                         "spawn replicas this way without port races)")
     ap.add_argument("--random_init", action="store_true",
                     help="serve a random tiny model (smoke test)")
     ap.add_argument("--legacy_engine", action="store_true",
@@ -120,9 +123,11 @@ def main():
         if engine.spec_k:
             kind += (f", spec_k={engine.spec_k} "
                      f"(draft {engine.draft_cfg.model.num_layers}L)")
-    print(f"serving ({kind}) on http://{args.host}:{args.port}/api",
-          flush=True)
-    server.run(args.host, args.port)
+    # bind BEFORE printing so --port 0 reports the real ephemeral port
+    # (fleet spawners parse this line, then poll /health until ready)
+    port = server.bind(args.host, args.port)
+    print(f"serving ({kind}) on http://{args.host}:{port}/api", flush=True)
+    server.serve()
 
 
 if __name__ == "__main__":

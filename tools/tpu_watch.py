@@ -152,6 +152,13 @@ JOBS = [
     ("bench_decode_spec",
      [sys.executable, "bench_decode.py", "--mode", "spec"],
      False, _bench_on_tpu),
+    # ISSUE 10: cross-replica router — 2-replica fleet on the shared-prefix
+    # workload, prefix_affinity vs round_robin fleet hit rate + TTFT, and
+    # a mid-run replica kill with zero dropped requests (bench_decode.py
+    # --mode router, engine_decode_router evidence)
+    ("bench_decode_router",
+     [sys.executable, "bench_decode.py", "--mode", "router"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
