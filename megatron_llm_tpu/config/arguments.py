@@ -446,6 +446,17 @@ class InferenceConfig:
     spec_k: int = 0
     spec_draft: Optional[str] = None
     spec_adaptive: bool = True
+    # ragged paged attention (generation/ragged.py, ISSUE 11):
+    # --ragged_tick fuses every tick's decode slots, speculative-verify
+    # blocks and prefill-chunk rows into ONE compiled launch over a ragged
+    # row batch (bitwise-identical output to the legacy split dispatch;
+    # 0 restores the split decode-tick + per-chunk programs).  Requires
+    # chunked prefill; prefill_chunk=0 implies the legacy path.
+    # --prefill_budget is the compiled prefill-row capacity of the ragged
+    # tick in TOKENS per tick (0 = one chunk's worth, the legacy pacing);
+    # the SchedulerPolicy's token-level prefill_budget is capped by it.
+    ragged_tick: bool = True
+    prefill_budget: int = 0
 
 
 @dataclass

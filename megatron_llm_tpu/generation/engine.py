@@ -474,6 +474,8 @@ class ContinuousBatchingEngine:
                  spec_k: Optional[int] = None,
                  spec_draft=None,
                  spec_adaptive: Optional[bool] = None,
+                 ragged: Optional[bool] = None,
+                 prefill_budget: Optional[int] = None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -587,6 +589,27 @@ class ContinuousBatchingEngine:
                 draft_params = jax.device_put(
                     draft_params, param_shardings(mesh, draft_params))
             self.draft_cfg, self.draft_params = draft.cfg, draft_params
+        # ragged tick (generation/ragged.py, ISSUE 11): ONE compiled
+        # launch per tick carries the decode slots, the speculative-verify
+        # blocks AND up to prefill_rows prefill-chunk rows — bitwise-
+        # identical output to the legacy split dispatch, minus its per-tick
+        # program launches.  Needs the block-table prefill path, so
+        # prefill_chunk=0 (monolithic) implies the legacy dispatch.
+        self.ragged = bool(
+            (ragged if ragged is not None
+             else getattr(inf, "ragged_tick", True))
+            and self.prefill_chunk)
+        budget_cap = (prefill_budget if prefill_budget is not None
+                      else getattr(inf, "prefill_budget", 0))
+        # compiled prefill-row capacity of the ragged tick (a geometry
+        # static, like max_slots); the policy's token budget is capped here
+        self.prefill_rows = (max(self.prefill_chunk, int(budget_cap or 0))
+                             if self.ragged else 0)
+        # distinct prefilling requests packable into one tick — the
+        # compressed-table capacity of the ragged program (one table row
+        # per request; rows of a request share it)
+        self._pre_tables_cap = (self.prefill_rows // self.prefill_chunk + 1
+                                if self.ragged else 0)
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
@@ -634,6 +657,9 @@ class ContinuousBatchingEngine:
 
         self._tick_fn = None
         self._spec_tick_fn = None
+        # ragged tick executables keyed by bucketed live-prefill-row
+        # count — bounded at 1 + prefill_rows // prefill_chunk entries
+        self._ragged_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[Tuple[int, bool], object] = {}
         self._chunk_fns: Dict[Tuple[int, int, bool], object] = {}
         self._copy_fn = None
@@ -644,6 +670,13 @@ class ContinuousBatchingEngine:
         # tick/cache telemetry for the decode bench
         self.ticks = 0
         self.ticked_tokens = 0
+        # attention-program launches in the tick phase (ISSUE 11): ragged
+        # ticks dispatch ONE compiled program per tick; the legacy split
+        # path dispatches the decode/spec tick plus one program per
+        # prefill chunk.  last_tick_launches is the most recent step's
+        # count — the single-launch claim tests assert on.
+        self.tick_launches = 0
+        self.last_tick_launches = 0
         self.prefill_tokens_computed = 0  # rows pushed through prefill
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
@@ -698,6 +731,16 @@ class ContinuousBatchingEngine:
         self._m_prefill_tokens = reg.counter(
             "mlt_engine_prefill_tokens_total",
             help="token rows pushed through prefill (chunked or monolithic)")
+        self._m_launches = reg.counter(
+            "mlt_engine_tick_launches_total",
+            help="attention-program launches in the tick phase (ragged "
+                 "mode: exactly one per non-idle tick)")
+        self._m_prefill_per_tick = reg.histogram(
+            "mlt_engine_prefill_tokens_per_tick",
+            help="prompt tokens prefilled per tick (token-level "
+                 "prefill_budget control; observed on ticks that prefill)",
+            buckets=[16.0, 32.0, 64.0, 128.0, 192.0, 256.0, 512.0,
+                     1024.0])
         self._m_preempt = reg.counter(
             "mlt_engine_preemptions_total",
             help="decoding requests preempted by page release")
@@ -817,17 +860,16 @@ class ContinuousBatchingEngine:
         return self._tick_fn
 
     def _spec_tick(self):
-        """The fused draft-k-then-verify tick (speculative/verify.py):
-        one compiled program drafts ``spec_k`` tokens per slot, verifies
-        all k+1 positions in a single flattened-batch target forward, and
+        """The fused draft-k-then-verify tick for the LEGACY split
+        dispatch: the ragged builder at prefill-row capacity 0 — one
+        compiled program drafts ``spec_k`` tokens per slot, verifies all
+        k+1 positions in a single flattened-batch target forward, and
         applies the lossless acceptance rule.  Cache key carries the
         DRAFT config fingerprint too — engines speculating with different
         drafts must not share executables."""
         if self._spec_tick_fn is not None:
             return self._spec_tick_fn
-        from megatron_llm_tpu.generation.speculative.verify import (
-            make_spec_tick_fn,
-        )
+        from megatron_llm_tpu.generation.ragged import make_ragged_tick_fn
 
         statics = ("engine_spec_tick", self.max_slots, self.pages_per_seq,
                    self.page_size, self.pool.num_pages,
@@ -836,10 +878,57 @@ class ContinuousBatchingEngine:
                    str(self.pool.draft_k.dtype), self._mesh_statics)
         self._spec_tick_fn = gen.cached_jit(
             self.cfg, "engine_spec_tick", statics,
-            lambda: make_spec_tick_fn(self.cfg, self.draft_cfg, self.spec_k,
-                                      tp=self._tp),
+            lambda: make_ragged_tick_fn(self.cfg, self.draft_cfg,
+                                        self.spec_k, 0, tp=self._tp),
             donate_argnums=(2, 3, 4, 5))
         return self._spec_tick_fn
+
+    def _ragged_tick(self, pre_rows: int):
+        """THE ragged-mode tick (generation/ragged.py): decode slots,
+        verify blocks and ``pre_rows`` prefill-chunk rows in ONE compiled
+        launch.  Every piece of tick composition — which slots decode,
+        per-slot speculation depth, which prompt positions prefill, their
+        block tables and kv horizons — is a traced operand (never a
+        static; graftcheck's recompile-hazard rule flags ragged metadata
+        that strays into the statics key).  The ONLY shape is
+        ``pre_rows``, the live prefill-row count bucketed to
+        ``prefill_chunk`` multiples: a BOUNDED set of at most
+        ``1 + prefill_rows // prefill_chunk`` executables (0 rows = the
+        pure decode/verify tick, byte-identical shape to the legacy tick),
+        so a decode-heavy tick never pays for dead prefill rows and tick
+        composition changes re-dispatch, never recompile
+        (tests/test_ragged_tick.py pins the bound)."""
+        fn = self._ragged_fns.get(pre_rows)
+        if fn is not None:
+            return fn
+        from megatron_llm_tpu.generation.ragged import make_ragged_tick_fn
+
+        if self.spec_k:
+            statics = ("engine_ragged_tick", self.max_slots,
+                       self.pages_per_seq, self.page_size,
+                       self.pool.num_pages, str(self.pool.k.dtype),
+                       self.spec_k, pre_rows, self._pre_tables_cap,
+                       gen.config_fingerprint(self.draft_cfg),
+                       str(self.pool.draft_k.dtype), self._mesh_statics)
+            fn = gen.cached_jit(
+                self.cfg, "engine_ragged_tick", statics,
+                lambda: make_ragged_tick_fn(
+                    self.cfg, self.draft_cfg, self.spec_k,
+                    pre_rows, tp=self._tp),
+                donate_argnums=(2, 3, 4, 5))
+        else:
+            statics = ("engine_ragged_tick", self.max_slots,
+                       self.pages_per_seq, self.page_size,
+                       self.pool.num_pages, str(self.pool.k.dtype),
+                       0, pre_rows, self._pre_tables_cap,
+                       self._mesh_statics)
+            fn = gen.cached_jit(
+                self.cfg, "engine_ragged_tick", statics,
+                lambda: make_ragged_tick_fn(
+                    self.cfg, None, 0, pre_rows, tp=self._tp),
+                donate_argnums=(1, 2))
+        self._ragged_fns[pre_rows] = fn
+        return fn
 
     def _prefill(self, s_pre: int, with_log_probs: bool):
         """Monolithic dense prefill (the ``prefill_chunk=0`` legacy path):
@@ -1064,6 +1153,7 @@ class ContinuousBatchingEngine:
             free_slots=sum(r is None for r in self._slots),
             queue_depth=len(self._queue),
             can_preempt=bool(self.prefill_chunk),
+            prefill_chunk=self.prefill_chunk,
         )
 
     def _admit(self) -> None:
@@ -1525,6 +1615,29 @@ class ContinuousBatchingEngine:
         self.spec_ticks += 1
         return emitted
 
+    def _apply_plain_locked(self, active, next_np, logp_np,
+                            now) -> int:  # holds _lock
+        """Fold one non-speculative tick's sampled tokens into the slots;
+        returns tokens emitted (== len(active))."""
+        for i in active:
+            req = self._slots[i]
+            tok = int(next_np[i])
+            req.generated.append(tok)
+            req.log_probs.append(float(logp_np[i]))
+            req._step += 1
+            if req._step == 1:
+                req._t_first = now
+            self._positions[i] += 1
+            self._tokens[i] = tok
+            self._steps[i] += 1
+            done = (self._stopped_by_token(req, tok)
+                    or len(req.generated) >= req.max_new_tokens
+                    or len(req.prompt) + len(req.generated)
+                    >= self.max_seq)
+            if done:
+                self._retire(i)
+        return len(active)
+
     def spec_stats(self) -> dict:
         """Speculative-decoding snapshot for ``/health`` and the spec
         bench (generation/server.py, bench_decode.py --mode spec)."""
@@ -1549,15 +1662,23 @@ class ContinuousBatchingEngine:
 
     # -- chunked prefill scheduling ---------------------------------------
 
-    def _advance_prefill(self) -> bool:
+    def _advance_prefill(self, only_log_probs: bool = False) -> bool:
         """Run ONE prefill chunk for the policy's chosen prefilling
         request (fcfs: the oldest).  Returns True if a chunk ran — the
-        policy's per-tick budget bounds how many run back to back, so
-        decode slots keep ticking while long prompts fill in the gaps."""
+        policy's token budget bounds how many run back to back, so decode
+        slots keep ticking while long prompts fill in the gaps.
+
+        ``only_log_probs`` is the ragged-mode carve-out: teacher-forced
+        prompt log-probs need every chunk position's logits from the
+        s>1 prefill program (their bits are pinned by the api scoring
+        contract), so ``return_log_probs`` prompts keep this legacy chunk
+        path even when everything else rides the fused ragged tick."""
         with self._lock:
             live = [r for r in self._prefill_q if r._phase == "prefill"]
             if len(live) != len(self._prefill_q):  # failed/cancelled
                 self._prefill_q = deque(live)
+            if only_log_probs:
+                live = [r for r in live if r.return_log_probs]
             if not live:
                 return False
             req = self.policy.prefill_order(
@@ -1643,20 +1764,112 @@ class ContinuousBatchingEngine:
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> int:
-        """Admit what fits, advance one prefill chunk, run one fused decode
-        tick over every slot, and retire finished requests.  Returns the
-        number of slots advanced (decode rows ticked, +1 if a prefill chunk
-        ran; 0 = idle, nothing ran).  Call from one driver at a time
-        (:meth:`run_until_idle` / the background loop serialize via
-        ``_drive_lock``)."""
+        """Admit what fits, advance prefill under the policy's token
+        budget, run the tick, and retire finished requests.  Returns the
+        number of slots advanced (decode rows ticked, +1 per prefill
+        phase that ran; 0 = idle, nothing ran).  Call from one driver at
+        a time (:meth:`run_until_idle` / the background loop serialize
+        via ``_drive_lock``).
+
+        Ragged mode (the default): the whole tick — decode slots, verify
+        blocks, prefill-chunk rows — is ONE compiled launch
+        (:meth:`_step_ragged`).  Legacy split mode dispatches the
+        decode/spec tick plus one program per prefill chunk."""
         with obs_trace.span("engine-admit"):
             self._admit()
+        if self.ragged:
+            return self._step_ragged()
+        return self._step_legacy()
+
+    def _prefill_budget_tokens(self) -> int:  # holds _lock
+        """The policy's per-tick prefill budget, validated as TOKENS
+        (ISSUE 11: the unit is pinned — a chunk-count return is a policy
+        bug) and floored to one chunk so prefill always advances."""
+        budget = self.policy.prefill_budget(
+            [r for r in self._prefill_q if r._phase == "prefill"],
+            self._sched_state(time.monotonic()))
+        if not isinstance(budget, int) or budget < 0:
+            raise ValueError(
+                f"prefill_budget must be a non-negative int of TOKENS, "
+                f"got {budget!r}")
+        return max(budget, self.prefill_chunk)
+
+    def _prepare_decode_locked(self, active) -> np.ndarray:  # holds _lock
+        """On-demand paging + per-slot speculation depth for the decode
+        rows of this tick; mutates ``active`` in place when a row must be
+        failed.
+
+        A row crossing into a page it doesn't own yet gets one allocated
+        now (commitment ledger guarantees this can't fail while the slot
+        is in flight).  A speculating slot writes up to k_eff positions
+        past its own, so its horizon covers the whole verify block; k_eff
+        itself is per-slot and per-tick — capped by --spec_k, the tokens
+        the request still owes, and (adaptive mode) the acceptance EMA.
+        Writes past a row's k_eff land on the null page or above the
+        accepted frontier — discarded by the acceptance mask, rewritten
+        before ever being attended."""
+        k_eff = np.zeros((self.max_slots,), np.int32)
+        for i in list(active):
+            req = self._slots[i]
+            if self.spec_k:
+                remaining = req.max_new_tokens - len(req.generated)
+                k_i = min(self.spec_k, remaining - 1)
+                if self.spec_adaptive:
+                    k_i = min(k_i, max(1, int(round(
+                        req._spec_ema * self.spec_k))))
+                k_eff[i] = max(k_i, 0)
+            p0 = int(self._positions[i]) // self.page_size
+            p1 = (int(self._positions[i]) + int(k_eff[i])) \
+                // self.page_size
+            for idx in range(p0, min(p1, self.pages_per_seq - 1) + 1):
+                if self._block_tables[i][idx] != NULL_PAGE:
+                    continue
+                got = self.pool.alloc(1)
+                if got is None:  # ledger-unreachable; fail just the row
+                    self._fail_locked(req, RuntimeError(
+                        "KV pool exhausted for an in-flight slot — "
+                        "commitment ledger violated"))
+                    active.remove(i)
+                    break
+                self._block_tables[i][idx] = got[0]
+                req._pages.append(got[0])
+                self._committed -= 1
+                self._dirty = True
+        return k_eff
+
+    def _dev_state_locked(self) -> Tuple:  # holds _lock
+        """The device mirror of the per-slot arrays, re-uploaded from the
+        host copies only when admission/retirement dirtied the layout."""
+        if self._dirty:
+            self._dev_state = (self._asarray(self._block_tables),
+                               self._asarray(self._positions),
+                               self._asarray(self._tokens),
+                               self._asarray(self._keys),
+                               self._asarray(self._steps),
+                               self._asarray(self._temperature),
+                               self._asarray(self._top_k),
+                               self._asarray(self._top_p))
+            self._dirty = False
+        return self._dev_state
+
+    def _note_launches_locked(self, n: int,
+                              prefill_tokens: int) -> None:  # holds _lock
+        """Tick-phase launch accounting (ISSUE 11): ``n`` compiled
+        attention programs were dispatched this step."""
+        self.tick_launches += n
+        self.last_tick_launches = n
+        if obs_registry.publishing():
+            if n:
+                self._m_launches.inc(n)
+            if prefill_tokens:
+                self._m_prefill_per_tick.observe(prefill_tokens)
+
+    def _step_legacy(self) -> int:
         with self._lock:
-            budget = self.policy.prefill_budget(
-                [r for r in self._prefill_q if r._phase == "prefill"],
-                self._sched_state(time.monotonic()))
+            budget = self._prefill_budget_tokens()
+            pre0 = self.prefill_tokens_computed
         did_prefill = 0
-        for _ in range(max(1, budget)):
+        for _ in range(max(1, budget // max(self.prefill_chunk, 1))):
             if not self._advance_prefill():
                 break
             did_prefill += 1
@@ -1664,6 +1877,8 @@ class ContinuousBatchingEngine:
             active = [i for i, r in enumerate(self._slots)
                       if r is not None and r._phase == "decode"]
             if not active:
+                self._note_launches_locked(
+                    did_prefill, self.prefill_tokens_computed - pre0)
                 if obs_registry.publishing():
                     self._m_active.set(0)
                     self._m_free_pages.set(self.pool.num_free)
@@ -1671,56 +1886,13 @@ class ContinuousBatchingEngine:
                         len(self.cache) if self.cache else 0)
                 self._publish_queued_locked()
                 return did_prefill
-            # on-demand paging: a row crossing into a page it doesn't own
-            # yet gets one allocated now (commitment ledger guarantees this
-            # can't fail while the slot is in flight).  A speculating slot
-            # writes up to k_eff positions past its own, so its horizon
-            # covers the whole verify block; k_eff itself is per-slot and
-            # per-tick — capped by --spec_k, the tokens the request still
-            # owes, and (adaptive mode) the acceptance EMA.  Writes past a
-            # row's k_eff land on the null page or above the accepted
-            # frontier — discarded by the acceptance mask, rewritten before
-            # ever being attended.
-            k_eff = np.zeros((self.max_slots,), np.int32)
-            for i in list(active):
-                req = self._slots[i]
-                if self.spec_k:
-                    remaining = req.max_new_tokens - len(req.generated)
-                    k_i = min(self.spec_k, remaining - 1)
-                    if self.spec_adaptive:
-                        k_i = min(k_i, max(1, int(round(
-                            req._spec_ema * self.spec_k))))
-                    k_eff[i] = max(k_i, 0)
-                p0 = int(self._positions[i]) // self.page_size
-                p1 = (int(self._positions[i]) + int(k_eff[i])) \
-                    // self.page_size
-                for idx in range(p0, min(p1, self.pages_per_seq - 1) + 1):
-                    if self._block_tables[i][idx] != NULL_PAGE:
-                        continue
-                    got = self.pool.alloc(1)
-                    if got is None:  # ledger-unreachable; fail just the row
-                        self._fail_locked(req, RuntimeError(
-                            "KV pool exhausted for an in-flight slot — "
-                            "commitment ledger violated"))
-                        active.remove(i)
-                        break
-                    self._block_tables[i][idx] = got[0]
-                    req._pages.append(got[0])
-                    self._committed -= 1
-                    self._dirty = True
+            k_eff = self._prepare_decode_locked(active)
             if not active:
+                self._note_launches_locked(
+                    did_prefill, self.prefill_tokens_computed - pre0)
                 return did_prefill
-            if self._dirty:
-                self._dev_state = (self._asarray(self._block_tables),
-                                   self._asarray(self._positions),
-                                   self._asarray(self._tokens),
-                                   self._asarray(self._keys),
-                                   self._asarray(self._steps),
-                                   self._asarray(self._temperature),
-                                   self._asarray(self._top_k),
-                                   self._asarray(self._top_p))
-                self._dirty = False
-            bt, pos, toks, keys, steps, temp, tk, tp = self._dev_state
+            bt, pos, toks, keys, steps, temp, tk, tp = \
+                self._dev_state_locked()
 
         t_tick = time.monotonic()
         if self.spec_k:
@@ -1762,25 +1934,11 @@ class ContinuousBatchingEngine:
                 emitted = self._apply_spec_locked(
                     active, k_eff, emit_np, lp_np, acc_np, m_np, now)
             else:
-                emitted = len(active)
-                for i in active:
-                    req = self._slots[i]
-                    tok = int(next_np[i])
-                    req.generated.append(tok)
-                    req.log_probs.append(float(logp_np[i]))
-                    req._step += 1
-                    if req._step == 1:
-                        req._t_first = now
-                    self._positions[i] += 1
-                    self._tokens[i] = tok
-                    self._steps[i] += 1
-                    done = (self._stopped_by_token(req, tok)
-                            or len(req.generated) >= req.max_new_tokens
-                            or len(req.prompt) + len(req.generated)
-                            >= self.max_seq)
-                    if done:
-                        self._retire(i)
+                emitted = self._apply_plain_locked(
+                    active, next_np, logp_np, now)
             self.ticked_tokens += emitted
+            self._note_launches_locked(
+                did_prefill + 1, self.prefill_tokens_computed - pre0)
             if obs_registry.publishing():
                 self._m_ticks.inc()
                 self._m_tokens.inc(emitted)
@@ -1793,6 +1951,208 @@ class ContinuousBatchingEngine:
                     len(self.cache) if self.cache else 0)
             self._publish_queued_locked()
         return len(active) + did_prefill
+
+    # -- the ragged tick (ISSUE 11) ----------------------------------------
+
+    def _plan_ragged_prefill(self):  # holds _lock
+        """Pack prefill-chunk rows for this tick under the policy's
+        token budget.
+
+        Chunks stay on the absolute ``prefill_chunk`` grid; multiple
+        chunks — from one request or several, in the policy's prefill
+        order — pack into the tick until the budget, the compiled row
+        capacity, or the work runs out.  A later chunk of the same
+        request may attend K/V a same-tick earlier chunk writes
+        (write-then-attend holds across the whole ragged batch).  Row
+        bits depend only on (token, position, horizon bucket), so ANY
+        packing produces the bitwise output the one-chunk-per-tick
+        legacy interleave produces.
+
+        Returns ``(spans, pre_tok, pre_pos, pre_tables, pre_index,
+        pre_hor, lp_live)`` where spans is ``[(req, start, end), ...]``,
+        ``pre_tables``/``pre_index`` are the COMPRESSED block tables (one
+        table per packed request, ``-1`` index = dead row), and
+        ``lp_live`` flags return_log_probs prompts that must take the
+        legacy teacher-forced chunk path instead."""
+        Rp = self.prefill_rows
+        pre_tok = np.zeros((Rp,), np.int32)
+        pre_pos = np.zeros((Rp,), np.int32)
+        pre_tables = np.full((self._pre_tables_cap, self.pages_per_seq),
+                             NULL_PAGE, np.int32)
+        pre_index = np.full((Rp,), -1, np.int32)
+        pre_hor = np.zeros((Rp,), np.int32)
+        spans: List[Tuple[EngineRequest, int, int]] = []
+        live = [r for r in self._prefill_q if r._phase == "prefill"]
+        if len(live) != len(self._prefill_q):  # failed/cancelled
+            self._prefill_q = deque(live)
+        lp_live = any(r.return_log_probs for r in live)
+        live = [r for r in live if not r.return_log_probs]
+        if not live:
+            return (spans, pre_tok, pre_pos, pre_tables, pre_index,
+                    pre_hor, lp_live)
+        budget = min(self._prefill_budget_tokens(), Rp)
+        order = self.policy.prefill_order(
+            live, self._sched_state(time.monotonic()))
+        used = 0
+        n_req = 0
+        ps = self.page_size
+        chunk = self.prefill_chunk
+        for req in order:
+            if n_req >= self._pre_tables_cap:
+                break  # table slots exhausted; the rest wait a tick
+            seq = req.seq_tokens  # resumed requests re-prefill their tail
+            prompt_len = len(seq)
+            fill_end = _bucket_up(prompt_len, ps)
+            pos = req._fill_pos
+            if pos >= fill_end or used >= budget:
+                continue
+            pre_tables[n_req, : len(req._pages)] = req._pages
+            while pos < fill_end and used < budget:
+                # absolute-grid chunk boundary (first/last may be short);
+                # a budget cut mid-chunk is fine — the next tick's chunk
+                # re-anchors on the grid
+                end = min(fill_end, (pos // chunk + 1) * chunk,
+                          pos + (budget - used))
+                for p in range(pos, end):
+                    pre_tok[used] = seq[p] if p < prompt_len else 0
+                    pre_pos[used] = p
+                    pre_index[used] = n_req
+                    pre_hor[used] = _bucket_up(p + 1)
+                    used += 1
+                spans.append((req, pos, end))
+                pos = end
+            n_req += 1
+            if used >= budget:
+                break
+        return (spans, pre_tok, pre_pos, pre_tables, pre_index,
+                pre_hor, lp_live)
+
+    def _apply_ragged_prefill_locked(self, spans) -> None:  # holds _lock
+        """Advance the packed requests' fill frontiers; a request whose
+        bucketed prompt completed inserts its full pages into the prefix
+        trie (refeed page excluded — shared pages immutable from birth)
+        and activates into decode, exactly like _advance_prefill's
+        completion tail."""
+        ps = self.page_size
+        for req, start, end in spans:
+            if req._phase != "prefill":  # failed mid-step (defensive)
+                continue
+            req._fill_pos = end
+            rows = end - start
+            self.prefill_tokens_computed += rows
+            if obs_registry.publishing():
+                self._m_prefill_tokens.inc(rows)
+            seq = req.seq_tokens
+            if end >= _bucket_up(len(seq), ps):
+                self._prefill_q.remove(req)
+                if self.cache is not None:
+                    self.cache.insert(seq, req._pages,
+                                      (len(seq) - 1) // ps)
+                self._activate(req, req._slot)
+
+    def _step_ragged(self) -> int:
+        """One fused ragged tick: decode slots + verify blocks + packed
+        prefill-chunk rows, ONE compiled attention launch
+        (generation/ragged.py).  return_log_probs prompts are the one
+        carve-out — their teacher-forced chunk keeps the legacy program
+        (counted honestly in the launch telemetry)."""
+        with self._lock:
+            pre0 = self.prefill_tokens_computed
+            (spans, pre_tok, pre_pos, pre_tables, pre_index, pre_hor,
+             lp_live) = self._plan_ragged_prefill()
+        did_lp = 1 if lp_live and self._advance_prefill(
+            only_log_probs=True) else 0
+        with self._lock:
+            active = [i for i, r in enumerate(self._slots)
+                      if r is not None and r._phase == "decode"]
+            if active:
+                k_eff = self._prepare_decode_locked(active)
+            else:
+                k_eff = np.zeros((self.max_slots,), np.int32)
+            if not active and not spans:
+                self._note_launches_locked(
+                    did_lp, self.prefill_tokens_computed - pre0)
+                if obs_registry.publishing():
+                    self._m_active.set(0)
+                    self._m_free_pages.set(self.pool.num_free)
+                    self._m_pages_cached.set(
+                        len(self.cache) if self.cache else 0)
+                self._publish_queued_locked()
+                return did_lp
+            bt, pos, toks, keys, steps, temp, tk, tp = \
+                self._dev_state_locked()
+
+        n_pre = sum(end - start for _, start, end in spans)
+        # live prefill rows bucketed to chunk multiples: the program's one
+        # shape knob (a dead-row-free decode tick at 0; composition within
+        # a bucket is pure data)
+        n_bucket = (min(self.prefill_rows,
+                        _bucket_up(n_pre, self.prefill_chunk))
+                    if n_pre else 0)
+        t_tick = time.monotonic()
+        with obs_trace.span("engine-ragged-tick", active=len(active),
+                            prefill_tokens=n_pre, launches=1,
+                            k=self.spec_k, tp=self._tp):
+            pre_args = () if not n_bucket else (
+                self._asarray(pre_tok[:n_bucket]),
+                self._asarray(pre_pos[:n_bucket]),
+                self._asarray(pre_tables),
+                self._asarray(pre_index[:n_bucket]),
+                self._asarray(pre_hor[:n_bucket]))
+            tick_fn = self._ragged_tick(n_bucket)
+            if self.spec_k:
+                (self.pool.k, self.pool.v, self.pool.draft_k,
+                 self.pool.draft_v, emit, emit_lp, acc, cnt,
+                 new_pos, next_tok, new_steps) = tick_fn(
+                    self.params, self.draft_params,
+                    self.pool.k, self.pool.v,
+                    self.pool.draft_k, self.pool.draft_v,
+                    bt, pos, toks, keys, steps, temp, tk, tp,
+                    self._asarray(k_eff), *pre_args)
+                emit_np = np.asarray(emit)
+                lp_np = np.asarray(emit_lp)
+                acc_np = np.asarray(acc)
+                m_np = np.asarray(cnt)
+            else:
+                (self.pool.k, self.pool.v, next_tok, logp,
+                 new_pos, new_steps) = tick_fn(
+                    self.params, self.pool.k, self.pool.v,
+                    bt, pos, toks, keys, steps, temp, tk, tp,
+                    *pre_args)
+                next_np = np.asarray(next_tok)
+                logp_np = np.asarray(logp)
+
+        now = time.monotonic()
+        with self._lock:
+            dt = now - t_tick  # feeds Retry-After/shed drain estimates
+            self._ema_tick_s = (dt if self._ema_tick_s is None
+                                else 0.8 * self._ema_tick_s + 0.2 * dt)
+            if not self._dirty:
+                # steady state: the tick already advanced the device mirror
+                self._dev_state = (bt, new_pos, next_tok, keys, new_steps,
+                                   temp, tk, tp)
+            self.ticks += 1
+            if self.spec_k:
+                emitted = self._apply_spec_locked(
+                    active, k_eff, emit_np, lp_np, acc_np, m_np, now)
+            else:
+                emitted = self._apply_plain_locked(
+                    active, next_np, logp_np, now)
+            self._apply_ragged_prefill_locked(spans)
+            self.ticked_tokens += emitted
+            self._note_launches_locked(
+                1 + did_lp, self.prefill_tokens_computed - pre0)
+            if obs_registry.publishing():
+                self._m_ticks.inc()
+                self._m_tokens.inc(emitted)
+                self._m_active.set(
+                    sum(r is not None and r._phase == "decode"
+                        for r in self._slots))
+                self._m_free_pages.set(self.pool.num_free)
+                self._m_pages_cached.set(
+                    len(self.cache) if self.cache else 0)
+            self._publish_queued_locked()
+        return len(active) + (1 if spans else 0) + did_lp
 
     def run_until_idle(self) -> None:
         """Drive ticks on the calling thread until queue and slots drain.

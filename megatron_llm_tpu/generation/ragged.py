@@ -1,0 +1,298 @@
+"""The fused RAGGED engine tick: one compiled program per engine geometry
+runs a whole tick's heterogeneous work — decode slots, speculative-verify
+blocks, and prefill chunks — as a single flattened row batch (ISSUE 11,
+PAPERS.md "Ragged Paged Attention").
+
+The legacy split dispatch compiles up to three shapes of the same
+computation per tick: the decode batch (``engine._tick``), one program per
+prefill-chunk geometry (``engine._chunk_prefill``), and the speculative
+verify.  Here the tick is ONE ragged batch of single-token rows; each row
+carries its own data-carried ``(token, position, block-table row, kv
+horizon)``:
+
+* a **decode slot** contributes 1 row (span 1) at its own position;
+* a **speculative-verify block** contributes ``spec_k + 1`` consecutive
+  rows (span k+1) — the PR 9 flattened-batch construction, now just an
+  ordinary span in the ragged batch rather than a special-cased program;
+* a **prefill chunk** contributes ``rows`` consecutive rows (span =
+  chunk), one per prompt position, writing K/V through the request's
+  block table exactly like the chunked-prefill path.
+
+Every op in the forward is then structurally an s=1 paged decode over a
+larger batch, and per-row bits are BATCH-SIZE INVARIANT (the PR 9 key
+numerics fact) — so decode rows are bitwise the legacy decode tick, verify
+rows are bitwise the legacy flattened verify, and prefill rows are bitwise
+the legacy chunk rows (masked attention is invariant to query-row
+partitioning when kv horizons stay on the BUCKET(64) grid — the PR 5
+contract).  That is what makes ragged output — tokens AND log-probs,
+greedy AND sampled, cache on/off — bitwise-identical to the legacy split
+path (tests/test_ragged_tick.py).
+
+``prefill_rows`` is the COMPILED prefill-row capacity (a static, like
+``max_slots``); which rows are live each tick is pure data.  With
+``prefill_rows=0`` the builders reduce exactly to the legacy programs:
+``make_ragged_tick_fn(cfg, None, 0, 0)`` is the decode tick and
+``make_ragged_tick_fn(cfg, draft_cfg, k, 0)`` is byte-for-byte the
+flattened spec verify this module absorbed from ``speculative/verify.py``.
+
+Write-then-attend causality holds across the whole ragged batch: all R
+rows' K/V lands first (each row a distinct (page, offset) — different
+requests own disjoint writable pages, consecutive rows of one request
+write consecutive positions), then every row attends causally ``<= its
+position``.  A prefill row may therefore attend K/V written by an earlier
+row of the SAME tick (its own chunk's prefix, or an earlier chunk of the
+same request packed into the same tick) — the property that lets the
+token-level prefill budget run multiple chunks per tick in one launch.
+
+Key discipline is unchanged from speculative/verify.py: every random draw
+derives from ``base = fold_in(request_key, steps)`` fanned out through
+disjoint DRAFT/ACCEPT/EMIT streams; no key is ever consumed twice.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.generation import generation as gen
+from megatron_llm_tpu.generation.sampling import (
+    filtered_logits_per_slot,
+    sample_per_slot,
+)
+from megatron_llm_tpu.generation.speculative.verify import (
+    ACCEPT_STREAM,
+    DRAFT_STREAM,
+    EMIT_STREAM,
+    speculative_acceptance,
+)
+from megatron_llm_tpu.models.language_model import (
+    make_rope_cache,
+    model_forward,
+)
+from megatron_llm_tpu.ops.paged_attention import PagedState
+
+
+def row_horizons(positions: jax.Array) -> jax.Array:
+    """Per-row kv horizon for LIVE rows: ``position + 1`` bucketed up to
+    the BUCKET(64) grid — the same bucketing the chunked-prefill path
+    applies to its attended page horizon, kept here so ragged bits depend
+    only on (tokens, positions), never on tick composition."""
+    b = gen.BUCKET
+    return ((positions // b) + 1) * b
+
+
+def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
+                        *, tp: int = 1):
+    """Build the fused ragged tick the engine compiles once per geometry.
+
+    Returned signature, ``spec_k >= 1`` (draft model present)::
+
+        (params, draft_params, pool_k, pool_v, draft_k, draft_v,
+         block_tables, positions, tokens, req_keys, steps,
+         temperature, top_k, top_p, k_eff
+         [, pre_tok, pre_pos, pre_tables, pre_index, pre_hor])
+        -> (pool_k, pool_v, draft_k, draft_v,
+            emit [b, K+1], emit_logp [b, K+1], accepted [b], counts [b],
+            new_pos, new_tok, new_steps)
+
+    and ``spec_k == 0`` (no draft args, plain per-slot sampling)::
+
+        (params, pool_k, pool_v, block_tables, positions, tokens,
+         req_keys, steps, temperature, top_k, top_p
+         [, pre_tok, pre_pos, pre_tables, pre_index, pre_hor])
+        -> (pool_k, pool_v, next_tok, logp, new_pos, new_steps)
+
+    The ``pre_*`` operands exist iff ``prefill_rows > 0``: ``pre_tok`` /
+    ``pre_pos`` / ``pre_hor`` are ``[prefill_rows]``; block tables come
+    COMPRESSED — ``pre_tables`` is ``[T_pre, max_pages_per_seq]`` (one
+    row per packed prefilling request) and ``pre_index`` maps each
+    prefill row to its request's table (``-1`` = dead row).  Inside, the
+    program assembles the tick's unique-table set ``[null] + slot tables
+    + pre_tables`` and a per-row index — rows of one span share one
+    table, so the jnp fallback gathers each table's pages exactly once
+    (ops/paged_attention.paged_attention_ragged) and the Pallas kernel
+    resolves ``tables[index[row], page]`` in its scalar-prefetch index
+    map.  Dead prefill rows carry horizon 0, the null table and position
+    0 — their writes land in garbage that is never attended, exactly
+    like idle decode slots.  All of it is traced data: ANY tick
+    composition — 6 decoding slots + 1 prefilling chunk + 1 verify
+    block, or all-decode, or all-prefill — re-dispatches the same
+    executable.
+    """
+    K = spec_k
+    vocab = cfg.model.vocab_size
+    scope_t = ("ragged-fwd" if tp == 1 else f"ragged-fwd-tp{tp}") \
+        if prefill_rows else \
+        (("verify-fwd" if tp == 1 else f"verify-fwd-tp{tp}") if K
+         else ("decode-fwd" if tp == 1 else f"decode-fwd-tp{tp}"))
+    scope_d = "draft-fwd" if tp == 1 else f"draft-fwd-tp{tp}"
+
+    def target_forward(params, pool_k, pool_v, tbl, idx, pos, tok, hor):
+        """ONE target forward over the full ragged batch — the single
+        attention launch of the tick.  ``tbl`` is the tick's compressed
+        unique-table set, ``idx`` each row's table."""
+        with jax.named_scope(scope_t):
+            logits, (pool_k, pool_v) = model_forward(
+                cfg, params, tok[:, None],
+                position_ids=pos[:, None],
+                rope_cache=make_rope_cache(cfg),
+                kv_caches=(pool_k, pool_v),
+                paged=PagedState(tbl, pos, hor, idx),
+            )
+        return logits[:, 0], pool_k, pool_v
+
+    def spec_tick(params, draft_params, pool_k, pool_v, draft_k, draft_v,
+                  block_tables, positions, tokens, req_keys, steps,
+                  temperature, top_k, top_p, k_eff,
+                  pre_tok=None, pre_pos=None, pre_tables=None,
+                  pre_index=None, pre_hor=None):
+        b = tokens.shape[0]
+        W = block_tables.shape[1]
+        null_tbl = jnp.zeros((1, W), block_tables.dtype)
+        rope_d = make_rope_cache(draft_cfg)
+        base = jax.vmap(jax.random.fold_in)(req_keys, steps)   # [b, 2]
+        greedy_row = top_k == 1
+
+        # ---- draft prefill rows (speculating engines keep BOTH caches
+        # filled for every prefilled page, so trie-matched pages carry
+        # valid draft K/V — the chunk_spec contract, fused in-program) ----
+        if prefill_rows:
+            d_idx = jnp.where(pre_index >= 0, 1 + pre_index, 0)
+            with jax.named_scope(scope_d):
+                _, (draft_k, draft_v) = model_forward(
+                    draft_cfg, draft_params, pre_tok[:, None],
+                    position_ids=pre_pos[:, None], rope_cache=rope_d,
+                    kv_caches=(draft_k, draft_v),
+                    paged=PagedState(
+                        jnp.concatenate([null_tbl, pre_tables]),
+                        pre_pos, pre_hor, d_idx))
+
+        # ---- 1) draft k tokens (sequential s=1 draft forwards) ----
+        # The scan runs K+1 steps, not K: step j < K samples draft token
+        # d_{j+1}; the final step feeds d_K at position pos+K purely for
+        # its K/V WRITE (its sample is discarded) — without it an
+        # all-accepted-plus-bonus tick leaves a permanent hole in the
+        # draft cache at d_K's position (the PR 9 acceptance-decay bug).
+        def draft_step(carry, j):
+            tok, dk, dv = carry
+            pos_j = positions + j
+            # rows past their own depth write to the NULL page: a clipped
+            # write at the end of the sequence budget would otherwise land
+            # inside the row's LAST real page and corrupt live KV
+            bt_j = jnp.where((j <= k_eff)[:, None], block_tables, 0)
+            with jax.named_scope(scope_d):
+                logits, (dk, dv) = model_forward(
+                    draft_cfg, draft_params, tok[:, None],
+                    position_ids=pos_j[:, None], rope_cache=rope_d,
+                    kv_caches=(dk, dv),
+                    paged=PagedState(bt_j, pos_j))
+            filt, greedy = filtered_logits_per_slot(
+                logits[:, -1], top_k=top_k, top_p=top_p,
+                temperature=temperature, vocab_size=vocab)
+            keys_j = jax.vmap(lambda kb: jax.random.fold_in(
+                jax.random.fold_in(kb, DRAFT_STREAM), j))(base)
+            drawn = jax.vmap(lambda k_, row: jax.random.categorical(k_, row))(
+                keys_j, filt)
+            nxt = jnp.where(greedy_row, greedy, drawn).astype(jnp.int32)
+            return (nxt, dk, dv), (nxt, filt)
+
+        (_, draft_k, draft_v), (draft_seq, q_seq) = jax.lax.scan(
+            draft_step, (tokens, draft_k, draft_v), jnp.arange(K + 1))
+        draft_toks = jnp.moveaxis(draft_seq[:K], 0, 1)   # [b, K]
+        q_filt = jnp.moveaxis(q_seq[:K], 0, 1)           # [b, K, v]
+
+        # ---- 2) target verify + prefill: ONE ragged forward ----
+        # verify blocks are ordinary span-(K+1) entries: row (slot i,
+        # offset j) feeds one token at position pos_i + j with slot i's
+        # block table; prefill rows append after them.
+        S = K + 1
+        block = jnp.concatenate([tokens[:, None], draft_toks], axis=1)
+        flat_tok = block.reshape(b * S)
+        flat_pos = (positions[:, None]
+                    + jnp.arange(S)[None, :]).reshape(b * S)
+        # compressed tables: [null] + the b slot tables (+ the packed
+        # prefilling requests' tables).  Null-table routing replaces the
+        # old per-row bt masking: verify rows past a slot's depth are
+        # discarded by the acceptance mask, and their writes must never
+        # clip into a live page at the budget edge
+        live = (jnp.arange(S)[None, :] <= k_eff[:, None]).reshape(b * S)
+        slot_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), S)
+        flat_idx = jnp.where(live, 1 + slot_ids, 0)
+        flat_hor = row_horizons(flat_pos)
+        if prefill_rows:
+            all_tok = jnp.concatenate([flat_tok, pre_tok])
+            all_pos = jnp.concatenate([flat_pos, pre_pos])
+            all_idx = jnp.concatenate(
+                [flat_idx,
+                 jnp.where(pre_index >= 0, 1 + b + pre_index, 0)])
+            all_tbl = jnp.concatenate([null_tbl, block_tables, pre_tables])
+            all_hor = jnp.concatenate([flat_hor, pre_hor])
+        else:
+            all_tok, all_pos, all_idx, all_hor = (
+                flat_tok, flat_pos, flat_idx, flat_hor)
+            all_tbl = jnp.concatenate([null_tbl, block_tables])
+        out, pool_k, pool_v = target_forward(
+            params, pool_k, pool_v, all_tbl, all_idx, all_pos, all_tok,
+            all_hor)
+        t_logits = out[: b * S].reshape(b, S, -1)      # [b, K+1, v_padded]
+
+        rep = lambda x: jnp.repeat(x, S, axis=0)  # noqa: E731
+        t_filt_flat, t_greedy_flat = filtered_logits_per_slot(
+            t_logits.reshape(b * S, -1), top_k=rep(top_k), top_p=rep(top_p),
+            temperature=rep(temperature), vocab_size=vocab)
+        t_filt = t_filt_flat.reshape(b, S, -1)
+        t_greedy = t_greedy_flat.reshape(b, S)
+
+        # ---- 3) lossless acceptance ----
+        u = jax.vmap(lambda kb: jax.random.uniform(
+            jax.random.fold_in(kb, ACCEPT_STREAM), (K,)))(base)
+        emit_keys = jax.vmap(
+            lambda kb: jax.random.fold_in(kb, EMIT_STREAM))(base)
+        accepted, counts, emit = speculative_acceptance(
+            draft_toks, q_filt, t_filt, t_greedy, greedy_row, k_eff,
+            u, emit_keys)
+
+        # reported per-token log-probs come from the RAW target logits,
+        # exactly like the non-speculative tick's gather
+        emit_logp = gen._gather_token_log_probs(t_logits, emit)
+
+        new_pos = positions + counts
+        new_steps = steps + counts
+        new_tok = jnp.take_along_axis(
+            emit, (counts - 1)[:, None], axis=1)[:, 0]
+        return (pool_k, pool_v, draft_k, draft_v, emit, emit_logp,
+                accepted, counts, new_pos, new_tok, new_steps)
+
+    def tick(params, pool_k, pool_v, block_tables, positions, tokens,
+             req_keys, steps, temperature, top_k, top_p,
+             pre_tok=None, pre_pos=None, pre_tables=None,
+             pre_index=None, pre_hor=None):
+        b = tokens.shape[0]
+        W = block_tables.shape[1]
+        null_tbl = jnp.zeros((1, W), block_tables.dtype)
+        idx = 1 + jnp.arange(b, dtype=jnp.int32)
+        hor = row_horizons(positions)
+        if prefill_rows:
+            all_tok = jnp.concatenate([tokens, pre_tok])
+            all_pos = jnp.concatenate([positions, pre_pos])
+            all_idx = jnp.concatenate(
+                [idx, jnp.where(pre_index >= 0, 1 + b + pre_index, 0)])
+            all_tbl = jnp.concatenate([null_tbl, block_tables, pre_tables])
+            all_hor = jnp.concatenate([hor, pre_hor])
+        else:
+            all_tok, all_pos, all_idx, all_hor = (
+                tokens, positions, idx, hor)
+            all_tbl = jnp.concatenate([null_tbl, block_tables])
+        out, pool_k, pool_v = target_forward(
+            params, pool_k, pool_v, all_tbl, all_idx, all_pos, all_tok,
+            all_hor)
+        last = out[:b]
+        keys = jax.vmap(jax.random.fold_in)(req_keys, steps)
+        next_tok = sample_per_slot(
+            keys, last, top_k=top_k, top_p=top_p,
+            temperature=temperature, vocab_size=cfg.model.vocab_size)
+        logp = gen._gather_token_log_probs(last, next_tok)
+        return (pool_k, pool_v, next_tok, logp,
+                positions + 1, steps + 1)
+
+    return spec_tick if K else tick

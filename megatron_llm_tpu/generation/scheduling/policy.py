@@ -7,7 +7,8 @@ read-only :class:`SchedulerState` snapshot and answers four questions —
   what order, and does a blocked best-candidate block everyone behind it
   (``barrier_admission``, the FCFS no-starvation property)?
 * **prefill schedule**: which prefilling request gets the next chunk, and
-  how many chunks may run this tick (``prefill_budget``)?
+  how many prompt TOKENS may prefill this tick (``prefill_budget`` —
+  token-denominated, NOT a chunk count; see its docstring)?
 * **preemption**: when the best queued candidate cannot admit (no slot,
   or the page budget is short), which decoding request — if any — should
   release its pages and re-queue?  The engine only calls this when
@@ -61,6 +62,7 @@ class SchedulerState:
     free_slots: int
     queue_depth: int
     can_preempt: bool                # chunked mode + policy allows it
+    prefill_chunk: int = 0           # engine chunk size in tokens (0 = off)
 
     def drain_eta(self, depth: int) -> Optional[float]:
         """Predicted seconds until ``depth`` queued requests drain, from
@@ -108,10 +110,18 @@ class SchedulerPolicy:
 
     def prefill_budget(self, prefilling: Sequence,
                        state: SchedulerState) -> int:
-        """Chunks the engine may run this tick (>= 1 keeps long prompts
-        draining; the default matches the pre-policy one-chunk-per-tick
-        interleave, so decode never stalls behind prefill)."""
-        return 1
+        """Prompt TOKENS the engine may prefill this tick.
+
+        The unit is TOKENS, not chunks (ISSUE 11 pinned the ambiguity):
+        the engine floors the budget to at least one chunk
+        (``state.prefill_chunk``) so prefill always advances, and caps it
+        at its compiled prefill-row capacity; a budget of N tokens may
+        therefore admit MULTIPLE chunks from MULTIPLE prefilling requests
+        into one tick (tests/test_ragged_tick.py pins the regression).
+        The default — exactly one chunk's worth — matches the pre-policy
+        one-chunk-per-tick interleave, so decode never stalls behind
+        prefill.  Negative returns are a policy bug and raise."""
+        return max(state.prefill_chunk, 1)
 
     # ---- shedding ------------------------------------------------------
 

@@ -13,11 +13,14 @@ The subsystem lives in three pieces:
   bundle: a separate (same-family, smaller) config + params that share
   the target's tokenizer/vocab, resolved from ``--spec_draft`` and
   sharded by the same tp.py rules when a mesh is present.
-* :mod:`~megatron_llm_tpu.generation.speculative.verify` — the fused
-  draft-k-then-verify tick program and the lossless acceptance rule
-  (greedy: bitwise-identical to non-speculative decode; sampled:
-  residual rejection sampling whose output distribution provably equals
-  the target model's).
+* :mod:`~megatron_llm_tpu.generation.speculative.verify` — the lossless
+  acceptance rule (greedy: bitwise-identical to non-speculative decode;
+  sampled: residual rejection sampling whose output distribution provably
+  equals the target model's) and the disjoint key-stream discipline.  The
+  fused draft-k-then-verify tick program itself lives in
+  :mod:`~megatron_llm_tpu.generation.ragged` (ISSUE 11): verify blocks
+  are ordinary span-(k+1) entries of the engine's single-launch ragged
+  tick, not a special-cased program.
 * the engine integration (generation/engine.py): draft K/V lives in the
   SAME :class:`~megatron_llm_tpu.generation.engine.PagedKVPool` — every
   page id indexes both the target and the draft pools, so one block
@@ -36,7 +39,6 @@ from megatron_llm_tpu.generation.speculative.draft import (
     resolve_draft,
 )
 from megatron_llm_tpu.generation.speculative.verify import (
-    make_spec_tick_fn,
     speculative_acceptance,
 )
 
@@ -44,7 +46,6 @@ __all__ = [
     "DraftModel",
     "check_draft_compat",
     "extend_params_identity",
-    "make_spec_tick_fn",
     "resolve_draft",
     "speculative_acceptance",
 ]

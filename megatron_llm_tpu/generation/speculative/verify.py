@@ -1,6 +1,13 @@
-"""The fused draft-then-verify tick and the lossless acceptance rule.
+"""The lossless speculative acceptance rule and its key-stream discipline.
 
-One compiled program per engine geometry does all of:
+The fused draft-then-verify tick that used to live here moved to
+``generation/ragged.py`` (ISSUE 11): verify's k+1 query positions are no
+longer a special-cased flattened-batch program — they are ordinary
+span-(k+1) entries in the engine's RAGGED tick batch, which also carries
+the decode slots and the tick's prefill-chunk rows in the same single
+launch.  ``make_ragged_tick_fn(cfg, draft_cfg, spec_k, prefill_rows=0)``
+is byte-for-byte the program this module used to build.  The design that
+program implements:
 
 1. **Draft k tokens** — ``spec_k`` autoregressive s=1 forwards of the
    draft model (a ``lax.scan``), each writing draft K/V through the SAME
@@ -62,16 +69,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from megatron_llm_tpu.generation import generation as gen
-from megatron_llm_tpu.generation.sampling import (
-    NEG_INF,
-    filtered_logits_per_slot,
-)
-from megatron_llm_tpu.models.language_model import (
-    make_rope_cache,
-    model_forward,
-)
-from megatron_llm_tpu.ops.paged_attention import PagedState
+from megatron_llm_tpu.generation.sampling import NEG_INF
 
 # disjoint key streams fanned out of the per-(request, step) base key
 DRAFT_STREAM = 1   # j-th draft sampling draw
@@ -143,123 +141,3 @@ def speculative_acceptance(
                      jnp.where(t_idx == accepted[:, None],
                                emit_at[:, None], 0)).astype(jnp.int32)
     return accepted, counts, emit
-
-
-def make_spec_tick_fn(cfg, draft_cfg, spec_k: int, *, tp: int = 1):
-    """Build the fused speculative tick the engine compiles once.
-
-    Signature of the returned function::
-
-        (params, draft_params, pool_k, pool_v, draft_k, draft_v,
-         block_tables, positions, tokens, req_keys, steps,
-         temperature, top_k, top_p, k_eff)
-        -> (pool_k, pool_v, draft_k, draft_v,
-            emit [b, K+1], emit_logp [b, K+1],
-            accepted [b], counts [b], new_pos, new_tok, new_steps)
-
-    ``k_eff`` caps each slot's ACCEPTED depth; the draft loop still runs
-    the static ``spec_k`` steps for every row (one compiled program),
-    rows past their ``k_eff`` just produce writes the acceptance mask
-    discards and later blocks overwrite-before-attend.
-    """
-    K = spec_k
-    assert K >= 1
-    vocab = cfg.model.vocab_size
-    scope_t = "verify-fwd" if tp == 1 else f"verify-fwd-tp{tp}"
-    scope_d = "draft-fwd" if tp == 1 else f"draft-fwd-tp{tp}"
-
-    def spec_tick(params, draft_params, pool_k, pool_v, draft_k, draft_v,
-                  block_tables, positions, tokens, req_keys, steps,
-                  temperature, top_k, top_p, k_eff):
-        b = tokens.shape[0]
-        rope_t = make_rope_cache(cfg)
-        rope_d = make_rope_cache(draft_cfg)
-        base = jax.vmap(jax.random.fold_in)(req_keys, steps)   # [b, 2]
-        greedy_row = top_k == 1
-
-        # ---- 1) draft k tokens (sequential s=1 draft forwards) ----
-        # The scan runs K+1 steps, not K: step j < K samples draft token
-        # d_{j+1}; the final step feeds d_K at position pos+K purely for
-        # its K/V WRITE (its sample is discarded).  Without it, an
-        # all-accepted-plus-bonus tick leaves a permanent hole in the
-        # draft cache at d_K's position — the next tick starts past it,
-        # the draft forever attends garbage there, and acceptance decays
-        # (the bug showed up as ~78% acceptance on a draft the target
-        # provably agrees with).
-        def draft_step(carry, j):
-            tok, dk, dv = carry
-            pos_j = positions + j
-            # rows past their own depth write to the NULL page: a clipped
-            # write at the end of the sequence budget would otherwise land
-            # inside the row's LAST real page and corrupt live K/V (the
-            # engine only allocates pages up to pos + k_eff)
-            bt_j = jnp.where((j <= k_eff)[:, None], block_tables, 0)
-            with jax.named_scope(scope_d):
-                logits, (dk, dv) = model_forward(
-                    draft_cfg, draft_params, tok[:, None],
-                    position_ids=pos_j[:, None], rope_cache=rope_d,
-                    kv_caches=(dk, dv),
-                    paged=PagedState(bt_j, pos_j))
-            filt, greedy = filtered_logits_per_slot(
-                logits[:, -1], top_k=top_k, top_p=top_p,
-                temperature=temperature, vocab_size=vocab)
-            keys_j = jax.vmap(lambda kb: jax.random.fold_in(
-                jax.random.fold_in(kb, DRAFT_STREAM), j))(base)
-            drawn = jax.vmap(lambda k_, row: jax.random.categorical(k_, row))(
-                keys_j, filt)
-            nxt = jnp.where(greedy_row, greedy, drawn).astype(jnp.int32)
-            return (nxt, dk, dv), (nxt, filt)
-
-        (_, draft_k, draft_v), (draft_seq, q_seq) = jax.lax.scan(
-            draft_step, (tokens, draft_k, draft_v), jnp.arange(K + 1))
-        draft_toks = jnp.moveaxis(draft_seq[:K], 0, 1)   # [b, K]
-        q_filt = jnp.moveaxis(q_seq[:K], 0, 1)           # [b, K, v]
-
-        # ---- 2) target verify: k+1 positions flattened into the batch ----
-        S = K + 1
-        block = jnp.concatenate([tokens[:, None], draft_toks], axis=1)
-        flat_tok = block.reshape(b * S)
-        flat_pos = (positions[:, None]
-                    + jnp.arange(S)[None, :]).reshape(b * S)
-        # same null-page routing as the draft loop: verify rows past a
-        # slot's depth are discarded by the acceptance mask, and their
-        # writes must never clip into a live page at the budget edge
-        live = (jnp.arange(S)[None, :] <= k_eff[:, None]).reshape(b * S)
-        flat_bt = jnp.where(live[:, None],
-                            jnp.repeat(block_tables, S, axis=0), 0)
-        with jax.named_scope(scope_t):
-            logits, (pool_k, pool_v) = model_forward(
-                cfg, params, flat_tok[:, None],
-                position_ids=flat_pos[:, None], rope_cache=rope_t,
-                kv_caches=(pool_k, pool_v),
-                paged=PagedState(flat_bt, flat_pos))
-        t_logits = logits[:, 0].reshape(b, S, -1)      # [b, K+1, v_padded]
-
-        rep = lambda x: jnp.repeat(x, S, axis=0)  # noqa: E731
-        t_filt_flat, t_greedy_flat = filtered_logits_per_slot(
-            t_logits.reshape(b * S, -1), top_k=rep(top_k), top_p=rep(top_p),
-            temperature=rep(temperature), vocab_size=vocab)
-        t_filt = t_filt_flat.reshape(b, S, -1)
-        t_greedy = t_greedy_flat.reshape(b, S)
-
-        # ---- 3) lossless acceptance ----
-        u = jax.vmap(lambda kb: jax.random.uniform(
-            jax.random.fold_in(kb, ACCEPT_STREAM), (K,)))(base)
-        emit_keys = jax.vmap(
-            lambda kb: jax.random.fold_in(kb, EMIT_STREAM))(base)
-        accepted, counts, emit = speculative_acceptance(
-            draft_toks, q_filt, t_filt, t_greedy, greedy_row, k_eff,
-            u, emit_keys)
-
-        # reported per-token log-probs come from the RAW target logits,
-        # exactly like the non-speculative tick's gather
-        emit_logp = gen._gather_token_log_probs(t_logits, emit)
-
-        new_pos = positions + counts
-        new_steps = steps + counts
-        new_tok = jnp.take_along_axis(
-            emit, (counts - 1)[:, None], axis=1)[:, 0]
-        return (pool_k, pool_v, draft_k, draft_v, emit, emit_logp,
-                accepted, counts, new_pos, new_tok, new_steps)
-
-    return spec_tick

@@ -235,24 +235,42 @@ def attention_sublayer(
         from megatron_llm_tpu.ops.paged_attention import (
             paged_attention_decode,
             paged_attention_prefill,
+            paged_attention_ragged,
         )
 
         pk, pv = kv_cache
         page_size = pk.shape[1]
         pos = paged.positions
+        # ragged compressed tables (ISSUE 11): block_tables holds the
+        # tick's UNIQUE tables and table_index maps rows onto them; the
+        # K/V write needs per-row tables, a [rows, pages] int gather
+        row_tables = paged.block_tables
+        if paged.table_index is not None:
+            row_tables = row_tables[paged.table_index]
         wpos = pos[:, None] + jnp.arange(s)[None, :]       # [b, s]
         # clip: idle slots' device-side positions keep advancing between
         # engine re-uploads, and a chunk's garbage padding rows may run past
         # the table; clipped lookups resolve to null-page (or
         # decode-overwritten) entries, so the stray writes are never attended
         page_slot = jnp.clip(wpos // page_size, 0,
-                             paged.block_tables.shape[1] - 1)
-        page_ids = jnp.take_along_axis(paged.block_tables, page_slot, axis=1)
+                             row_tables.shape[1] - 1)
+        page_ids = jnp.take_along_axis(row_tables, page_slot, axis=1)
         offs = wpos % page_size
         pk = pk.at[page_ids, offs].set(k.astype(pk.dtype))
         pv = pv.at[page_ids, offs].set(v.astype(pv.dtype))
         new_cache = (pk, pv)
-        if s == 1:
+        if s == 1 and paged.horizons is not None:
+            # ragged tick (ISSUE 11): one launch for a mixed
+            # decode/verify/prefill row batch; each row carries its own
+            # data-carried kv horizon (0 = dead padding row) and an index
+            # into the tick's unique block tables
+            ctx = paged_attention_ragged(
+                q, pk, pv, paged.block_tables, paged.table_index, pos,
+                paged.horizons,
+                scale=scale, sliding_window=m.sliding_window_size,
+                use_kernel=cfg.training.use_flash_attn,
+            )
+        elif s == 1:
             ctx = paged_attention_decode(
                 q, pk, pv, paged.block_tables, pos, scale=scale,
                 sliding_window=m.sliding_window_size,

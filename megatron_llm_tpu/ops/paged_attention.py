@@ -48,6 +48,22 @@ class PagedState(NamedTuple):
 
     block_tables: jax.Array  # [b, max_pages_per_seq] int32 page ids
     positions: jax.Array     # [b] int32 — position of tokens[:, 0] per row
+    # RAGGED batch metadata (ISSUE 11).  None selects the legacy
+    # decode/prefill dispatch; traced arrays route s == 1 batches to
+    # paged_attention_ragged — the single-launch form a mixed
+    # prefill+decode+verify tick runs on.  Data-carried, never static:
+    # tick composition changes never recompile.
+    #
+    # ``horizons`` is each row's kv horizon in tokens, bucketed to
+    # BUCKET(64)-token multiples (0 = dead padding row, touches no page).
+    # When ``table_index`` is set, ``block_tables`` is COMPRESSED to the
+    # tick's unique tables [T, max_pages] (one per decode slot + one per
+    # packed prefilling request + the null table) and ``table_index``
+    # maps each of the R rows to its table — rows of one span share one
+    # table, so the fallback gathers each table's pages once instead of
+    # once per row.
+    horizons: Optional[jax.Array] = None     # [R] int32 or None
+    table_index: Optional[jax.Array] = None  # [R] int32 into block_tables
 
 
 def paged_gather_kv(k_pool: jax.Array, v_pool: jax.Array,
@@ -104,6 +120,92 @@ def paged_attention_decode(
     bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)
     return attn_ops.xla_attention(
         q, k_all, v_all, bias=bias[:, None, None, :], scale=scale)
+
+
+def paged_attention_ragged(
+    q: jax.Array,             # [R, 1, n_heads, d] — one query row per entry
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    tables: jax.Array,        # [T, max_pages_per_seq] int32 — UNIQUE tables
+    table_index: jax.Array,   # [R] int32 — each row's table
+    positions: jax.Array,     # [R] int32 — each row's own position
+    horizons: jax.Array,      # [R] int32 — bucketed kv horizon (0 = dead row)
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    use_kernel: bool = True,
+) -> jax.Array:
+    """One RAGGED batch of paged attention; returns [R, 1, n_heads, d].
+
+    The ragged decomposition (PAPERS.md "Ragged Paged Attention"): a tick's
+    heterogeneous work — decode slots (query span 1), speculative-verify
+    blocks (span k+1) and prefill chunks (span = chunk rows) — is flattened
+    into R single-token rows, each carrying its own (position, kv horizon,
+    block table).  Block tables arrive COMPRESSED: rows of one span share
+    one entry of ``tables`` and ``table_index`` names it, so a 64-row
+    chunk walks its pages once, not 64 times.  One launch serves any mix;
+    the composition lives entirely in the data-carried metadata, so
+    changing it never recompiles.
+
+    Numerics contract (tests/test_ragged_tick.py): row ``i`` computes the
+    s=1 decode attention at ``positions[i]`` over its own table — bitwise
+    what :func:`paged_attention_decode` produces for that row (per-row
+    bits are batch-size invariant, and batching scores over the unique
+    tables then selecting a row's table is bitwise the per-row gather),
+    which is also bitwise what a chunked prefill produces for the same
+    (tokens, positions) because masked attention is invariant to
+    query-row partitioning when kv horizons stay on the BUCKET(64) grid.
+    ``horizons`` bounds the page walk in the Pallas kernel (a dead row —
+    horizon 0 — skips every page); the fallback's mask ``kv_pos <=
+    positions`` subsumes them.
+    """
+    assert q.ndim == 4 and q.shape[1] == 1, "ragged rows are [R, 1, n, d]"
+    b, _, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    if use_kernel and _kernel_ok(q, k_pool):
+        from megatron_llm_tpu.ops.pallas.paged_attention import (
+            paged_ragged_kernel,
+        )
+
+        return paged_ragged_kernel(
+            q, k_pool, v_pool, tables, table_index, positions, horizons,
+            scale=scale, sliding_window=sliding_window,
+        )
+
+    # fallback: gather each UNIQUE table's pages once, batch the score
+    # matmul over all T tables, select each row's table, softmax only the
+    # selected scores, then scatter the probs back through a one-hot so
+    # the context matmul keeps the shared [T, kv] v layout (no per-row
+    # gather ever materializes) — bitwise the per-row-gathered
+    # xla_attention decode fallback (same contractions, same per-(row,
+    # table) reduction order; only the batching layout moves)
+    T = tables.shape[0]
+    nkv = k_pool.shape[2]
+    g = n // nkv
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, tables)  # [T, kv, nkv, d]
+    kv_len = k_all.shape[1]
+    qg = q.reshape(b, 1, nkv, g, d)
+    # [R, T, nkv, g, 1, kv] — the decode fallback's "bqhgd,bkhd->bhgqk"
+    # with the table dim batched
+    scores = jnp.einsum("bqhgd,tkhd->bthgqk", qg * scale, k_all)
+    scores = scores.astype(jnp.float32)
+    idx6 = table_index[:, None, None, None, None, None]
+    s_sel = jnp.take_along_axis(scores, idx6, axis=1)[:, 0]
+    kv_pos = jnp.arange(kv_len)[None, :]
+    allowed = kv_pos <= positions[:, None]
+    if sliding_window is not None:
+        allowed &= positions[:, None] - kv_pos < sliding_window
+    bias = jnp.where(allowed, 0.0, attn_ops.NEG_INF).astype(jnp.float32)
+    s_sel = s_sel + bias[:, None, None, None, :]
+    p_sel = jax.nn.softmax(s_sel, axis=-1).astype(v_all.dtype)
+    onehot = (jnp.arange(T)[None, :]
+              == table_index[:, None]).astype(v_all.dtype)      # [R, T]
+    p_full = p_sel[:, None] * onehot[:, :, None, None, None, None]
+    out = jnp.einsum("bthgqk,tkhd->bthgqd", p_full, v_all)
+    sel = jnp.take_along_axis(out, idx6, axis=1)[:, 0]
+    # [R, nkv, g, 1, d] -> [R, 1, n, d]
+    return sel.transpose(0, 3, 1, 2, 4).reshape(b, 1, n, d)
 
 
 def paged_attention_prefill(

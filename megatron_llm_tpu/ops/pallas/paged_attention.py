@@ -184,6 +184,153 @@ def _prefill_kernel(
         o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
+def _ragged_kernel(
+    # scalar prefetch — the per-row ragged metadata (ISSUE 11): the
+    # tick's UNIQUE block tables, each row's table index, query position
+    # and bucketed kv horizon all arrive as data-carried prefetch
+    # operands, so ONE compiled launch serves any tick composition
+    # (decode slots, verify blocks, prefill chunks)
+    tbl_ref,     # [T, max_pages] int32 unique block tables
+    idx_ref,     # [R] int32 row -> table
+    pos_ref,     # [R] int32 query positions
+    hor_ref,     # [R] int32 kv horizons (tokens, 0 = dead row)
+    # tensor refs
+    q_ref,       # block [1, 1, g, d]
+    k_ref,       # block [1, page, 1, d]
+    v_ref,       # block [1, page, 1, d]
+    o_ref,       # block [1, 1, g, d]
+    # scratch
+    m_s,         # [g, 1] fp32 running max
+    l_s,         # [g, 1] fp32 normalizer
+    acc_s,       # [g, d] fp32 accumulator
+    *,
+    scale: float,
+    page_size: int,
+    sliding_window: Optional[int],
+):
+    """Ragged sibling of :func:`_decode_kernel`: one query row per grid
+    step, same online-softmax page walk, but the page loop is bounded by
+    the row's own data-carried horizon — a dead row (horizon 0, the fixed
+    batch's padding) touches no page at all, and the accumulated work per
+    row scales with that row's context, not the widest row's."""
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    first = j * page_size
+    pos = pos_ref[i]
+    hor = hor_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # first <= pos gives bitwise the decode kernel's page set for live
+    # rows (hor >= pos + 1 by construction); first < hor kills dead rows
+    run = jnp.logical_and(first <= pos, first < hor)
+    if sliding_window is not None:
+        run = jnp.logical_and(run, first + page_size > pos - sliding_window + 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # [g, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [g, page]
+        kv_pos = first + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = kv_pos <= pos
+        if sliding_window is not None:
+            mask = jnp.logical_and(mask, pos - kv_pos < sliding_window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_s[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - m_cur[:, None]))
+        l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
+        m_s[:, 0] = m_cur
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_s[:, 0]
+        # dead rows never ran a page: l == 0 -> exact zeros out
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_ragged_kernel(
+    q: jax.Array,             # [R, 1, n_heads, d]
+    k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    v_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]
+    tables: jax.Array,        # [T, max_pages_per_seq] int32 unique tables
+    table_index: jax.Array,   # [R] int32 row -> table
+    positions: jax.Array,     # [R] int32
+    horizons: jax.Array,      # [R] int32 bucketed kv horizon (0 = dead)
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dispatch wrapper; returns [R, 1, n_heads, d] in q's dtype.
+
+    ONE launch for a whole ragged tick: every row of a mixed decode /
+    spec-verify / prefill batch is a grid step over its own block table
+    — resolved as ``tables[table_index[row], page]`` in the BlockSpec
+    index map, with (position, horizon) scalar-prefetched alongside.
+    All four operands are traced data — composition changes re-dispatch
+    the same executable, never recompile."""
+    b, _, n, d = q.shape
+    num_pages, page_size, nkv, _ = k_pool.shape
+    assert n % nkv == 0
+    g = n // nkv
+    max_pages = tables.shape[1]
+
+    qg = q.reshape(b, nkv, g, d)
+    grid = (b, nkv, max_pages)
+
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, page_size=page_size,
+        sliding_window=sliding_window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda i, h, j, tbl, idx, pos, hor: (i, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, tbl, idx, pos, hor:
+                         (tbl[idx[i], j], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda i, h, j, tbl, idx, pos, hor:
+                         (tbl[idx[i], j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda i, h, j, tbl, idx, pos, hor:
+                               (i, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), table_index.astype(jnp.int32),
+      positions.astype(jnp.int32), horizons.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, 1, n, d)
+
+
 def paged_prefill_kernel(
     q: jax.Array,             # [b, s, n_heads, d]
     k_pool: jax.Array,        # [num_pages, page_size, n_kv_heads, d]

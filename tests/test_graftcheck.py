@@ -233,6 +233,49 @@ def test_historic_id_keyed_cached_jit():
     assert "id()" in hits[0].message
 
 
+def test_ragged_metadata_in_cached_jit_statics_flagged():
+    """ISSUE 11: per-tick ragged batch composition (spans / horizons /
+    k_eff) in a cached_jit STATICS key compiles one executable per tick
+    mix — the dispatch explosion the ragged kernel removes.  The
+    recompile-hazard rule pins the pattern; composition must be a traced
+    operand (generation/ragged.py contract)."""
+    bad_inline = (
+        "from megatron_llm_tpu.generation import generation as gen\n"
+        "def tick_fn(self, spans, horizons):\n"
+        "    return gen.cached_jit(\n"
+        "        self.cfg, 'engine_ragged_tick',\n"
+        "        ('engine_ragged_tick', self.max_slots, tuple(spans),\n"
+        "         tuple(horizons)),\n"
+        "        lambda: None)\n"
+    )
+    hits_inline = [f for f in findings_for(bad_inline)
+                   if f.rule == "recompile-hazard"
+                   and "ragged" in f.message]
+    assert hits_inline, "ragged metadata in statics not flagged"
+    # k_eff sneaking in as an attribute is caught too
+    bad_attr = (
+        "from megatron_llm_tpu.generation import generation as gen\n"
+        "def tick_fn(self):\n"
+        "    return gen.cached_jit(\n"
+        "        self.cfg, 't', ('t', self.k_eff), lambda: None)\n"
+    )
+    assert [f for f in findings_for(bad_attr)
+            if f.rule == "recompile-hazard" and "ragged" in f.message]
+    # the engine's REAL statics (geometry capacities, dtypes, mesh) are
+    # clean — capacities like prefill_rows are shapes, not composition
+    good = (
+        "from megatron_llm_tpu.generation import generation as gen\n"
+        "def tick_fn(self, pre_rows):\n"
+        "    return gen.cached_jit(\n"
+        "        self.cfg, 'engine_ragged_tick',\n"
+        "        ('engine_ragged_tick', self.max_slots, pre_rows,\n"
+        "         self.pages_per_seq, str(self.pool.k.dtype)),\n"
+        "        lambda: None)\n"
+    )
+    assert not [f for f in findings_for(good)
+                if f.rule == "recompile-hazard"]
+
+
 def test_historic_direct_shard_map_import():
     """The 8-failure jax-0.4.37 gap: every direct spelling is caught,
     and compat.py itself is exempt."""

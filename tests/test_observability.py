@@ -578,7 +578,10 @@ def test_generation_server_metrics_endpoint():
                       "mlt_engine_prefix_hit_tokens_total",
                       "mlt_engine_prefix_miss_tokens_total",
                       "mlt_engine_pages_cached",
-                      "mlt_engine_pages_cow_copies_total"):
+                      "mlt_engine_pages_cow_copies_total",
+                      # ISSUE 11: ragged-tick launch telemetry
+                      "mlt_engine_tick_launches_total",
+                      "mlt_engine_prefill_tokens_per_tick"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
         # /health still answers alongside

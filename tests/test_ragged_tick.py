@@ -1,0 +1,396 @@
+"""Ragged paged attention tests (ISSUE 11).
+
+Gates:
+
+1. **Bitwise parity matrix** — the ragged single-launch tick emits tokens
+   AND log-probs bitwise-identical to the legacy split dispatch (decode
+   tick + per-chunk prefill programs + flattened spec verify) across:
+   decode-only, prefill-heavy, mixed, speculative (greedy and sampled),
+   cache on/off, preemption/resume, and tp=4 (token identity).
+2. **One launch per tick** — a mixed prefill+decode+spec tick dispatches
+   exactly ONE compiled attention program, asserted via the engine's
+   launch counter AND the ``engine-ragged-tick`` trace span (launches
+   claimed in traces, not assumed).
+3. **No recompiles** — tick-composition changes (different span/horizon
+   mixes: all-decode, decode+prefill, multi-request prefill, drained)
+   re-dispatch one executable (``_cache_size() == 1``).
+4. **Token-level prefill budget** — ``SchedulerPolicy.prefill_budget`` is
+   TOKENS: a budget of N admits multiple chunks from multiple requests
+   into one tick; negative/typed-wrong budgets raise.
+5. Telemetry: ``mlt_engine_tick_launches_total`` /
+   ``mlt_engine_prefill_tokens_per_tick`` reach ``/metrics``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from megatron_llm_tpu.generation import ContinuousBatchingEngine, DraftModel
+from megatron_llm_tpu.generation.scheduling import SchedulerPolicy
+
+VOCAB = 67
+
+
+@pytest.fixture(scope="module")
+def models():
+    from megatron_llm_tpu.models import init_model_params, make_config
+
+    def mk(layers, hidden, heads, nkv, ffn):
+        return make_config(
+            "llama2", num_layers=layers, hidden_size=hidden,
+            num_attention_heads=heads, num_attention_heads_kv=nkv,
+            ffn_hidden_size=ffn, seq_length=256,
+            max_position_embeddings=256, vocab_size=VOCAB,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            params_dtype="float32", use_flash_attn=False,
+        )
+
+    cfg = mk(2, 64, 4, 2, 128)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    dcfg = mk(1, 32, 2, 2, 64)
+    dparams = init_model_params(dcfg, jax.random.PRNGKey(1))
+    return {"cfg": cfg, "params": params,
+            "draft": DraftModel(dcfg, dparams)}
+
+
+def _engine(models, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq", 128)
+    return ContinuousBatchingEngine(models["cfg"], models["params"], None,
+                                    **kw)
+
+
+def _mixed_jobs(n_new=10):
+    """Short prompts (instant decode), long prompts (multi-chunk
+    prefill), a shared prefix (cache/COW traffic), and sampled rows."""
+    shared = [2 + (i * 7) % 60 for i in range(48)]  # 3 full pages @ 16
+    jobs = []
+    for i in range(3):
+        jobs.append(([5 + i, 9, 2 + i], n_new,
+                     dict(top_k=1, termination_id=10 ** 9)))
+    for i in range(2):
+        tail = [3 + (i * 11 + j) % 60 for j in range(60 + 13 * i)]
+        jobs.append((shared + tail[:128 - len(shared) - n_new], n_new,
+                     dict(top_k=1, termination_id=10 ** 9)))
+    jobs.append((list(shared), 8, dict(top_k=1, termination_id=10 ** 9)))
+    for i in range(2):
+        p = [3 + (i * 5 + j) % 60 for j in range(40 + 11 * i)]
+        jobs.append((p, n_new, dict(temperature=0.9, top_k=7,
+                                    seed=42 + i, termination_id=10 ** 9)))
+    return jobs
+
+
+def _run(eng, jobs):
+    reqs = [eng.submit(p, n, **kw) for p, n, kw in jobs]
+    eng.run_until_idle()
+    return [r.result(timeout=120) for r in reqs]
+
+
+def _assert_bitwise(a, b):
+    assert len(a) == len(b)
+    for (t0, l0), (t1, l1) in zip(a, b):
+        assert t0 == t1, "ragged tokens diverged from legacy"
+        assert l0 == l1, "ragged log-prob bits diverged from legacy"
+
+
+# ---------------------------------------------------------------------------
+# 1. bitwise parity matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cache", [True, False])
+def test_parity_mixed(models, cache):
+    legacy = _run(_engine(models, ragged=False, prefix_cache=cache),
+                  _mixed_jobs())
+    ragged = _run(_engine(models, ragged=True, prefix_cache=cache),
+                  _mixed_jobs())
+    _assert_bitwise(legacy, ragged)
+
+
+def test_parity_decode_only(models):
+    jobs = [([5, 9, 2 + i], 16, dict(top_k=1, termination_id=10 ** 9))
+            for i in range(4)]
+    _assert_bitwise(_run(_engine(models, ragged=False), jobs),
+                    _run(_engine(models, ragged=True), jobs))
+
+
+def test_parity_prefill_heavy(models):
+    # prompts far longer than a chunk: most ticks are prefill-dominated
+    jobs = [([2 + (i * 7 + j) % 60 for j in range(110 + 5 * i)], 6,
+             dict(top_k=1, termination_id=10 ** 9)) for i in range(3)]
+    _assert_bitwise(_run(_engine(models, ragged=False), jobs),
+                    _run(_engine(models, ragged=True), jobs))
+
+
+@pytest.mark.parametrize("cache", [True, False])
+def test_parity_spec(models, cache):
+    kw = dict(spec_k=3, spec_draft=models["draft"], spec_adaptive=False,
+              prefix_cache=cache)
+    legacy = _run(_engine(models, ragged=False, **kw), _mixed_jobs())
+    ragged = _run(_engine(models, ragged=True, **kw), _mixed_jobs())
+    _assert_bitwise(legacy, ragged)
+
+
+def test_parity_spec_vs_nonspec_through_ragged(models):
+    """The PR 9 losslessness contract survives the ragged rebuild:
+    greedy spec rows through the ragged tick == plain ragged decode."""
+    jobs = [j for j in _mixed_jobs() if "temperature" not in j[2]]
+    plain = _run(_engine(models, ragged=True), jobs)
+    spec = _run(_engine(models, ragged=True, spec_k=3,
+                        spec_draft=models["draft"], spec_adaptive=False),
+                jobs)
+    _assert_bitwise(plain, spec)
+
+
+def test_parity_preemption_resume(models):
+    """A mid-decode preemption + trie resume under the ragged tick is
+    bitwise the legacy path's resume (and the uninterrupted stream)."""
+    def run(ragged, preempt_at):
+        eng = _engine(models, ragged=ragged, sched_policy="fcfs")
+        long = [2 + (j * 7) % 60 for j in range(48)]
+        req = eng.submit(long, 14, top_k=1, termination_id=10 ** 9)
+        other = eng.submit([5, 9, 2], 6, top_k=1, termination_id=10 ** 9)
+        steps = 0
+        while not req.finished:
+            eng.step()
+            steps += 1
+            if steps == preempt_at and req._phase == "decode":
+                assert eng.preempt(req)
+        eng.run_until_idle()
+        return [req.result(timeout=120), other.result(timeout=120)]
+
+    base = run(True, 10 ** 9)   # never preempted
+    for cut in (3, 6):
+        _assert_bitwise(base, run(True, cut))
+        _assert_bitwise(run(False, cut), run(True, cut))
+
+
+def test_parity_tp4_token_identity(models, eight_devices):
+    from megatron_llm_tpu.core import parallel_state as ps
+    from megatron_llm_tpu.models import init_model_params, make_config
+
+    # tp=4 needs kv heads % 4 == 0 — a 4-kv-head sibling of the toy model
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=256,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    tpm = {"cfg": cfg, "params": params}
+
+    jobs = _mixed_jobs(n_new=6)[:4]
+    base = _run(_engine(tpm, ragged=True), jobs)
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=eight_devices[:4])
+    tp = _run(_engine(tpm, ragged=True, mesh=mesh), jobs)
+    for (t0, l0), (t1, l1) in zip(base, tp):
+        assert t0 == t1  # tokens bitwise across tp
+        np.testing.assert_allclose(l0, l1, atol=1e-5)
+
+
+def test_parity_return_log_probs(models):
+    """return_log_probs prompts take the legacy teacher-forced chunk
+    carve-out in ragged mode: prompt AND generation log-probs bitwise."""
+    jobs = [([2 + (j * 7) % 60 for j in range(40)], 8,
+             dict(top_k=1, termination_id=10 ** 9, return_log_probs=True)),
+            ([5, 9, 2], 8, dict(top_k=1, termination_id=10 ** 9))]
+
+    def run(ragged):
+        eng = _engine(models, ragged=ragged)
+        reqs = [eng.submit(p, n, **kw) for p, n, kw in jobs]
+        eng.run_until_idle()
+        return [(r.result(timeout=120), r.prompt_log_probs) for r in reqs]
+
+    legacy, ragged = run(False), run(True)
+    for ((t0, l0), p0), ((t1, l1), p1) in zip(legacy, ragged):
+        assert t0 == t1 and l0 == l1
+        assert p0 == p1  # teacher-forced prompt scores bitwise too
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. single launch per mixed tick; no recompiles across compositions
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_tick_single_launch_and_span(models):
+    """A tick carrying decode slots + a prefill chunk + spec-verify
+    blocks is ONE launch — counter And trace span agree."""
+    from megatron_llm_tpu.observability import trace as obs_trace
+
+    old = obs_trace.get_tracer()
+    tracer = obs_trace.configure(capacity=4096)
+    try:
+        eng = _engine(models, ragged=True, spec_k=2,
+                      spec_draft=models["draft"], spec_adaptive=False)
+        # saturate decode first
+        short = [eng.submit([5 + i, 9, 2], 24, top_k=1,
+                            termination_id=10 ** 9) for i in range(3)]
+        for _ in range(4):
+            eng.step()
+        # now a long prompt arrives: the next steps mix prefill + decode
+        long = eng.submit([2 + (j * 7) % 60 for j in range(90)], 4,
+                          top_k=1, termination_id=10 ** 9)
+        mixed_seen = False
+        for _ in range(4):
+            eng.step()
+            decoding = sum(r is not None and r._phase == "decode"
+                           for r in eng._slots)
+            if long._phase == "prefill" and decoding:
+                mixed_seen = True
+                assert eng.last_tick_launches == 1, (
+                    "mixed prefill+decode+spec tick dispatched more than "
+                    "one attention program")
+        assert mixed_seen, "workload never produced a mixed tick"
+        eng.run_until_idle()
+        for r in short + [long]:
+            r.result(timeout=120)
+    finally:
+        obs_trace._TRACER = old
+
+    # events are (ph, name, ts, dur, ident, args) tuples
+    spans = [e for e in tracer.snapshot()
+             if e[1] == "engine-ragged-tick"]
+    assert spans, "no engine-ragged-tick spans recorded"
+    mixed = [e for e in spans
+             if (e[5] or {}).get("prefill_tokens", 0) > 0
+             and (e[5] or {}).get("active", 0) > 0]
+    assert mixed, "no mixed tick span recorded in traces"
+    assert all((e[5] or {}).get("launches") == 1 for e in spans), (
+        "a ragged-tick span claimed more than one launch")
+
+
+def test_legacy_mixed_tick_multi_launch(models):
+    """The counter is honest: the legacy split path really does dispatch
+    more than one program on a mixed tick (the thing ragged removes)."""
+    eng = _engine(models, ragged=False)
+    short = [eng.submit([5 + i, 9, 2], 24, top_k=1,
+                        termination_id=10 ** 9) for i in range(3)]
+    for _ in range(3):
+        eng.step()
+    long = eng.submit([2 + (j * 7) % 60 for j in range(90)], 4,
+                      top_k=1, termination_id=10 ** 9)
+    seen = 0
+    for _ in range(4):
+        eng.step()
+        if long._phase == "prefill":
+            seen = max(seen, eng.last_tick_launches)
+    assert seen >= 2, "legacy mixed tick should be >= 2 launches"
+    eng.run_until_idle()
+    for r in short + [long]:
+        r.result(timeout=120)
+
+
+def test_composition_changes_reuse_bounded_executables(models):
+    """The recompile-hazard gate: all-decode, mixed, multi-request
+    prefill, spec depths, drained — every composition re-dispatches a
+    BOUNDED executable set (one per bucketed live-prefill-row count, at
+    most 1 + prefill_rows/prefill_chunk) and none of them ever
+    re-traces: span/horizon/block-table metadata is data-carried, never
+    static."""
+    eng = _engine(models, ragged=True, spec_k=2,
+                  spec_draft=models["draft"], spec_adaptive=False)
+    _run(eng, _mixed_jobs())            # mixed compositions
+    _run(eng, _mixed_jobs(n_new=4)[:2])  # different mix
+    bound = 1 + eng.prefill_rows // eng.prefill_chunk
+    assert eng._ragged_fns, "ragged tick never compiled"
+    assert len(eng._ragged_fns) <= bound, (
+        "tick-composition changes grew the executable set past the "
+        "shape bound")
+    for fn in eng._ragged_fns.values():
+        assert fn._cache_size() == 1, (
+            "a ragged executable re-traced on a composition change")
+
+    eng2 = _engine(models, ragged=True)
+    _run(eng2, _mixed_jobs())
+    assert len(eng2._ragged_fns) <= 1 + (eng2.prefill_rows
+                                         // eng2.prefill_chunk)
+    for fn in eng2._ragged_fns.values():
+        assert fn._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. token-level prefill budget
+# ---------------------------------------------------------------------------
+
+
+class _TokenBudget(SchedulerPolicy):
+    name = "_token_budget_test"
+    barrier_admission = True
+
+    def __init__(self, tokens, **kw):
+        super().__init__(**kw)
+        self.tokens = tokens
+
+    def prefill_budget(self, prefilling, state):
+        return self.tokens
+
+
+def test_budget_admits_multiple_chunks_multiple_requests(models):
+    """ISSUE 11 regression: prefill_budget is TOKENS — a 192-token budget
+    packs 3 chunks spanning TWO requests into one tick."""
+    eng = _engine(models, max_seq=256, ragged=True,
+                  sched_policy=_TokenBudget(192), prefill_budget=192)
+    r1 = eng.submit([2 + (j % 60) for j in range(150)], 4,
+                    top_k=1, termination_id=10 ** 9)
+    r2 = eng.submit([3 + (j % 60) for j in range(100)], 4,
+                    top_k=1, termination_id=10 ** 9)
+    eng.step()
+    # r1's bucketed prompt (160) fills entirely; r2 gets the rest (32)
+    assert r1._fill_pos == 160 and r2._fill_pos == 32, (
+        r1._fill_pos, r2._fill_pos)
+    assert eng.last_tick_launches == 1
+    eng.run_until_idle()
+    got = [r1.result(timeout=60), r2.result(timeout=60)]
+    # aggressive packing is still bitwise the default pacing
+    base = _run(_engine(models, max_seq=256, ragged=True),
+                [([2 + (j % 60) for j in range(150)], 4,
+                  dict(top_k=1, termination_id=10 ** 9)),
+                 ([3 + (j % 60) for j in range(100)], 4,
+                  dict(top_k=1, termination_id=10 ** 9))])
+    _assert_bitwise(base, got)
+
+
+def test_budget_validated_as_tokens(models):
+    """Negative or non-int budgets are policy bugs and raise."""
+    eng = _engine(models, ragged=True, sched_policy=_TokenBudget(-1))
+    eng.submit([2 + (j % 60) for j in range(80)], 2,
+               top_k=1, termination_id=10 ** 9)
+    with pytest.raises(ValueError, match="TOKENS"):
+        eng.step()
+    eng2 = _engine(models, ragged=True, sched_policy=_TokenBudget(2.5))
+    eng2.submit([2 + (j % 60) for j in range(80)], 2,
+                top_k=1, termination_id=10 ** 9)
+    with pytest.raises(ValueError, match="TOKENS"):
+        eng2.step()
+
+
+def test_budget_floor_keeps_prefill_alive(models):
+    """A zero budget still advances one chunk per tick (liveness — the
+    legacy `max(1, ...)` guarantee, now in token units)."""
+    eng = _engine(models, ragged=True, sched_policy=_TokenBudget(0))
+    req = eng.submit([2 + (j % 60) for j in range(80)], 2,
+                     top_k=1, termination_id=10 ** 9)
+    eng.run_until_idle()
+    req.result(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# 5. telemetry surface
+# ---------------------------------------------------------------------------
+
+
+def test_launch_metrics_on_scrape(models):
+    from megatron_llm_tpu.observability import registry as obs_registry
+
+    reg = obs_registry.get_registry()
+    before = reg.counter("mlt_engine_tick_launches_total").value
+    eng = _engine(models, ragged=True)
+    _run(eng, _mixed_jobs(n_new=4)[:3])
+    text = reg.render()
+    assert "mlt_engine_tick_launches_total" in text
+    assert "mlt_engine_prefill_tokens_per_tick" in text
+    assert reg.counter("mlt_engine_tick_launches_total").value > before
+    # ragged mode: launches == non-idle ticks
+    assert eng.tick_launches == eng.ticks, (eng.tick_launches, eng.ticks)

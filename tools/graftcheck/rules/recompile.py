@@ -16,6 +16,11 @@ CONTRACT).  Four statically visible ways to break that:
   literal — the first defaulted call raises ``TypeError: unhashable``.
 * ``jax.jit``/``cached_jit`` invoked inside a loop — re-traces (or at
   minimum re-hashes and re-dispatches) per iteration; hoist it out.
+* RAGGED-GRID metadata in a ``cached_jit`` statics key (ISSUE 11): the
+  engine's ragged tick carries per-row (query-span, kv-horizon) batch
+  composition as TRACED operands by contract — spans/horizons/k_eff in
+  the statics tuple would compile one executable per tick composition,
+  the exact dispatch explosion the ragged kernel exists to remove.
 """
 
 from __future__ import annotations
@@ -27,6 +32,10 @@ from tools.graftcheck.core import FileContext, Finding, Rule, qualname
 
 _JIT_NAMES = {"jax.jit", "jit"}
 _PARTIAL_NAMES = {"partial", "functools.partial"}
+# identifiers that name per-tick ragged batch composition (data-carried by
+# contract — generation/ragged.py); matched as whole dotted-name segments
+_RAGGED_META = {"span", "spans", "horizon", "horizons", "k_eff",
+                "row_meta"}
 _UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
                ast.SetComp)
 _FRESH_IDENTITY = _UNHASHABLE + (ast.Lambda,)
@@ -199,6 +208,40 @@ class RecompileHazardRule(Rule):
                     "cached_jit bug); key on content "
                     "(generation.config_fingerprint)")
 
+    # ---- (e) ragged-grid metadata in cached_jit statics ----
+
+    def _check_ragged_statics(self, ctx: FileContext) -> Iterable[Finding]:
+        """Flag per-tick ragged metadata (spans / horizons / k_eff)
+        reaching the STATICS tuple of a ``cached_jit`` call — statics are
+        compile-cache keys, so every tick composition would compile a new
+        executable.  Ragged batch composition must be a traced operand."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or not (qualname(node.func) or "").endswith("cached_jit"):
+                continue
+            if len(node.args) < 3:
+                continue
+            statics = node.args[2]
+            for sub in ast.walk(statics):
+                name = None
+                if isinstance(sub, ast.Name):
+                    name = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    name = sub.attr
+                if name is None:
+                    continue
+                segs = set(name.lower().split("_")) | {name.lower()}
+                hit = segs & _RAGGED_META
+                if hit:
+                    yield self.finding(
+                        ctx, sub,
+                        f"ragged-grid metadata '{name}' in a cached_jit "
+                        f"statics key — per-tick (span, horizon) batch "
+                        f"composition must be a traced operand, or every "
+                        f"tick mix compiles its own executable "
+                        f"(generation/ragged.py contract)")
+                    break
+
     # ---- (d) jit inside a loop ----
 
     def _check_jit_in_loop(self, ctx: FileContext) -> Iterable[Finding]:
@@ -238,6 +281,7 @@ class RecompileHazardRule(Rule):
             if isinstance(node, ast.FunctionDef):
                 yield from emit(self._check_decorated(ctx, node))
         yield from emit(self._check_static_callsites(ctx))
+        yield from emit(self._check_ragged_statics(ctx))
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield from emit(self._check_id_keyed(ctx, node))

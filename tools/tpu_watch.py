@@ -159,6 +159,13 @@ JOBS = [
     ("bench_decode_router",
      [sys.executable, "bench_decode.py", "--mode", "router"],
      False, _bench_on_tpu),
+    # ISSUE 11: ragged paged attention — mixed prefill+decode+spec traffic
+    # through the single-launch ragged tick vs the legacy split dispatch:
+    # launches per tick, long-prompt TTFT, decode tok/s, lossless-token
+    # assert (bench_decode.py --mode mixed, engine_decode_mixed evidence)
+    ("bench_decode_mixed",
+     [sys.executable, "bench_decode.py", "--mode", "mixed"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
