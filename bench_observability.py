@@ -130,8 +130,11 @@ def measure_instrument_cost(steps: int = 2000,
 
     Replays exactly what one driver iteration records — the step mark,
     the data-wait/dispatch/metric-drain spans, the timer stop mirrors and
-    driver gauges, the profiler-trigger checks, and the amortized
-    every-10-steps window dump — and times it in isolation.  This is the
+    driver gauges, the profiler-trigger checks, the amortized
+    every-10-steps window dump, AND one full flight-recorder request
+    lifecycle (ISSUE 12: open, enqueue, admit/decode phase transitions,
+    first token, finish, close — what one served request bills the
+    engine's scheduler thread) — and times it in isolation.  This is the
     deterministic companion to the wall-clock A/B above: steps/sec pairs
     are the honest end-to-end number but ride a noisy host, while this
     isolates the instrument bill itself (tests gate on cost vs measured
@@ -141,6 +144,7 @@ def measure_instrument_cost(steps: int = 2000,
 
     from megatron_llm_tpu.observability import registry as registry_mod
     from megatron_llm_tpu.observability import trace as trace_mod
+    from megatron_llm_tpu.observability.flight import FlightRecorder
     from megatron_llm_tpu.observability.profiler import ProfileTrigger
     from megatron_llm_tpu.utils.timers import Timers
 
@@ -150,6 +154,7 @@ def measure_instrument_cost(steps: int = 2000,
     tracer = trace_mod.configure(capacity=65536)
     registry_mod.set_publishing(True)
     timers = Timers(1)
+    flight = FlightRecorder(capacity=256, events_per_request=64)
     trigger = ProfileTrigger(trace_dir, start_fn=lambda d: None,
                              stop_fn=lambda: None)
     try:
@@ -170,6 +175,13 @@ def measure_instrument_cost(steps: int = 2000,
                 pass
             timers("train-step").stop()
             trigger.step_done()
+            rec = flight.open(f"cost-{i}", prompt_tokens=64)
+            rec.event("enqueue", queued=1)
+            rec.set_phase("prefill", kind="admit", slot=0, hit_tokens=0)
+            rec.set_phase("decode", pos=63)
+            rec.mark_first_token()
+            rec.finish("ok", tokens=16)
+            flight.close(rec)
             if i % 10 == 9:  # the driver's N-step window dump, amortized
                 tracer.dump(os.path.join(trace_dir, "w.json"))
         cost_us = (_time.perf_counter() - t0) / steps * 1e6

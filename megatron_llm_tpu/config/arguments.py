@@ -457,6 +457,13 @@ class InferenceConfig:
     # the SchedulerPolicy's token-level prefill_budget is capped by it.
     ragged_tick: bool = True
     prefill_budget: int = 0
+    # per-request flight recorder (observability/flight.py, ISSUE 12):
+    # --flight_records bounds how many retired request records the
+    # engine keeps for /debug/requests and the watchdog's emergency dump
+    # (0 disables recording entirely); --flight_events bounds each
+    # record's event log (oldest events drop, with an honest count)
+    flight_records: int = 256
+    flight_events: int = 64
 
 
 @dataclass

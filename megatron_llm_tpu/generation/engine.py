@@ -113,6 +113,7 @@ from megatron_llm_tpu.generation.scheduling import (
     SchedulerState,
     get_policy,
 )
+from megatron_llm_tpu.observability import flight as obs_flight
 from megatron_llm_tpu.observability import registry as obs_registry
 from megatron_llm_tpu.observability import trace as obs_trace
 from megatron_llm_tpu.generation.tokenization import detokenize_generations
@@ -395,6 +396,10 @@ class EngineRequest:
     priority: int = 1
     ttft_deadline_ms: Optional[float] = None
     tpot_deadline_ms: Optional[float] = None
+    # distributed tracing (ISSUE 12): the X-MLT-Trace-Id the router or
+    # caller minted; correlates this request across router spans,
+    # replica spans and flight records ("" = untraced direct submit)
+    trace_id: str = ""
 
     # engine-filled state
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -425,6 +430,10 @@ class EngineRequest:
     # speculative decoding: acceptance EMA drives the per-slot adaptive
     # depth (starts optimistic; shrinks when the draft keeps missing)
     _spec_ema: float = dataclasses.field(default=1.0, repr=False)
+    # flight record (observability/flight.py); the shared null record
+    # when the recorder is disabled, so every call site stays branch-free
+    _flight: object = dataclasses.field(
+        default=obs_flight.NULL_RECORD, repr=False)
 
     def result(self, timeout: Optional[float] = None):
         """Wait for completion; returns (full token list, gen log-probs)."""
@@ -476,6 +485,8 @@ class ContinuousBatchingEngine:
                  spec_adaptive: Optional[bool] = None,
                  ragged: Optional[bool] = None,
                  prefill_budget: Optional[int] = None,
+                 flight_records: Optional[int] = None,
+                 flight_events: Optional[int] = None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -698,6 +709,21 @@ class ContinuousBatchingEngine:
         # inter-retire EMA — guarded by _lock
         self._ema_retire_s: Optional[float] = None
         self._last_retire_t: Optional[float] = None  # guarded by _lock
+        # submit-to-first-token EMA: the replica's REAL first-token time
+        # (published in /health so the router's slo_aware predictions use
+        # measured TTFT, not time-to-response) — guarded by _lock
+        self._ema_ttft_s: Optional[float] = None
+        # flight recorder (ISSUE 12, observability/flight.py): one
+        # bounded event log + latency decomposition per request, served
+        # on /debug/requests and dumped by the watchdog.  0 records
+        # disables it (every call site degrades to the null record).
+        n_rec = (flight_records if flight_records is not None
+                 else getattr(inf, "flight_records", 256))
+        n_ev = (flight_events if flight_events is not None
+                else getattr(inf, "flight_events", 64))
+        self.flight = obs_flight.FlightRecorder(
+            capacity=n_rec, events_per_request=n_ev, enabled=n_rec > 0)
+        obs_flight.set_recorder(self.flight)
         # label sets ever published — guarded by _lock
         self._queued_prios: Set[int] = set()
         # registry instruments, resolved once (observability/registry.py):
@@ -758,6 +784,22 @@ class ContinuousBatchingEngine:
             "mlt_engine_deadline_miss_total",
             help="retired requests that missed a declared deadline",
             labels={"kind": "tpot"})
+        # honest TTFT decomposition (ISSUE 12): where retired requests'
+        # first-token latency actually went.  The phase-attributed
+        # deadline-miss children ({kind,phase}) are created lazily at
+        # miss time; the {kind}-only children above stay the totals.
+        self._m_queue_wait = reg.histogram(
+            "mlt_engine_queue_wait_seconds",
+            help="submit-to-admission wait of retired requests (flight-"
+                 "recorder queued-phase bucket)")
+        self._m_prefill_compute = reg.histogram(
+            "mlt_engine_prefill_compute_seconds",
+            help="prefill-phase seconds of retired requests (admission "
+                 "to decode activation)")
+        self._m_preempted_s = reg.histogram(
+            "mlt_engine_preempted_seconds",
+            help="seconds retired requests spent preempted (observed "
+                 "only for requests that were preempted at least once)")
         # speculative-decoding instruments, registered only when the spec
         # path can run (mlt_engine_spec_* stays absent from scrapes of
         # non-speculating engines)
@@ -1079,9 +1121,20 @@ class ContinuousBatchingEngine:
                 "Length of prompt + tokens_to_generate longer than allowed")
         req = EngineRequest(prompt=prompt, max_new_tokens=max_new_tokens, **kw)
         req._t_submit = time.monotonic()
-        with obs_trace.span("engine-enqueue", prompt_len=len(prompt)):
+        # flight record + enqueue event (observability/flight.py): a
+        # request turned away at the door still leaves a record, so an
+        # overload burst is reconstructable from /debug/requests
+        req._flight = self.flight.open(
+            req.trace_id, prompt_tokens=len(prompt),
+            max_new_tokens=max_new_tokens, priority=req.priority,
+            t_submit=req._t_submit)
+        with obs_trace.span("engine-enqueue", prompt_len=len(prompt),
+                            trace_id=req.trace_id):
             with self._work:
                 if self.max_queue and len(self._queue) >= self.max_queue:
+                    req._flight.finish("overload",
+                                       queued=len(self._queue))
+                    self.flight.close(req._flight)
                     raise EngineOverloaded(
                         f"request queue full ({self.max_queue} waiting)",
                         retry_after=self._drain_eta(len(self._queue)),
@@ -1091,6 +1144,9 @@ class ContinuousBatchingEngine:
                     depth = sum(1 for r in self._queue
                                 if r.priority == req.priority)
                     if depth >= quota:
+                        req._flight.finish("overload", queued=depth,
+                                           quota=quota)
+                        self.flight.close(req._flight)
                         raise EngineOverloaded(
                             f"priority-{req.priority} queue full "
                             f"({quota} waiting)",
@@ -1099,6 +1155,7 @@ class ContinuousBatchingEngine:
                 self._seqno += 1
                 req._seqno = self._seqno
                 self._queue.append(req)
+                req._flight.event("enqueue", queued=len(self._queue))
                 if obs_registry.publishing():
                     self._m_requests.inc()
                 self._publish_queued_locked()
@@ -1119,6 +1176,12 @@ class ContinuousBatchingEngine:
     def _overload_info(self) -> dict:  # holds _lock
         return {"queued": len(self._queue), "policy": self.policy.name,
                 "active_slots": sum(r is not None for r in self._slots)}
+
+    def _note_ttft_locked(self, ttft_s: float) -> None:  # holds _lock
+        """Feed the real first-token EMA (published in /health; the
+        router's slo_aware wait predictions consume it)."""
+        self._ema_ttft_s = (ttft_s if self._ema_ttft_s is None
+                            else 0.7 * self._ema_ttft_s + 0.3 * ttft_s)
 
     def _publish_queued_locked(self, force: bool = False) -> None:  # holds _lock
         """THE queue-depth gauge update point (total + per-priority
@@ -1154,6 +1217,7 @@ class ContinuousBatchingEngine:
             queue_depth=len(self._queue),
             can_preempt=bool(self.prefill_chunk),
             prefill_chunk=self.prefill_chunk,
+            ttft_ema_s=self._ema_ttft_s,
         )
 
     def _admit(self) -> None:
@@ -1262,6 +1326,9 @@ class ContinuousBatchingEngine:
         victim._slot = -1
         victim._fill_pos = 0
         victim._preemptions += 1
+        victim._flight.note_preemption()
+        victim._flight.set_phase("preempted", step=victim._step,
+                                 pages_released=len(pages))
         self.preemptions += 1
         self._queue.append(victim)  # position is policy-ordered anyway
         if obs_registry.publishing():
@@ -1278,6 +1345,8 @@ class ContinuousBatchingEngine:
         req._phase = "finished"
         req.error = f"request shed: {reason}"
         req.finished = True
+        req._flight.finish("shed", reason=reason)
+        self.flight.close(req._flight)
         self.shed_requests += 1
         if obs_registry.publishing():
             self._m_shed.inc()
@@ -1312,6 +1381,10 @@ class ContinuousBatchingEngine:
                                 else round(self._ema_tick_s * 1e3, 3)),
                 "ema_retire_ms": (None if self._ema_retire_s is None
                                   else round(self._ema_retire_s * 1e3, 3)),
+                # measured submit-to-first-token EMA (ISSUE 12): the
+                # honest TTFT signal the router's wait predictions use
+                "ttft_ema_ms": (None if self._ema_ttft_s is None
+                                else round(self._ema_ttft_s * 1e3, 3)),
                 "retry_after_s": round(self._drain_eta(len(self._queue)), 3),
             }
 
@@ -1366,6 +1439,10 @@ class ContinuousBatchingEngine:
         self._slots[slot] = req
         self.prefix_hit_tokens += covered
         self.prefix_miss_tokens += prompt_len - covered
+        req._flight.note_hit_tokens(covered)
+        req._flight.set_phase(
+            "prefill", kind="resume" if req._preemptions else "admit",
+            slot=slot, hit_tokens=covered, pages=len(req._pages))
         if obs_registry.publishing():
             self._m_hit_tokens.inc(covered)
             self._m_miss_tokens.inc(prompt_len - covered)
@@ -1416,6 +1493,8 @@ class ContinuousBatchingEngine:
         req._max_pages = len(pages)
         req._slot = slot
         self._slots[slot] = req
+        req._flight.set_phase("prefill", kind="admit", slot=slot,
+                              pages=len(pages))
         return {"pages": pages}
 
     def _place_monolithic(self, req: EngineRequest) -> None:
@@ -1478,6 +1557,7 @@ class ContinuousBatchingEngine:
         self._keys[slot] = req._key
         self._steps[slot] = req._step
         req._phase = "decode"
+        req._flight.set_phase("decode", pos=len(seq) - 1)
         self._dirty = True
 
     def _fail(self, req: EngineRequest, e: Exception) -> None:
@@ -1497,6 +1577,8 @@ class ContinuousBatchingEngine:
         req._phase = "finished"
         req.error = f"{type(e).__name__}: {e}"
         req.finished = True
+        req._flight.finish("error", error=req.error)
+        self.flight.close(req._flight)
         req._done.set()
 
     def _retire(self, slot: int) -> None:  # holds _lock
@@ -1524,21 +1606,43 @@ class ContinuousBatchingEngine:
                                   else 0.7 * self._ema_retire_s + 0.3 * dt)
         self._last_retire_t = now
         req._t_done = now
+        rec = req._flight
+        rec.finish("ok", now=now, tokens=len(req.generated))
+        self.flight.close(rec)
         ttft = req.ttft
         missed = False
+        publishing = obs_registry.publishing()
+        if rec.enabled and publishing:
+            # honest TTFT/latency decomposition (ISSUE 12): the flight
+            # record's phase buckets sum to the measured latency, so
+            # these histograms attribute it instead of re-measuring it
+            d = rec.to_dict()["decomposition"]
+            self._m_queue_wait.observe(d["queue_wait_s"])
+            self._m_prefill_compute.observe(d["prefill_s"])
+            if req._preemptions:
+                self._m_preempted_s.observe(d["preempted_s"])
         if ttft is not None:
-            if obs_registry.publishing():
+            if publishing:
                 self._m_ttft.observe(ttft)
             if (req.ttft_deadline_ms is not None
                     and ttft > req.ttft_deadline_ms / 1e3):
                 missed = True
-                if obs_registry.publishing():
+                if publishing:
                     self._m_miss_ttft.inc()
+                    if rec.enabled:
+                        # attribution: blame the phase that ate the
+                        # largest TTFT share ({kind}-only stays total)
+                        obs_registry.get_registry().counter(
+                            "mlt_engine_deadline_miss_total",
+                            help="retired requests that missed a "
+                                 "declared deadline",
+                            labels={"kind": "ttft",
+                                    "phase": rec.miss_phase()}).inc()
             if (req.tpot_deadline_ms is not None and req._step > 1
                     and ((now - req._t_first) / (req._step - 1)
                          > req.tpot_deadline_ms / 1e3)):
                 missed = True
-                if obs_registry.publishing():
+                if publishing:
                     self._m_miss_tpot.inc()
         if missed:
             self.deadline_misses += 1
@@ -1589,6 +1693,8 @@ class ContinuousBatchingEngine:
                     break
             if req._step == 0:
                 req._t_first = now
+                req._flight.mark_first_token(now)
+                self._note_ttft_locked(now - req._t_submit)
             req._step += took
             self._positions[i] += took
             self._tokens[i] = int(emit_np[i, took - 1])
@@ -1600,6 +1706,9 @@ class ContinuousBatchingEngine:
                 self.spec_draft_tokens += k_i
                 self.spec_accepted_tokens += a_i
                 req._spec_ema = 0.7 * req._spec_ema + 0.3 * (a_i / k_i)
+                req._flight.add_spec(k_i, a_i)
+                req._flight.event("spec_tick", k=k_i, accepted=a_i,
+                                  emitted=took)
                 if publishing:
                     self._m_spec_draft.inc(k_i)
                     self._m_spec_accepted.inc(a_i)
@@ -1627,6 +1736,8 @@ class ContinuousBatchingEngine:
             req._step += 1
             if req._step == 1:
                 req._t_first = now
+                req._flight.mark_first_token(now)
+                self._note_ttft_locked(now - req._t_submit)
             self._positions[i] += 1
             self._tokens[i] = tok
             self._steps[i] += 1
@@ -1710,9 +1821,11 @@ class ContinuousBatchingEngine:
             if req.return_log_probs and n_lp:
                 targets[0, :n_lp] = seq[start + 1:start + 1 + n_lp]
 
+        t_chunk = time.monotonic()
         try:
             with obs_trace.span("engine-prefill-chunk", start=start,
-                                rows=rows, tp=self._tp):
+                                rows=rows, tp=self._tp,
+                                trace_id=req.trace_id):
                 if self.spec_k:
                     out = self._chunk_prefill(rows, kv_pages,
                                               req.return_log_probs)(
@@ -1747,6 +1860,9 @@ class ContinuousBatchingEngine:
         with self._lock:
             req._fill_pos = end
             self.prefill_tokens_computed += rows
+            req._flight.event("prefill_chunk", start=start, end=end,
+                              rows=rows, fill_end=fill_end)
+            req._flight.add_prefill_compute(time.monotonic() - t_chunk)
             if obs_registry.publishing():
                 self._m_prefill_tokens.inc(rows)
             if end >= fill_end:
@@ -2027,12 +2143,17 @@ class ContinuousBatchingEngine:
         return (spans, pre_tok, pre_pos, pre_tables, pre_index,
                 pre_hor, lp_live)
 
-    def _apply_ragged_prefill_locked(self, spans) -> None:  # holds _lock
+    def _apply_ragged_prefill_locked(self, spans, tick_s: float = 0.0,
+                                     work_rows: int = 0
+                                     ) -> None:  # holds _lock
         """Advance the packed requests' fill frontiers; a request whose
         bucketed prompt completed inserts its full pages into the prefix
         trie (refeed page excluded — shared pages immutable from birth)
         and activates into decode, exactly like _advance_prefill's
-        completion tail."""
+        completion tail.  ``tick_s``/``work_rows`` attribute the fused
+        launch's wall time to each request's flight record
+        proportionally to its rows — an estimate by construction (the
+        launch is ONE program), documented as such."""
         ps = self.page_size
         for req, start, end in spans:
             if req._phase != "prefill":  # failed mid-step (defensive)
@@ -2040,6 +2161,11 @@ class ContinuousBatchingEngine:
             req._fill_pos = end
             rows = end - start
             self.prefill_tokens_computed += rows
+            req._flight.event("prefill_chunk", start=start, end=end,
+                              rows=rows,
+                              fill_end=_bucket_up(len(req.seq_tokens), ps))
+            if work_rows > 0:
+                req._flight.add_prefill_compute(tick_s * rows / work_rows)
             if obs_registry.publishing():
                 self._m_prefill_tokens.inc(rows)
             seq = req.seq_tokens
@@ -2138,7 +2264,8 @@ class ContinuousBatchingEngine:
             else:
                 emitted = self._apply_plain_locked(
                     active, next_np, logp_np, now)
-            self._apply_ragged_prefill_locked(spans)
+            self._apply_ragged_prefill_locked(
+                spans, tick_s=dt, work_rows=n_pre + len(active))
             self.ticked_tokens += emitted
             self._note_launches_locked(
                 1 + did_lp, self.prefill_tokens_computed - pre0)
@@ -2218,6 +2345,7 @@ class ContinuousBatchingEngine:
         priority: int = 1,
         ttft_deadline_ms: Optional[float] = None,
         tpot_deadline_ms: Optional[float] = None,
+        trace_id: str = "",
     ):
         """Drop-in for api.generate_and_post_process: tokenize, submit each
         prompt as its own request (all of them share decode ticks), wait,
@@ -2247,6 +2375,7 @@ class ContinuousBatchingEngine:
                 priority=priority,
                 ttft_deadline_ms=ttft_deadline_ms,
                 tpot_deadline_ms=tpot_deadline_ms,
+                trace_id=trace_id,
             ))
         if self._thread is None:
             self.run_until_idle()

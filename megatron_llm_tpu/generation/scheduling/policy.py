@@ -63,6 +63,12 @@ class SchedulerState:
     queue_depth: int
     can_preempt: bool                # chunked mode + policy allows it
     prefill_chunk: int = 0           # engine chunk size in tokens (0 = off)
+    # measured submit-to-first-token EMA (ISSUE 12, flight-recorder
+    # derived): the REAL first-token latency of recent requests —
+    # includes queue + prefill, unlike the tick/retire EMAs.  None until
+    # the first token ever lands.  Policies may use it to ground their
+    # wait predictions in observed TTFT rather than drain arithmetic.
+    ttft_ema_s: Optional[float] = None
 
     def drain_eta(self, depth: int) -> Optional[float]:
         """Predicted seconds until ``depth`` queued requests drain, from

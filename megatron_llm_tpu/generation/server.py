@@ -28,9 +28,11 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
+from urllib.parse import parse_qs
 
 from megatron_llm_tpu.generation.engine import EngineOverloaded
 from megatron_llm_tpu.generation.scheduling import RequestShed
+from megatron_llm_tpu.observability import trace as obs_trace
 
 _STATIC_DIR = Path(__file__).parent / "static"
 
@@ -170,8 +172,15 @@ class MegatronServer:
         self._health_seq = 0  # guarded by _seq_lock
         self._seq_lock = threading.Lock()
 
-    def handle_request(self, payload):
-        """Core PUT /api logic; returns (status_code, response dict)."""
+    def handle_request(self, payload, trace_id: str = ""):
+        """Core PUT /api logic; returns (status_code, response dict).
+
+        ``trace_id`` is the request's ``X-MLT-Trace-Id`` (minted by the
+        HTTP handler when the caller/router sent none); it threads into
+        the engine's flight record and spans, and 200 responses from
+        batching engines carry a ``timing`` block derived from the
+        flight record — the server-side first-token and latency
+        decomposition the router's honest TTFT metric reads."""
         if not isinstance(payload, dict):
             return 400, {"error": "request body must be a JSON object"}
         params, err = _validate(payload)
@@ -198,7 +207,8 @@ class MegatronServer:
                     # scheduling fields only exist on the batching engine
                     kw = dict(priority=params["priority"],
                               ttft_deadline_ms=params["ttft_deadline_ms"],
-                              tpot_deadline_ms=params["tpot_deadline_ms"])
+                              tpot_deadline_ms=params["tpot_deadline_ms"],
+                              trace_id=trace_id)
                 texts, segments, logprobs, _ = self.engine.generate_and_post_process(
                     params["prompts"],
                     tokens_to_generate=params["tokens_to_generate"],
@@ -212,8 +222,13 @@ class MegatronServer:
                     random_seed=params["random_seed"],
                     **kw,
                 )
-                return 200, {"text": texts, "segments": segments,
-                             "logprobs": logprobs}
+                body = {"text": texts, "segments": segments,
+                        "logprobs": logprobs}
+                if self.batching and trace_id:
+                    timing = self.request_timing(trace_id)
+                    if timing is not None:
+                        body["timing"] = timing
+                return 200, body
             except EngineOverloaded as eo:
                 # backpressure instead of unbounded queueing: structured
                 # 503 + machine-readable retry hint (the HTTP handler turns
@@ -258,32 +273,59 @@ class MegatronServer:
                     payload = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
                     return self._send(400, {"error": "invalid JSON"})
+                # distributed tracing (ISSUE 12): accept the caller's /
+                # router's trace id, mint one otherwise; every response
+                # echoes it so untraced callers can still correlate
+                trace_id = (self.headers.get("X-MLT-Trace-Id", "").strip()
+                            or uuid.uuid4().hex)
                 try:
-                    code, body = server.handle_request(payload)
+                    with obs_trace.span("serve-api", trace_id=trace_id):
+                        code, body = server.handle_request(
+                            payload, trace_id=trace_id)
                 except Exception as e:  # last-resort: still a JSON answer
                     code, body = 500, {
                         "error": f"internal error: {type(e).__name__}: {e}"}
                 if isinstance(body, str):  # legacy engines may return text
                     return self._send(code, body, "text/plain")
-                headers = None
+                headers = {"X-MLT-Trace-Id": trace_id}
                 if code == 503 and isinstance(body, dict) \
                         and "retry_after" in body:
-                    headers = {"Retry-After":
-                               str(max(1, int(body["retry_after"])))}
+                    headers["Retry-After"] = str(
+                        max(1, int(body["retry_after"])))
+                if code == 200 and isinstance(body, dict) \
+                        and body.get("timing", {}).get("ttft_s") is not None:
+                    # server-side first-token seconds as a header, so the
+                    # router's TTFT metric never has to parse the body
+                    headers["X-MLT-TTFT-S"] = str(body["timing"]["ttft_s"])
                 return self._send(code, body, headers=headers)
 
             do_POST = do_PUT  # convenience; reference is PUT-only
 
             def do_GET(self):
-                if self.path.rstrip("/") == "/health":
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/")
+                if path == "/health":
                     return self._send(200, server.health())
-                if self.path.split("?", 1)[0].rstrip("/") == "/metrics":
+                if path == "/metrics":
                     # Prometheus exposition (observability/registry.py),
                     # alongside /health on the same port — the serving
                     # analog of pretrain's --metrics_port endpoint
                     return self._send(
                         200, server.metrics_text(),
                         "text/plain; version=0.0.4; charset=utf-8")
+                if path == "/debug/requests":
+                    # recent flight records (observability/flight.py):
+                    # ?n= caps the count, ?trace_id= filters.  Schema:
+                    # docs/guide/observability.md "Request tracing"
+                    qs = parse_qs(query)
+                    try:
+                        n = int(qs["n"][0]) if "n" in qs else None
+                    except ValueError:
+                        return self._send(
+                            400, {"error": "n must be an integer"})
+                    tid = qs.get("trace_id", [None])[0]
+                    return self._send(
+                        200, server.debug_requests(n=n, trace_id=tid))
                 index = _STATIC_DIR / "index.html"
                 if self.path in ("/", "/index.html") and index.exists():
                     return self._send(200, index.read_text(), "text/html")
@@ -343,6 +385,48 @@ class MegatronServer:
                 # tokens per tick (generation/speculative/)
                 info["spec"] = eng.spec_stats()
         return info
+
+    def request_timing(self, trace_id: str) -> Optional[dict]:
+        """Server-side timing block for a 200 response, read from the
+        engine's flight records for ``trace_id`` (one per prompt in the
+        request): the real first-token time (the minimum across prompts
+        — the instant the response started existing) and the matching
+        latency decomposition.  None when the recorder is off or the
+        records already aged out of the ring."""
+        flight = getattr(self.engine, "flight", None)
+        if flight is None or not flight.enabled:
+            return None
+        recs = flight.lookup(trace_id)
+        if not recs:
+            return None
+        with_ttft = [r for r in recs if r.get("ttft_s") is not None]
+        first = (min(with_ttft, key=lambda r: r["ttft_s"])
+                 if with_ttft else None)
+        timing = {
+            "trace_id": trace_id,
+            "replica_id": self.replica_id,
+            "requests": len(recs),
+            "ttft_s": first["ttft_s"] if first else None,
+            "latency_s": max((r["latency_s"] or 0.0) for r in recs),
+        }
+        if first is not None and "ttft_decomposition" in first:
+            timing["ttft_decomposition"] = first["ttft_decomposition"]
+        return timing
+
+    def debug_requests(self, n: Optional[int] = None,
+                       trace_id: Optional[str] = None) -> dict:
+        """``GET /debug/requests``: recent flight records as JSON (in-
+        flight first, then retired newest-first), plus replica identity
+        so a fleet aggregation stays attributable."""
+        flight = getattr(self.engine, "flight", None)
+        enabled = flight is not None and flight.enabled
+        recs = flight.snapshot(n=n, trace_id=trace_id) if enabled else []
+        return {
+            "replica_id": self.replica_id,
+            "flight_recorder": enabled,
+            "count": len(recs),
+            "requests": recs,
+        }
 
     def metrics_text(self) -> str:
         """Prometheus text for GET /metrics: refresh the engine-occupancy

@@ -13,7 +13,10 @@ run:
 * ``profiler`` — on-demand ``jax.profiler`` windows (SIGUSR2 or
   ``/profile?steps=N``);
 * ``flops``    — config-derived flops/MFU math shared by driver, bench
-  and registry.
+  and registry;
+* ``flight``   — per-request flight recorder: bounded event logs with an
+  exact latency decomposition, served on ``/debug/requests`` and dumped
+  by the watchdog.
 
 Package-wide contract, enforced by the ``obs-no-sync`` graftcheck rule
 (docs/guide/static-analysis.md): nothing in here may sync the device —
@@ -24,6 +27,6 @@ on).  This docstring can name those calls only because the rule is
 AST-based: prose is prose, a call is a finding.
 """
 
-from megatron_llm_tpu.observability import flops, registry, trace
+from megatron_llm_tpu.observability import flight, flops, registry, trace
 
-__all__ = ["flops", "registry", "trace"]
+__all__ = ["flight", "flops", "registry", "trace"]

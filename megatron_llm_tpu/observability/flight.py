@@ -1,0 +1,458 @@
+"""Per-request flight recorder: a bounded, lock-disciplined event log.
+
+PR 4 gave the process a span ring and aggregate counters; what it could
+not answer is the per-request question operators actually ask: *this*
+request missed its SLO / 503'd / hung — what happened to it?  The flight
+recorder answers that.  Every request the decode engine touches gets a
+:class:`RequestRecord`: a bounded event log (enqueue, admit, prefill
+chunks, preempt/resume, speculative ticks, first token, stop/shed) with
+monotonic timestamps, plus an exact **latency decomposition** — every
+second between submit and retirement falls into exactly one of four
+phase buckets (``queued`` / ``prefill`` / ``decode`` / ``preempted``),
+so the components provably sum to the measured TTFT and total latency.
+
+The same hot-path contract as trace.py and registry.py (enforced by the
+``obs-no-sync`` graftcheck rule): pure host arithmetic, O(1) per event,
+never any device work.  Values recorded must already live on the host —
+the ``span-device-attr`` rule flags device arrays passed as event attrs,
+because a traced jax array would force a host sync at dump time.
+
+Bounding: the recorder keeps at most ``capacity`` retired records (a
+ring — oldest drop) plus whatever is genuinely in flight; each record
+keeps at most ``events_per_request`` events (oldest drop, with an honest
+``dropped_events`` count — terminal events are the newest, so they
+always survive).
+
+Consumers:
+
+* ``GET /debug/requests`` on the generation server serves recent records
+  as JSON; the router aggregates every replica's endpoint fleet-wide
+  (docs/guide/observability.md "Request tracing & flight recorder").
+* The step watchdog dumps in-flight records next to its thread-stack and
+  trace dumps, so a hang is attributable to a specific request state
+  (resilience/watchdog.py).
+* The engine derives its honest TTFT decomposition histograms
+  (``mlt_engine_queue_wait_seconds`` etc.) from retired records.
+
+One lock (the recorder's) covers the recorder *and* every record it
+issued: record mutators run under it, so a ``/debug/requests`` snapshot
+taken mid-tick can never see a half-updated record.  The engine calls
+into the recorder while holding its own lock; the recorder never calls
+back out, so the lock order is engine -> recorder, acyclic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "NULL_RECORD",
+    "RequestRecord",
+    "get_recorder",
+    "set_recorder",
+]
+
+#: Phase buckets of the latency decomposition.  A request is in exactly
+#: one at any instant: ``queued`` (submitted, no slot yet), ``prefill``
+#: (admitted, prompt K/V filling), ``decode`` (emitting tokens),
+#: ``preempted`` (pages released, waiting to re-admit).
+PHASES = ("queued", "prefill", "decode", "preempted")
+
+
+class RequestRecord:
+    """One request's flight log + phase-bucketed latency accounting.
+
+    Mutators take the owning recorder's lock (shared — see module doc);
+    ``*_locked`` readers document the callers that already hold it."""
+
+    __slots__ = (
+        "_lock", "trace_id", "meta", "t_submit", "wall_submit",
+        "events", "dropped_events", "phase", "_phase_since", "phase_s",
+        "prefill_compute_s", "hit_tokens", "preemptions",
+        "spec_drafted", "spec_accepted", "t_first", "t_done",
+        "ttft_phase_s", "outcome", "finished", "enabled",
+    )
+
+    def __init__(self, trace_id: str, lock: threading.Lock,
+                 events_cap: int, t_submit: Optional[float] = None,
+                 **meta: Any):
+        self._lock = lock  # the owning FlightRecorder's lock
+        self.enabled = True
+        self.trace_id = trace_id
+        self.meta = meta
+        self.t_submit = (time.monotonic() if t_submit is None
+                         else float(t_submit))
+        self.wall_submit = time.time()
+        # newest events win the bounded ring: terminal events (first
+        # token, stop, shed) are by construction the newest, so a chatty
+        # spec-tick history can never push them out — guarded by _lock
+        self.events: deque = deque(maxlen=max(int(events_cap), 4))
+        self.dropped_events = 0          # guarded by _lock
+        self.phase = "queued"            # guarded by _lock
+        self._phase_since = self.t_submit  # guarded by _lock
+        # seconds spent per phase; the decomposition — guarded by _lock
+        self.phase_s: Dict[str, float] = {p: 0.0 for p in PHASES}
+        # device wall attributed to this request's prefill work (exact
+        # for the legacy one-chunk dispatch; a proportional share of the
+        # fused launch in ragged mode) — guarded by _lock
+        self.prefill_compute_s = 0.0
+        self.hit_tokens = 0              # guarded by _lock
+        self.preemptions = 0             # guarded by _lock
+        self.spec_drafted = 0            # guarded by _lock
+        self.spec_accepted = 0           # guarded by _lock
+        self.t_first = 0.0               # guarded by _lock
+        self.t_done = 0.0                # guarded by _lock
+        # decomposition frozen at first token (sums to TTFT exactly)
+        self.ttft_phase_s: Optional[Dict[str, float]] = None  # guarded by _lock
+        self.outcome: Optional[str] = None  # guarded by _lock
+        self.finished = False            # guarded by _lock
+
+    # ---- recording (engine hot path) ----
+
+    def _fold_locked(self, now: float) -> None:  # holds _lock
+        """Credit the time since the last transition to the current
+        phase.  Every instant lands in exactly one bucket, which is what
+        makes the decomposition sum to the measured latency."""
+        self.phase_s[self.phase] += max(0.0, now - self._phase_since)
+        self._phase_since = now
+
+    def _event_locked(self, kind: str, now: float,
+                      args: Optional[Dict[str, Any]]) -> None:  # holds _lock
+        if len(self.events) == self.events.maxlen:
+            self.dropped_events += 1  # append evicts the oldest
+        self.events.append((now - self.t_submit, kind, args))
+
+    def event(self, kind: str, **args: Any) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._event_locked(kind, now, args or None)
+
+    def set_phase(self, phase: str, **args: Any) -> None:
+        """Transition phases, folding elapsed time into the old bucket
+        and recording the transition as an event."""
+        now = time.monotonic()
+        with self._lock:
+            self._fold_locked(now)
+            self.phase = phase
+            self._event_locked(phase, now, args or None)
+
+    def note_hit_tokens(self, n: int) -> None:
+        with self._lock:
+            self.hit_tokens = int(n)
+
+    def note_preemption(self) -> None:
+        with self._lock:
+            self.preemptions += 1
+
+    def add_prefill_compute(self, seconds: float) -> None:
+        with self._lock:
+            self.prefill_compute_s += max(0.0, float(seconds))
+
+    def add_spec(self, drafted: int, accepted: int) -> None:
+        with self._lock:
+            self.spec_drafted += int(drafted)
+            self.spec_accepted += int(accepted)
+
+    def mark_first_token(self, now: Optional[float] = None) -> None:
+        """First generated token: freeze the TTFT decomposition (the
+        live buckets keep accumulating toward total latency)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.t_first:
+                return
+            self._fold_locked(now)
+            self.t_first = now
+            self.ttft_phase_s = dict(self.phase_s)
+            self._event_locked("first_token", now, None)
+
+    def finish(self, outcome: str, now: Optional[float] = None,
+               **args: Any) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.finished:
+                return
+            self._fold_locked(now)
+            self.t_done = now
+            self.outcome = outcome
+            self.finished = True
+            self._event_locked(outcome, now, args or None)
+
+    # ---- derived views ----
+
+    def ttft_s(self) -> Optional[float]:
+        with self._lock:
+            return (self.t_first - self.t_submit) if self.t_first else None
+
+    def latency_s(self) -> Optional[float]:
+        with self._lock:
+            return (self.t_done - self.t_submit) if self.t_done else None
+
+    def ttft_decomposition(self) -> Optional[Dict[str, float]]:
+        """The frozen-at-first-token phase buckets (sum == TTFT)."""
+        with self._lock:
+            return dict(self.ttft_phase_s) if self.ttft_phase_s else None
+
+    def miss_phase(self) -> str:
+        """Which phase to blame for a TTFT deadline miss: the bucket
+        that ate the largest share of the TTFT.  Time spent preempted is
+        time spent *waiting for a slot again*, so it attributes to
+        ``queue`` (the exported label set is queue|prefill|decode)."""
+        d = self.ttft_decomposition()
+        if not d:
+            return "queue"
+        merged = {
+            "queue": d.get("queued", 0.0) + d.get("preempted", 0.0),
+            "prefill": d.get("prefill", 0.0),
+            "decode": d.get("decode", 0.0),
+        }
+        return max(merged, key=merged.get)  # type: ignore[arg-type]
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._to_dict_locked(time.monotonic())
+
+    def _to_dict_locked(self, now: float) -> Dict[str, Any]:  # holds _lock
+        live = dict(self.phase_s)
+        if not self.finished:  # include the still-open bucket honestly
+            live[self.phase] = (live.get(self.phase, 0.0)
+                                + max(0.0, now - self._phase_since))
+        d: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "phase": "finished" if self.finished else self.phase,
+            "outcome": self.outcome,
+            "submitted_unix": round(self.wall_submit, 6),
+            "age_s": round((self.t_done or now) - self.t_submit, 6),
+            "ttft_s": (round(self.t_first - self.t_submit, 6)
+                       if self.t_first else None),
+            "latency_s": (round(self.t_done - self.t_submit, 6)
+                          if self.t_done else None),
+            "decomposition": {
+                "queue_wait_s": round(live.get("queued", 0.0), 6),
+                "prefill_s": round(live.get("prefill", 0.0), 6),
+                "decode_s": round(live.get("decode", 0.0), 6),
+                "preempted_s": round(live.get("preempted", 0.0), 6),
+            },
+            "prefill_compute_s": round(self.prefill_compute_s, 6),
+            "hit_tokens": self.hit_tokens,
+            "preemptions": self.preemptions,
+            "dropped_events": self.dropped_events,
+            "events": [
+                {"t_s": round(t, 6), "kind": kind,
+                 **({"args": args} if args else {})}
+                for t, kind, args in self.events],
+        }
+        if self.ttft_phase_s is not None:
+            d["ttft_decomposition"] = {
+                "queue_wait_s": round(self.ttft_phase_s.get("queued", 0.0), 6),
+                "prefill_s": round(self.ttft_phase_s.get("prefill", 0.0), 6),
+                "decode_s": round(self.ttft_phase_s.get("decode", 0.0), 6),
+                "preempted_s": round(
+                    self.ttft_phase_s.get("preempted", 0.0), 6),
+            }
+        if self.spec_drafted:
+            d["spec"] = {"drafted": self.spec_drafted,
+                         "accepted": self.spec_accepted}
+        if self.meta:
+            d["meta"] = dict(self.meta)
+        return d
+
+
+class _NullRecord:
+    """Shared no-op record: the disabled-recorder fast path.  Every
+    mutator is a no-op and every derived view is empty, so engine code
+    stays branch-free (it only checks ``enabled`` before paying for a
+    histogram observation)."""
+
+    __slots__ = ()
+    enabled = False
+    trace_id = ""
+    hit_tokens = 0
+    preemptions = 0
+    prefill_compute_s = 0.0
+
+    def event(self, kind, **args):
+        pass
+
+    def set_phase(self, phase, **args):
+        pass
+
+    def note_hit_tokens(self, n):
+        pass
+
+    def note_preemption(self):
+        pass
+
+    def add_prefill_compute(self, seconds):
+        pass
+
+    def add_spec(self, drafted, accepted):
+        pass
+
+    def mark_first_token(self, now=None):
+        pass
+
+    def finish(self, outcome, now=None, **args):
+        pass
+
+    def ttft_s(self):
+        return None
+
+    def latency_s(self):
+        return None
+
+    def ttft_decomposition(self):
+        return None
+
+    def miss_phase(self):
+        return "queue"
+
+    def to_dict(self):
+        return {}
+
+
+NULL_RECORD = _NullRecord()
+
+
+class FlightRecorder:
+    """Bounded per-request record store: in-flight dict + retired ring.
+
+    ``capacity`` bounds retired records (ring; oldest drop with an
+    honest counter), ``events_per_request`` bounds each record's event
+    log.  ``enabled=False`` (or capacity 0) makes :meth:`open` hand out
+    the shared :data:`NULL_RECORD` — the engine's recording calls become
+    no-ops and nothing allocates."""
+
+    def __init__(self, capacity: int = 256, events_per_request: int = 64,
+                 enabled: bool = True):
+        self.capacity = max(int(capacity), 0)
+        self.events_per_request = max(int(events_per_request), 4)
+        self.enabled = bool(enabled) and self.capacity > 0
+        self._lock = threading.Lock()
+        # open (not yet closed) records, insertion-ordered — guarded by _lock
+        self._inflight: Dict[int, RequestRecord] = {}
+        # retired records, newest last — guarded by _lock
+        self._done: deque = deque(maxlen=self.capacity or 1)
+        self._seq = 0           # guarded by _lock
+        self._ids: Dict[int, int] = {}  # id(record) -> seq — guarded by _lock
+        self._evicted = 0       # retired records pushed out — guarded by _lock
+
+    # ---- lifecycle (engine calls) ----
+
+    def open(self, trace_id: str, **meta: Any):
+        """Start a record for a just-submitted request.  Returns the
+        shared null record when disabled."""
+        if not self.enabled:
+            return NULL_RECORD
+        rec = RequestRecord(trace_id, self._lock,
+                            self.events_per_request, **meta)
+        with self._lock:
+            self._seq += 1
+            self._inflight[self._seq] = rec
+            self._ids[id(rec)] = self._seq
+        return rec
+
+    def close(self, rec) -> None:
+        """Move a finished record from in-flight to the retired ring."""
+        if rec is None or not getattr(rec, "enabled", False):
+            return
+        with self._lock:
+            seq = self._ids.pop(id(rec), None)
+            if seq is not None:
+                self._inflight.pop(seq, None)
+            if len(self._done) == self._done.maxlen:
+                self._evicted += 1
+            self._done.append(rec)
+
+    # ---- inspection / export ----
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    @property
+    def evicted(self) -> int:
+        with self._lock:
+            return self._evicted
+
+    def _records(self) -> List[RequestRecord]:
+        """In-flight first (oldest submit first), then retired newest
+        first — the order ``/debug/requests`` serves."""
+        with self._lock:
+            return list(self._inflight.values()) + list(
+                reversed(self._done))
+
+    def snapshot(self, n: Optional[int] = None,
+                 trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        """JSON-ready dicts of recent records (see :meth:`_records` for
+        the order), optionally filtered by trace id and capped at ``n``."""
+        recs = self._records()
+        if trace_id is not None:
+            recs = [r for r in recs if r.trace_id == trace_id]
+        if n is not None:
+            recs = recs[: max(int(n), 0)]
+        return [r.to_dict() for r in recs]
+
+    def lookup(self, trace_id: str) -> List[Dict[str, Any]]:
+        """All records carrying ``trace_id`` (a multi-prompt request
+        opens one per prompt, sharing the id)."""
+        return self.snapshot(trace_id=trace_id)
+
+    def dump(self, path: str) -> str:
+        """Atomic JSON dump (the watchdog's emergency format): every
+        in-flight and retired record, plus the bound-honesty counters."""
+        doc = {
+            "records": self.snapshot(),
+            "inflight": self.inflight,
+            "capacity": self.capacity,
+            "evicted_records": self.evicted,
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def write_text(self, stream, limit: int = 32) -> None:
+        """Human-readable tail for hang reports without a dump dir: the
+        in-flight records' phase + decomposition, newest activity last."""
+        recs = self.snapshot(n=limit)
+        if not recs:
+            return
+        print(f"FLIGHT: {len(recs)} request records "
+              f"({self.inflight} in flight):", file=stream)
+        for r in recs:
+            d = r["decomposition"]
+            print(f"  [{r['trace_id'] or '-'}] phase={r['phase']} "
+                  f"age={r['age_s']:.3f}s queue={d['queue_wait_s']:.3f} "
+                  f"prefill={d['prefill_s']:.3f} "
+                  f"decode={d['decode_s']:.3f} "
+                  f"preempted={d['preempted_s']:.3f} "
+                  f"events={len(r['events'])}", file=stream)
+        stream.flush()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide recorder (the watchdog's fallback dump source)
+# ---------------------------------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def set_recorder(rec: Optional[FlightRecorder]) -> None:
+    """Register the process's flight recorder (the engine does this at
+    construction) so the watchdog's emergency dump can find it without
+    plumbing."""
+    global _RECORDER
+    _RECORDER = rec
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER

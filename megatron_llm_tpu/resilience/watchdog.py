@@ -70,6 +70,12 @@ class StepWatchdog:
         report should come with a timeline.  When None, falls back to a
         text tail of the process-wide tracer (observability/trace.py) on
         the stream, if one is configured.
+      flight_dump_fn: dumps the in-flight request flight records on
+        expiry (returns the written path) — a serving hang should be
+        attributable to a specific request state, not just thread
+        stacks.  When None, falls back to a text tail of the
+        process-wide recorder (observability/flight.py), if any engine
+        registered one.
       exit_fn: defaults to ``os._exit`` — tests inject a recorder.
     """
 
@@ -83,6 +89,7 @@ class StepWatchdog:
         snapshot_timeout: float = 120.0,
         gauge_fn: Optional[Callable[[], None]] = None,
         trace_dump_fn: Optional[Callable[[], Optional[str]]] = None,
+        flight_dump_fn: Optional[Callable[[], Optional[str]]] = None,
         exit_fn: Callable[[int], None] = os._exit,
         exit_code: int = EXIT_WATCHDOG,
         stream=None,
@@ -95,6 +102,7 @@ class StepWatchdog:
         self._snapshot_timeout = float(snapshot_timeout)
         self._gauge_fn = gauge_fn
         self._trace_dump_fn = trace_dump_fn
+        self._flight_dump_fn = flight_dump_fn
         self._exit_fn = exit_fn
         self._exit_code = exit_code
         self._stream = stream
@@ -171,6 +179,7 @@ class StepWatchdog:
         except Exception:
             pass
         self._dump_trace()
+        self._dump_flight()
         if self._gauge_fn is not None:
             try:
                 self._gauge_fn()
@@ -197,6 +206,27 @@ class StepWatchdog:
             tracer = obs_trace.get_tracer()
             if tracer is not None and tracer.enabled:
                 tracer.write_text(stream)
+        except Exception:
+            pass
+
+    def _dump_flight(self) -> None:
+        """Land the in-flight request flight records next to the stack
+        and trace dumps (ISSUE 12): the stacks say WHERE the process is
+        stuck, the timeline WHAT it was doing, the flight records WHICH
+        request it was doing it for.  Best-effort on every path."""
+        stream = self._stream or sys.stderr
+        try:
+            if self._flight_dump_fn is not None:
+                path = self._flight_dump_fn()
+                if path:
+                    print(f"WATCHDOG: flight records dumped to {path}",
+                          file=stream, flush=True)
+                return
+            from megatron_llm_tpu.observability import flight as obs_flight
+
+            rec = obs_flight.get_recorder()
+            if rec is not None and rec.enabled:
+                rec.write_text(stream)
         except Exception:
             pass
 

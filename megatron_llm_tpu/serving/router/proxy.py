@@ -49,7 +49,8 @@ class ForwardOutcome:
                  retry_after: Optional[float] = None,
                  attempts: int = 1,
                  failovers: int = 0,
-                 retries: int = 0):
+                 retries: int = 0,
+                 ttft_s: Optional[float] = None):
         self.status = status
         self.body = body
         self.replica_url = replica_url
@@ -57,6 +58,9 @@ class ForwardOutcome:
         self.attempts = attempts
         self.failovers = failovers
         self.retries = retries
+        # replica-reported first-token seconds (X-MLT-TTFT-S): the
+        # honest TTFT signal; None from pre-tracing replicas
+        self.ttft_s = ttft_s
 
 
 def _err_body(msg: str, **extra) -> bytes:
@@ -79,15 +83,22 @@ class ForwardingProxy:
 
     # ---- single attempt -------------------------------------------------
 
-    def _attempt(self, url: str, body: bytes
-                 ) -> Tuple[str, int, bytes, Optional[float]]:
+    def _attempt(self, url: str, body: bytes,
+                 headers: Optional[dict] = None
+                 ) -> Tuple[str, int, bytes, Optional[float],
+                            Optional[float]]:
         """One forward to one replica.
 
-        Returns (kind, status, body, retry_after) with kind in
-        {'ok', 'overloaded', 'terminal', 'connect_fail', 'partial'}."""
+        Returns (kind, status, body, retry_after, ttft_s) with kind in
+        {'ok', 'overloaded', 'terminal', 'connect_fail', 'partial'};
+        ``headers`` (the trace-id propagation path) merge into the
+        forwarded request, and ``ttft_s`` is the replica's own
+        ``X-MLT-TTFT-S`` first-token stamp when it sent one."""
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
         req = urllib.request.Request(
             url.rstrip("/") + "/api", data=body,
-            headers={"Content-Type": "application/json"}, method="PUT")
+            headers=hdrs, method="PUT")
         try:
             resp = urllib.request.urlopen(req, timeout=self.timeout_s)
         except urllib.error.HTTPError as e:
@@ -98,7 +109,7 @@ class ForwardingProxy:
             except Exception:
                 return ("partial", 502,
                         _err_body(f"replica {url} dropped mid-error-body"),
-                        None)
+                        None, None)
             if e.code == 503:
                 ra = e.headers.get("Retry-After")
                 try:
@@ -111,13 +122,13 @@ class ForwardingProxy:
                             json.loads(data).get("retry_after", 1.0))
                     except (ValueError, AttributeError):
                         retry_after = 1.0
-                return ("overloaded", 503, data, retry_after)
-            return ("terminal", e.code, data, None)
+                return ("overloaded", 503, data, retry_after, None)
+            return ("terminal", e.code, data, None, None)
         except (urllib.error.URLError, socket.timeout, ConnectionError,
                 OSError) as e:
             # no status line: the request never started executing
             return ("connect_fail", 0,
-                    _err_body(f"{type(e).__name__}: {e}"), None)
+                    _err_body(f"{type(e).__name__}: {e}"), None, None)
         with resp:
             try:
                 data = resp.read()
@@ -129,17 +140,24 @@ class ForwardingProxy:
                         _err_body(
                             f"replica {url} dropped mid-response "
                             f"({type(e).__name__}); not retried — the "
-                            f"generation may have executed"), None)
-            return ("ok", resp.status, data, None)
+                            f"generation may have executed"), None, None)
+            try:
+                ttft = float(resp.headers.get("X-MLT-TTFT-S"))
+            except (TypeError, ValueError):
+                ttft = None
+            return ("ok", resp.status, data, None, ttft)
 
     # ---- candidate walk -------------------------------------------------
 
-    def forward(self, candidate_urls: Sequence[str],
-                body: bytes) -> ForwardOutcome:
+    def forward(self, candidate_urls: Sequence[str], body: bytes,
+                headers: Optional[dict] = None) -> ForwardOutcome:
         """Walk candidates with failover, then bounded Retry-After-honoring
-        retry rounds over the saturated ones."""
+        retry rounds over the saturated ones.  ``headers`` ride every
+        attempt (trace-id propagation: the router's ``X-MLT-Trace-Id``
+        reaches whichever replica finally serves the request)."""
         from megatron_llm_tpu.observability.trace import span
 
+        trace_id = (headers or {}).get("X-MLT-Trace-Id", "")
         excluded: set = set()   # connect-failed: out for this request
         attempts = failovers = retries = 0
         saturated: List[Tuple[str, float]] = []
@@ -152,12 +170,14 @@ class ForwardingProxy:
                 if url in excluded:
                     continue
                 attempts += 1
-                with span("router-forward", url=url):
-                    kind, status, data, ra = self._attempt(url, body)
+                with span("router-forward", url=url, trace_id=trace_id):
+                    kind, status, data, ra, ttft = self._attempt(
+                        url, body, headers)
                 if kind == "ok" or kind == "terminal":
                     return ForwardOutcome(
                         status, data, replica_url=url, attempts=attempts,
-                        failovers=failovers, retries=retries)
+                        failovers=failovers, retries=retries,
+                        ttft_s=ttft)
                 if kind == "partial":
                     return ForwardOutcome(
                         status, data, replica_url=url, attempts=attempts,

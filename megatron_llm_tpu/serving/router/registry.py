@@ -87,6 +87,10 @@ class ReplicaView:
     retry_after_s: Optional[float] = None
     ema_tick_s: Optional[float] = None
     ema_retire_s: Optional[float] = None
+    # measured submit-to-first-token EMA (ISSUE 12): the replica's real
+    # TTFT including queue + prefill — the honest base for slo_aware's
+    # wait predictions (the tick EMA only covers one decode step)
+    ttft_ema_s: Optional[float] = None
     queued_by_priority: Tuple[Tuple[str, int], ...] = ()
     # speculative decoding payload (engine.spec_stats()), when present
     spec_acceptance: Optional[float] = None
@@ -126,6 +130,7 @@ class ReplicaView:
                            else float(sched["retry_after_s"])),
             ema_tick_s=_ms("ema_tick_ms"),
             ema_retire_s=_ms("ema_retire_ms"),
+            ttft_ema_s=_ms("ttft_ema_ms"),
             queued_by_priority=tuple(
                 sorted((str(k), int(v)) for k, v in
                        (sched.get("queued_by_priority") or {}).items())),
@@ -158,10 +163,14 @@ class ReplicaView:
         return self.depth * (per if per is not None else 1.0)
 
     def predicted_wait_s(self) -> float:
-        """Predicted TTFT floor for a new arrival: a free slot costs about
-        one tick; a backlog costs its drain estimate (the replica's own
-        Retry-After figure when it published one)."""
+        """Predicted TTFT floor for a new arrival.  With a free slot the
+        replica's measured first-token EMA (``ttft_ema_ms`` — real TTFT,
+        queue + prefill included) is the honest estimate, the tick EMA a
+        coarse pre-ISSUE-12 fallback; a backlog costs its drain estimate
+        (the replica's own Retry-After figure when it published one)."""
         if self.queued == 0 and self.active_slots < self.max_slots:
+            if self.ttft_ema_s is not None:
+                return self.ttft_ema_s
             return self.ema_tick_s if self.ema_tick_s is not None else 0.0
         if self.retry_after_s is not None:
             return self.retry_after_s

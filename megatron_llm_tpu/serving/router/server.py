@@ -10,17 +10,28 @@ probes point at either tier identically):
   queue/pages snapshot, restart counts) + router identity.
 * ``GET /metrics`` — Prometheus text: per-replica up/queue/pages gauges
   refreshed at scrape time, routing-decision / retry / failover / shed
-  counters, per-replica TTFT histograms (non-streaming replicas deliver
-  the whole body at first byte, so time-to-response IS time-to-first-
-  token as the client experiences it).
+  counters, per-replica TTFT histograms — **first-token honest** since
+  ISSUE 12: each replica stamps its measured server-side first-token
+  time into the ``X-MLT-TTFT-S`` response header and the histogram
+  observes that, falling back to client-observed time-to-response only
+  for replicas that don't stamp it.
+* ``GET /debug/requests`` — fleet-aggregated flight records: every
+  replica's ``/debug/requests`` (observability/flight.py) keyed by url,
+  with ``?trace_id=`` / ``?n=`` passed through.
 * ``POST /admin/drain`` / ``POST /admin/undrain`` — operator drain
   (body: ``{"replica": "<url>"}``); the breaker keeps polling a draining
   replica but no new traffic reaches it.
 
+Distributed tracing (ISSUE 12): ``PUT /api`` accepts (or mints) an
+``X-MLT-Trace-Id``, threads it through the forwarded request into the
+replica's engine, and echoes it in the response — one id correlates the
+router's spans, the replica's spans, and both tiers' flight records.
+
 Tracer spans (observability/trace.py): ``router-route`` around the
-policy decision, ``router-forward`` per attempt (proxy.py), and
-``router-poll`` per scrape (registry.py) — a Perfetto dump of a router
-process shows the poll cadence against the forward latency.
+policy decision, ``router-forward`` per attempt (proxy.py) — both
+carrying ``trace_id`` attrs so Perfetto dumps from router and replica
+processes correlate into per-request tracks — and ``router-poll`` per
+scrape (registry.py).
 """
 
 from __future__ import annotations
@@ -28,9 +39,11 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs
 
 from megatron_llm_tpu.observability.registry import get_registry
 from megatron_llm_tpu.observability.trace import span
@@ -131,16 +144,27 @@ class RouterServer:
              "draining": 3}.get(state, -1))
 
     def _observe_ttft(self, replica_url: str, seconds: float) -> None:
+        # first-token-honest since ISSUE 12: the replica's own
+        # X-MLT-TTFT-S stamp when it sends one (router.route falls back
+        # to time-to-response only for pre-tracing replicas)
         get_registry().histogram(
             "mlt_router_ttft_seconds",
-            "client-observed time-to-response per replica",
+            "server-reported first-token seconds per replica "
+            "(time-to-response fallback for replicas that don't stamp "
+            "X-MLT-TTFT-S)",
             labels={"replica": replica_url},
             buckets=_TTFT_BUCKETS).observe(seconds)
 
     # ---- request handling ----------------------------------------------
 
-    def route(self, payload: dict, body: bytes):
-        """Decide + forward.  Returns (status, body_bytes, headers)."""
+    def route(self, payload: dict, body: bytes, trace_id: str = ""):
+        """Decide + forward.  Returns (status, body_bytes, headers).
+
+        ``trace_id`` (minted by the HTTP handler when the caller sent no
+        ``X-MLT-Trace-Id``) rides the router spans, the forwarded
+        request and the response headers — the one id that correlates
+        the router's and the serving replica's trace dumps and flight
+        records."""
         request = RouteRequest.from_payload(payload)
         views = self.registry.routable_views()
         if not views:
@@ -151,7 +175,8 @@ class RouterServer:
                 "retry_after": 1.0, "fleet": fleet,
             }).encode(), {"Retry-After": "1"}
         try:
-            with span("router-route", policy=self.policy.name):
+            with span("router-route", policy=self.policy.name,
+                      trace_id=trace_id):
                 candidates = self.policy.order(request, views)
         except FleetOverloaded as fo:
             self._shed.inc()
@@ -160,9 +185,15 @@ class RouterServer:
                 "shed": True, **fo.info,
             }).encode(), {"Retry-After": str(max(1, int(fo.retry_after)))}
         t0 = time.monotonic()
-        out = self.proxy.forward([v.url for v in candidates], body)
+        out = self.proxy.forward(
+            [v.url for v in candidates], body,
+            headers={"X-MLT-Trace-Id": trace_id} if trace_id else None)
         if out.replica_url is not None and out.status == 200:
-            self._observe_ttft(out.replica_url, time.monotonic() - t0)
+            # honest TTFT (ISSUE 12): prefer the replica's own
+            # first-token stamp over client-observed time-to-response
+            self._observe_ttft(out.replica_url,
+                               out.ttft_s if out.ttft_s is not None
+                               else time.monotonic() - t0)
         self._routed.inc()
         if out.failovers:
             self._failovers.inc(out.failovers)
@@ -174,6 +205,8 @@ class RouterServer:
             labels={"policy": self.policy.name,
                     "replica": out.replica_url or "none"}).inc()
         headers = {}
+        if trace_id:
+            headers["X-MLT-Trace-Id"] = trace_id
         if out.status == 503 and out.retry_after is not None:
             headers["Retry-After"] = str(max(1, int(out.retry_after)))
         return out.status, out.body, headers
@@ -201,6 +234,35 @@ class RouterServer:
         if ok:
             self._publish_replica_gauges(self.registry.get(url))
         return ok
+
+    def debug_requests(self, n: Optional[int] = None,
+                       trace_id: Optional[str] = None) -> dict:
+        """Fleet-aggregating ``GET /debug/requests``: scrape every
+        replica's flight-record endpoint (ejected/draining ones too — a
+        request stuck on a sick replica is exactly what an operator is
+        hunting) and key the results by replica url.  A replica that
+        fails to answer contributes an ``error`` entry, never a router
+        failure."""
+        qs = []
+        if n is not None:
+            qs.append(f"n={int(n)}")
+        if trace_id:
+            qs.append(f"trace_id={trace_id}")
+        suffix = "/debug/requests" + ("?" + "&".join(qs) if qs else "")
+        fleet = {}
+        for rep in self.registry.replicas():
+            try:
+                with urllib.request.urlopen(
+                        rep.url.rstrip("/") + suffix,
+                        timeout=self.poller.timeout_s) as resp:
+                    fleet[rep.url] = json.loads(resp.read())
+            except Exception as e:  # a dead replica must not 500 this
+                fleet[rep.url] = {
+                    "error": f"{type(e).__name__}: {e}",
+                    "state": rep.state,
+                }
+        return {"role": "router", "router_id": self.router_id,
+                "fleet": fleet}
 
     # ---- HTTP plumbing --------------------------------------------------
 
@@ -231,8 +293,11 @@ class RouterServer:
                 if not isinstance(payload, dict):
                     return self._send_json(
                         400, {"error": "request body must be a JSON object"})
+                trace_id = (self.headers.get("X-MLT-Trace-Id", "").strip()
+                            or uuid.uuid4().hex)
                 try:
-                    code, data, headers = router.route(payload, body)
+                    code, data, headers = router.route(payload, body,
+                                                       trace_id=trace_id)
                 except Exception as e:  # route/forward must answer the client
                     return self._send_json(500, {
                         "error": f"router error: {type(e).__name__}: {e}"})
@@ -257,13 +322,24 @@ class RouterServer:
                 return self.do_PUT()  # /api convenience, replica parity
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0].rstrip("/")
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/")
                 if path == "/health":
                     return self._send_json(200, router.health())
                 if path == "/metrics":
                     return self._send(
                         200, router.metrics_text().encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
+                if path == "/debug/requests":
+                    qs = parse_qs(query)
+                    try:
+                        n = int(qs["n"][0]) if "n" in qs else None
+                    except ValueError:
+                        return self._send_json(
+                            400, {"error": "n must be an integer"})
+                    tid = qs.get("trace_id", [None])[0]
+                    return self._send_json(
+                        200, router.debug_requests(n=n, trace_id=tid))
                 return self._send_json(404, {"error": "not found"})
 
             def log_message(self, fmt, *args):  # quiet by default

@@ -43,6 +43,7 @@ from megatron_llm_tpu.models import init_model_params
 from megatron_llm_tpu.models.language_model import loss_from_batch, make_rope_cache
 from megatron_llm_tpu.optimizer.optimizer import opt_state_shardings
 from megatron_llm_tpu.parallel.tp import make_sp_constraint, param_shardings
+from megatron_llm_tpu.observability import flight as flight_mod
 from megatron_llm_tpu.observability import flops as flops_mod
 from megatron_llm_tpu.observability import registry as registry_mod
 from megatron_llm_tpu.observability import trace as trace_mod
@@ -533,6 +534,18 @@ def pretrain(
                     (lambda: tracer.dump(
                         os.path.join(obs.trace_dir, "trace_watchdog.json"),
                         drain=False))
+                    if tracer is not None else None),
+                # and the in-flight request flight records next to it
+                # (ISSUE 12): a hang report should name the request
+                # state, not just the thread stacks.  Resolved at expiry
+                # time — an engine constructed after the watchdog (e.g.
+                # a serving sidecar) still gets its records dumped.
+                flight_dump_fn=(
+                    (lambda: (flight_mod.get_recorder().dump(
+                        os.path.join(obs.trace_dir,
+                                     "flight_watchdog.json"))
+                        if flight_mod.get_recorder() is not None
+                        and flight_mod.get_recorder().enabled else None))
                     if tracer is not None else None),
             ).start()
             print0(f"resilience: watchdog armed per step "

@@ -804,3 +804,74 @@ def test_tp_bench_committed_cpu_evidence():
     assert by_tp[1]["all_reduce_count"] == 0
     assert sanity["loss_parity_vs_tp1"]["tp4_loss_delta"] <= 1e-4
     assert sanity["engine_tokens_match_tp1"] is True
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: bench-trajectory drift detector (tools/bench_drift.py)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_drift_in_watch_jobs():
+    """The drift check rides the tunnel-up capture list right after the
+    static analysis: bounded (it only reads committed JSON) and captured
+    whenever a parseable verdict line lands (drift is a finding to
+    bisect, not a retryable failure)."""
+    from tools.tpu_watch import JOBS, _drift_ran
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_drift" in by_name
+    cmd, bounded, pred = by_name["bench_drift"]
+    assert cmd[-1].endswith("bench_drift.py")
+    assert bounded is True and pred is _drift_ran
+    assert pred(json.dumps({"bench_drift": 1, "verdict": "ok"}))
+    assert pred(json.dumps({"bench_drift": 1, "verdict": "drift"}))
+    assert not pred("Traceback (most recent call last):")
+    assert not pred(json.dumps({"metric": "x", "value": 0.0}))
+
+
+def test_bench_drift_computation_synthetic():
+    """Per-metric drift math: ratio of newest to earliest committed
+    round, direction-aware thresholds, rounds without the metric
+    skipped."""
+    from tools.bench_drift import compute_drift
+
+    rows = [
+        (2, "BENCH_r02.json", {"step_time_s": 10.0, "compile_time_s": 40.0,
+                               "tokens_per_sec": 100.0}),
+        (3, "BENCH_r03.json", {"step_time_s": 11.0}),
+        (5, "BENCH_r05.json", {"step_time_s": 12.0, "compile_time_s": 44.0,
+                               "tokens_per_sec": 90.0}),
+    ]
+    res = compute_drift(rows)
+    assert res["verdict"] == "ok"
+    m = res["metrics"]["step_time_s"]
+    assert m["rounds"] == 3 and m["ratio"] == 1.2 and not m["exceeded"]
+    assert res["metrics"]["compile_time_s"]["rounds"] == 2
+    # now push step time past the ceiling
+    rows.append((6, "BENCH_r06.json", {"step_time_s": 31.0}))
+    res = compute_drift(rows)
+    assert res["verdict"] == "drift"
+    assert res["metrics"]["step_time_s"]["exceeded"] is True
+    assert res["metrics"]["tokens_per_sec"]["exceeded"] is False
+    # thresholds are configurable
+    res = compute_drift(rows, {"step_time_s": 4.0})
+    assert res["metrics"]["step_time_s"]["exceeded"] is False
+
+
+def test_bench_drift_flags_committed_trajectory():
+    """ROADMAP item 4 made measurable: on the committed BENCH_r*
+    evidence the detector reports the un-bisected CPU-sanity drift
+    (step 18.4s -> 52.2s, compile 38s -> 100s) as a drift verdict —
+    this test starts failing the day someone fixes the regression and
+    refreshes the evidence, which is exactly when the thresholds should
+    become a regression gate instead."""
+    from tools.bench_drift import compute_drift, load_trajectory
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rows = load_trajectory(repo)
+    assert len(rows) >= 4, "committed BENCH_r* trajectory went missing"
+    res = compute_drift(rows)
+    assert res["verdict"] == "drift"
+    assert res["metrics"]["step_time_s"]["exceeded"] is True
+    assert res["metrics"]["step_time_s"]["ratio"] > 2.0
+    assert res["metrics"]["compile_time_s"]["exceeded"] is True

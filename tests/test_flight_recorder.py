@@ -1,0 +1,531 @@
+"""Request-scoped tracing + flight recorder tests (ISSUE 12).
+
+Gates: (1) the flight recorder is bounded in both dimensions (retired
+ring + per-record events) with honest drop counters; (2) the latency
+decomposition's phase buckets sum to the measured TTFT and total latency
+(they are the same clock readings, bucketed); (3) recording is invisible
+to the engine's output — tokens and log-probs are bitwise-identical with
+the recorder on vs off; (4) one request traced across router -> replica
+-> engine shares a single trace id in both tiers' spans, the replica's
+``/debug/requests``, and the router's fleet aggregation, and the
+response's server-side timing block carries a decomposition that sums to
+its TTFT; (5) the watchdog's emergency dump lands the in-flight records;
+(6) the recorder's lock annotations are really modeled by graftcheck's
+lock-discipline rule (no vacuous cleanliness).
+"""
+
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from megatron_llm_tpu.generation import ContinuousBatchingEngine
+from megatron_llm_tpu.generation.server import MegatronServer
+from megatron_llm_tpu.models import init_model_params, make_config
+from megatron_llm_tpu.observability import flight as flight_mod
+from megatron_llm_tpu.observability import trace as trace_mod
+from megatron_llm_tpu.observability.flight import (
+    NULL_RECORD,
+    FlightRecorder,
+)
+from megatron_llm_tpu.serving.router.server import RouterServer
+
+VOCAB = 67
+GKW = dict(top_k=1, termination_id=10 ** 9)
+TOL = 1e-5  # decomposition fields are rounded to 1e-6 in to_dict
+
+
+@pytest.fixture(scope="module")
+def toy_model():
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=128,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False,
+    )
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq", 128)
+    return ContinuousBatchingEngine(cfg, params, None, **kw)
+
+
+def _prompt(n, off=0):
+    return [2 + ((i + off) * 7) % 60 for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Recorder unit: bounds, eviction, disabled mode
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_done_ring_bounded():
+    fl = FlightRecorder(capacity=4, events_per_request=8)
+    for i in range(10):
+        rec = fl.open(f"t{i}")
+        rec.finish("ok")
+        fl.close(rec)
+    snap = fl.snapshot()
+    assert len(snap) == 4
+    # newest first: t9..t6 survive, t5..t0 evicted with an honest count
+    assert [r["trace_id"] for r in snap] == ["t9", "t8", "t7", "t6"]
+    assert fl.evicted == 6
+    assert fl.inflight == 0
+
+
+def test_record_event_log_bounded_keeps_terminal_events():
+    fl = FlightRecorder(capacity=4, events_per_request=8)
+    rec = fl.open("chatty")
+    for i in range(50):
+        rec.event("spec_tick", k=3, accepted=2)
+    rec.mark_first_token()
+    rec.finish("ok")
+    d = rec.to_dict()
+    assert len(d["events"]) == 8
+    assert d["dropped_events"] == 50 + 2 - 8
+    # the bounded ring drops OLDEST: terminal events always survive
+    kinds = [e["kind"] for e in d["events"]]
+    assert kinds[-2:] == ["first_token", "ok"]
+
+
+def test_recorder_disabled_hands_out_null_record():
+    fl = FlightRecorder(capacity=0)
+    assert not fl.enabled
+    rec = fl.open("x")
+    assert rec is NULL_RECORD and not rec.enabled
+    # every mutator is a no-op; close tolerates the null record
+    rec.event("enqueue")
+    rec.set_phase("decode")
+    rec.mark_first_token()
+    rec.finish("ok")
+    fl.close(rec)
+    assert fl.snapshot() == []
+
+
+def test_snapshot_filters_and_caps():
+    fl = FlightRecorder(capacity=8)
+    for i in range(3):
+        rec = fl.open("shared" if i < 2 else "other", index=i)
+        rec.finish("ok")
+        fl.close(rec)
+    open_rec = fl.open("shared", index=99)  # stays in flight
+    assert len(fl.lookup("shared")) == 3
+    assert len(fl.lookup("other")) == 1
+    assert len(fl.snapshot(n=2)) == 2
+    # in-flight records come first
+    assert fl.snapshot()[0]["phase"] == "queued"
+    open_rec.finish("ok")
+    fl.close(open_rec)
+
+
+def test_decomposition_sums_exactly_synthetic():
+    """Phase buckets partition the submit->done interval: their sum IS
+    the measured latency (and the frozen TTFT buckets sum to TTFT)."""
+    fl = FlightRecorder(capacity=4)
+    rec = fl.open("t")
+    time.sleep(0.01)                  # queued
+    rec.set_phase("prefill")
+    time.sleep(0.02)                  # prefill
+    rec.set_phase("decode")
+    time.sleep(0.005)
+    rec.mark_first_token()
+    time.sleep(0.01)                  # more decode
+    rec.set_phase("preempted")
+    time.sleep(0.01)
+    rec.set_phase("decode")
+    rec.finish("ok")
+    fl.close(rec)
+    d = rec.to_dict()
+    assert abs(sum(d["ttft_decomposition"].values()) - d["ttft_s"]) < TOL
+    assert abs(sum(d["decomposition"].values()) - d["latency_s"]) < TOL
+    assert d["ttft_decomposition"]["preempted_s"] == 0.0
+    assert d["decomposition"]["preempted_s"] >= 0.01 - TOL
+    assert d["ttft_decomposition"]["prefill_s"] >= 0.02 - TOL
+
+
+# ---------------------------------------------------------------------------
+# Engine integration
+# ---------------------------------------------------------------------------
+
+
+def test_engine_records_lifecycle_and_decomposition(toy_model):
+    cfg, params = toy_model
+    eng = _engine(cfg, params)
+    req = eng.submit(_prompt(20), 6, trace_id="trace-life", **GKW)
+    eng.run_until_idle()
+    req.result(timeout=60)
+    recs = eng.flight.lookup("trace-life")
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["phase"] == "finished" and r["outcome"] == "ok"
+    kinds = [e["kind"] for e in r["events"]]
+    for expected in ("enqueue", "prefill", "prefill_chunk", "decode",
+                     "first_token", "ok"):
+        assert expected in kinds, f"missing {expected} in {kinds}"
+    # the acceptance bar: components sum to the measured TTFT/latency
+    assert abs(sum(r["ttft_decomposition"].values()) - r["ttft_s"]) < TOL
+    assert abs(sum(r["decomposition"].values()) - r["latency_s"]) < TOL
+    # and the engine's own TTFT agrees with the record's
+    assert abs(req.ttft - r["ttft_s"]) < 1e-3
+    assert r["prefill_compute_s"] > 0.0
+    assert r["meta"]["prompt_tokens"] == 20
+
+
+def test_engine_tokens_bitwise_identical_with_recorder_off(toy_model):
+    """Recording must be invisible to the computation: same tokens and
+    log-probs with the recorder on vs off (tracing on too)."""
+    cfg, params = toy_model
+    tracer = trace_mod.configure(capacity=4096)
+    try:
+        eng_on = _engine(cfg, params)
+        assert eng_on.flight.enabled
+        r_on = eng_on.submit(_prompt(24), 8, trace_id="parity", **GKW)
+        eng_on.run_until_idle()
+        toks_on, lps_on = r_on.result(timeout=60)
+    finally:
+        trace_mod.disable()
+    eng_off = _engine(cfg, params, flight_records=0)
+    assert not eng_off.flight.enabled
+    r_off = eng_off.submit(_prompt(24), 8, **GKW)
+    eng_off.run_until_idle()
+    toks_off, lps_off = r_off.result(timeout=60)
+    assert toks_on == toks_off
+    assert lps_on == lps_off
+    assert len(tracer) > 0  # tracing really was on for the on-arm
+
+
+def test_preemption_recorded_with_resume(toy_model):
+    cfg, params = toy_model
+    eng = _engine(cfg, params, max_slots=1)
+    victim = eng.submit(_prompt(16), 24, trace_id="victim", **GKW)
+    # tick until the victim is decoding, then force-preempt it
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        eng.step()
+        if victim._phase == "decode" and len(victim.generated) >= 2:
+            break
+    assert eng.preempt(victim)
+    eng.run_until_idle()
+    victim.result(timeout=60)
+    r = eng.flight.lookup("victim")[0]
+    kinds = [e["kind"] for e in r["events"]]
+    assert "preempted" in kinds
+    assert r["preemptions"] == 1
+    assert r["decomposition"]["preempted_s"] > 0.0
+    # resumed admission is recorded as a resume, not a fresh admit
+    resume = [e for e in r["events"] if e["kind"] == "prefill"
+              and e.get("args", {}).get("kind") == "resume"]
+    assert resume, kinds
+    assert abs(sum(r["decomposition"].values()) - r["latency_s"]) < TOL
+
+
+def test_overload_leaves_a_record(toy_model):
+    from megatron_llm_tpu.generation import EngineOverloaded
+
+    cfg, params = toy_model
+    eng = _engine(cfg, params, max_queue=1)
+    eng.submit(_prompt(8), 4, trace_id="q1", **GKW)
+    with pytest.raises(EngineOverloaded):
+        eng.submit(_prompt(8), 4, trace_id="turned-away", **GKW)
+    r = eng.flight.lookup("turned-away")[0]
+    assert r["outcome"] == "overload"
+    eng.run_until_idle()
+
+
+def test_deadline_miss_attributed_by_phase(toy_model):
+    from megatron_llm_tpu.observability import registry as obs_registry
+
+    cfg, params = toy_model
+    reg = obs_registry.get_registry()
+    eng = _engine(cfg, params)  # fcfs never sheds: the miss retires
+    req = eng.submit(_prompt(16), 2, ttft_deadline_ms=0.001,
+                     trace_id="misser", seed=1, **GKW)
+    eng.run_until_idle()
+    req.result(timeout=60)
+    rec = eng.flight.lookup("misser")[0]
+    phase = max(
+        (("queue", rec["ttft_decomposition"]["queue_wait_s"]
+          + rec["ttft_decomposition"]["preempted_s"]),
+         ("prefill", rec["ttft_decomposition"]["prefill_s"]),
+         ("decode", rec["ttft_decomposition"]["decode_s"])),
+        key=lambda kv: kv[1])[0]
+    val = reg.counter("mlt_engine_deadline_miss_total",
+                      labels={"kind": "ttft", "phase": phase}).value
+    assert val >= 1
+
+
+# ---------------------------------------------------------------------------
+# Replica server: /debug/requests + timing metadata
+# ---------------------------------------------------------------------------
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _put(url, payload, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=hdrs,
+        method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def fleet(toy_model):
+    """Two continuous-batching replicas behind real MegatronServers on
+    ephemeral ports (the test_router fixture shape)."""
+    from tests.test_generation import ToyTokenizer
+
+    cfg, params = toy_model
+    servers, urls = [], []
+    for _ in range(2):
+        engine = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                          max_slots=4, max_seq=128)
+        srv = MegatronServer(engine)
+        port = srv.start_background(port=0)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{port}")
+    yield servers, urls
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+
+
+def test_replica_debug_requests_and_timing(fleet):
+    servers, urls = fleet
+    tid = "replica-direct-trace"
+    code, headers, body = _put(
+        urls[0] + "/api",
+        {"prompts": ["debug me please"], "tokens_to_generate": 6,
+         "top_k": 1},
+        headers={"X-MLT-Trace-Id": tid})
+    assert code == 200
+    assert headers["X-MLT-Trace-Id"] == tid
+    timing = body["timing"]
+    assert timing["trace_id"] == tid
+    assert timing["replica_id"] == servers[0].replica_id
+    assert timing["ttft_s"] is not None
+    assert abs(float(headers["X-MLT-TTFT-S"]) - timing["ttft_s"]) < 1e-9
+    assert abs(sum(timing["ttft_decomposition"].values())
+               - timing["ttft_s"]) < TOL
+    # the flight record is served on /debug/requests, filterable
+    code, _, raw = _get(urls[0] + f"/debug/requests?trace_id={tid}")
+    assert code == 200
+    dbg = json.loads(raw)
+    assert dbg["replica_id"] == servers[0].replica_id
+    assert dbg["flight_recorder"] is True
+    assert dbg["count"] == 1
+    rec = dbg["requests"][0]
+    assert rec["trace_id"] == tid and rec["outcome"] == "ok"
+    # ?n= caps the listing
+    code, _, raw = _get(urls[0] + "/debug/requests?n=0")
+    assert json.loads(raw)["count"] == 0
+
+
+def test_replica_mints_trace_id_when_absent(fleet):
+    _, urls = fleet
+    code, headers, body = _put(
+        urls[0] + "/api",
+        {"prompts": ["no trace header"], "tokens_to_generate": 2,
+         "top_k": 1})
+    assert code == 200
+    minted = headers["X-MLT-Trace-Id"]
+    assert minted and body["timing"]["trace_id"] == minted
+
+
+def test_health_carries_ttft_ema(fleet):
+    _, urls = fleet
+    code, _, raw = _get(urls[0] + "/health")
+    sched = json.loads(raw)["scheduler"]
+    assert "ttft_ema_ms" in sched
+    assert sched["ttft_ema_ms"] is not None  # requests already served
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: one trace id across router -> replica -> engine
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_trace_id_spans_router_and_replica(fleet, tmp_path):
+    """The ISSUE 12 acceptance bar: one request's trace id appears in
+    the router tier's and the serving replica's Perfetto span dumps and
+    in both /debug/requests views, with decomposition fields summing to
+    the measured TTFT."""
+    servers, urls = fleet
+    tracer = trace_mod.configure(capacity=8192)
+    router = RouterServer(urls, policy="round_robin", poll_interval=30.0)
+    tid = "e2e-fleet-trace-0001"
+    try:
+        port = router.start_background()
+        base = f"http://127.0.0.1:{port}"
+        code, headers, body = _put(
+            base + "/api",
+            {"prompts": ["trace me across the fleet"],
+             "tokens_to_generate": 8, "top_k": 1},
+            headers={"X-MLT-Trace-Id": tid})
+        assert code == 200
+        assert headers["X-MLT-Trace-Id"] == tid
+        timing = body["timing"]
+        assert timing["trace_id"] == tid
+        assert abs(sum(timing["ttft_decomposition"].values())
+                   - timing["ttft_s"]) < TOL
+
+        # the serving replica's /debug/requests has the record...
+        serving = [s for s in servers
+                   if s.replica_id == timing["replica_id"]]
+        assert len(serving) == 1
+        direct = serving[0].debug_requests(trace_id=tid)
+        assert direct["count"] == 1
+        assert direct["requests"][0]["trace_id"] == tid
+
+        # ...and the router's fleet aggregation finds it too, keyed by
+        # replica url, without the caller knowing which replica served
+        code, _, raw = _get(base + f"/debug/requests?trace_id={tid}")
+        assert code == 200
+        agg = json.loads(raw)
+        assert agg["role"] == "router"
+        hits = [(u, rep) for u, rep in agg["fleet"].items()
+                if rep.get("count")]
+        assert len(hits) == 1
+        assert hits[0][1]["requests"][0]["trace_id"] == tid
+
+        # span correlation: the router tier's route/forward spans AND
+        # the replica tier's serve/enqueue spans carry the same id in
+        # the Perfetto dump (one process here, two server tiers — the
+        # trace_id attr is what correlates dumps across processes)
+        dump = tmp_path / "fleet_trace.json"
+        tracer.dump(str(dump), drain=False)
+        events = json.load(open(dump))["traceEvents"]
+        by_name = {}
+        for e in events:
+            if e.get("args", {}).get("trace_id") == tid:
+                by_name.setdefault(e["name"], 0)
+                by_name[e["name"]] += 1
+        for span_name in ("router-route", "router-forward", "serve-api",
+                          "engine-enqueue"):
+            assert by_name.get(span_name), (
+                f"no {span_name} span carries trace_id {tid}: {by_name}")
+
+        # honest router TTFT: the histogram observed the replica's own
+        # first-token stamp for the serving replica
+        text = router.metrics_text()
+        assert "mlt_router_ttft_seconds_bucket" in text
+    finally:
+        router.stop()
+        trace_mod.disable()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog emergency dump
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_dumps_flight_records(tmp_path):
+    from megatron_llm_tpu.resilience.watchdog import StepWatchdog
+
+    fl = FlightRecorder(capacity=8)
+    rec = fl.open("stuck-request", prompt_tokens=64)
+    rec.set_phase("prefill", kind="admit", slot=0)
+    path = str(tmp_path / "flight_watchdog.json")
+    stream = io.StringIO()
+    exits = []
+    dog = StepWatchdog(
+        min_deadline=0.05, first_deadline=0.05, multiplier=1.0,
+        flight_dump_fn=lambda: fl.dump(path),
+        exit_fn=exits.append, stream=stream,
+    ).start()
+    dog.arm(first=True)
+    deadline = time.monotonic() + 10
+    while not exits and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert exits == [43]
+    assert f"flight records dumped to {path}" in stream.getvalue()
+    doc = json.load(open(path))
+    assert doc["inflight"] == 1
+    assert doc["records"][0]["trace_id"] == "stuck-request"
+    assert doc["records"][0]["phase"] == "prefill"
+
+
+def test_watchdog_flight_fallback_text():
+    """Without a dump fn the watchdog prints the process recorder's
+    in-flight tail — a hang report names the request state either way."""
+    from megatron_llm_tpu.resilience.watchdog import StepWatchdog
+
+    fl = FlightRecorder(capacity=8)
+    fl.open("hanging", prompt_tokens=8)
+    flight_mod.set_recorder(fl)
+    stream = io.StringIO()
+    exits = []
+    try:
+        dog = StepWatchdog(
+            min_deadline=0.05, first_deadline=0.05, multiplier=1.0,
+            exit_fn=exits.append, stream=stream,
+        ).start()
+        dog.arm(first=True)
+        deadline = time.monotonic() + 10
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert exits == [43]
+        out = stream.getvalue()
+        assert "FLIGHT:" in out and "hanging" in out
+    finally:
+        flight_mod.set_recorder(None)
+
+
+# ---------------------------------------------------------------------------
+# Lock-annotation anti-vacuity (the ISSUE 10 idiom)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_rule_verifies_flight_annotations():
+    """The recorder's cross-thread state really is modeled by the
+    graftcheck lock-discipline rule — the repo sweep's cleanliness over
+    observability/flight.py is not vacuous."""
+    import ast as ast_mod
+
+    from tools.graftcheck import core
+    from tools.graftcheck.rules.locks import LockDisciplineRule
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "megatron_llm_tpu", "observability",
+                        "flight.py")
+    rule = LockDisciplineRule()
+    ctx = core.FileContext(path)
+    expected = {
+        "RequestRecord": ({"events", "phase", "phase_s", "t_first"},
+                          {"_fold_locked", "_event_locked"}),
+        "FlightRecorder": ({"_inflight", "_done", "_seq"}, set()),
+    }
+    found = set()
+    for node in ast_mod.walk(ctx.tree):
+        if isinstance(node, ast_mod.ClassDef) and node.name in expected:
+            guards, holds = expected[node.name]
+            model = rule._build(ctx, node)
+            assert model is not None, f"{node.name}: no lock model"
+            assert guards <= set(model.guards), (
+                f"{node.name} missing guards: "
+                f"{guards - set(model.guards)}")
+            assert holds <= set(model.holds), (
+                f"{node.name} missing holds: {holds - set(model.holds)}")
+            found.add(node.name)
+    assert found == set(expected)

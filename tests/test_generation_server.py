@@ -159,7 +159,10 @@ def test_batching_server_same_wire_contract(batching_server):
         "logprobs": True,
     })
     assert status == 200
-    assert set(body) == {"text", "segments", "logprobs"}
+    # ISSUE 12 extends the wire contract with server-side timing
+    # metadata (trace id, first-token time, latency decomposition)
+    assert set(body) == {"text", "segments", "logprobs", "timing"}
+    assert body["timing"]["ttft_s"] is not None
     assert len(body["logprobs"][0]) == len(body["segments"][0]) - 1
 
 

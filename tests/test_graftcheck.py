@@ -130,6 +130,26 @@ FIXTURES = {
         "        cache[k] = jax.jit(build())\n"
         "    return cache[k]\n",
     ),
+    "span-device-attr": (
+        # ISSUE 12: a jax array as a span/flight-event attr defers a
+        # host sync to dump time — flagged whether passed directly or
+        # through a name bound to a device-producing call
+        "import jax.numpy as jnp\n"
+        "from megatron_llm_tpu.observability import trace\n"
+        "def tick(x, rec):\n"
+        "    y = jnp.sum(x)\n"
+        "    with trace.span('tick', val=y):\n"
+        "        pass\n"
+        "    rec.event('spec_tick', logits=jnp.exp(x))\n",
+        "import jax.numpy as jnp\n"
+        "from megatron_llm_tpu.observability import trace\n"
+        "def tick(x, rec):\n"
+        "    y = jnp.sum(x)\n"
+        "    n = int(y)\n"
+        "    with trace.span('tick', val=n):\n"
+        "        pass\n"
+        "    rec.event('spec_tick', emitted=len(x))\n",
+    ),
     "line-length": (
         "x = 1  # " + "y" * 120 + "\n",
         "x = 1\n",

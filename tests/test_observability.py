@@ -581,7 +581,11 @@ def test_generation_server_metrics_endpoint():
                       "mlt_engine_pages_cow_copies_total",
                       # ISSUE 11: ragged-tick launch telemetry
                       "mlt_engine_tick_launches_total",
-                      "mlt_engine_prefill_tokens_per_tick"):
+                      "mlt_engine_prefill_tokens_per_tick",
+                      # ISSUE 12: honest TTFT decomposition histograms
+                      "mlt_engine_queue_wait_seconds",
+                      "mlt_engine_prefill_compute_seconds",
+                      "mlt_engine_preempted_seconds"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
         # /health still answers alongside

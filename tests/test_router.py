@@ -623,6 +623,11 @@ def test_e2e_routed_responses_token_identical_to_direct(fleet):
             code, routed = _put(base + "/api", payload)
             assert code == 200
             direct = [_put(u + "/api", payload)[1] for u in urls]
+            # the timing block (ISSUE 12) is per-serve metadata — wall
+            # clocks and trace ids legitimately differ per request; the
+            # generation payload must not
+            for b in (routed, *direct):
+                assert b.pop("timing", None) is not None
             assert routed == direct[0] == direct[1], (
                 "routing changed the tokens")
     finally:
@@ -654,11 +659,15 @@ def test_e2e_failover_mid_fleet_zero_dropped(fleet):
         payload = {"prompts": ["failover determinism probe"], **GEN}
         code, before = _put(base + "/api", payload)
         assert code == 200
+        before.pop("timing", None)  # per-serve metadata (ISSUE 12)
         victim_srv.stop()  # refuse new connections from here on
         results = [None] * 6
 
         def worker(i):
-            results[i] = _put(base + "/api", payload)
+            code_i, body_i = _put(base + "/api", payload)
+            if isinstance(body_i, dict):
+                body_i.pop("timing", None)  # per-serve metadata
+            results[i] = (code_i, body_i)
 
         threads = [threading.Thread(target=worker, args=(i,))
                    for i in range(len(results))]

@@ -439,4 +439,7 @@ def test_spec_metrics_and_health(models):
         plain = _engine(cfg, params)
         assert MegatronServer(plain).health()["spec"] == {"enabled": False}
     finally:
-        obs_registry.set_publishing(False)
+        # restore the PROCESS DEFAULT (publishing on) — restoring False
+        # left every later-ordered test with a dead registry (latent
+        # order dependence, exposed by non-alphabetical test selection)
+        obs_registry.set_publishing(True)

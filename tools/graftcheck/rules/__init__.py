@@ -16,7 +16,11 @@ from tools.graftcheck.rules.style import (
     TodoOwnerRule,
     TrailingWhitespaceRule,
 )
-from tools.graftcheck.rules.sync import ObsNoSyncRule, SyncInJitRule
+from tools.graftcheck.rules.sync import (
+    ObsNoSyncRule,
+    SpanDeviceAttrRule,
+    SyncInJitRule,
+)
 
 # ported from the regex linter (now scope-aware) ........ then the new
 # invariant analyzers, then lexical hygiene
@@ -25,6 +29,7 @@ ALL_RULES = [
     ObsNoSyncRule(),
     NoDirectShardMapRule(),
     SyncInJitRule(),
+    SpanDeviceAttrRule(),
     LockDisciplineRule(),
     RngKeyReuseRule(),
     RecompileHazardRule(),

@@ -15,6 +15,15 @@ as shard_map bodies.  Under a tracer these either leak (ConcretizationTypeError
 at best) or insert a hidden host-device sync that serializes the exact
 dispatch pipeline PR 2 and PR 4 built; the Megatron-LM scaling result
 (PAPERS.md) assumes the hot loop never blocks on the host.
+
+``span-device-attr`` (ISSUE 12): no device-array-valued attributes on
+``span()``/``instant()`` calls or flight-recorder ``event()`` calls.
+The tracer and the flight recorder hold attrs by reference and
+serialize them at DUMP time — a jax array smuggled in as an attr defers
+a host-device sync to exactly the moment an operator asks for the
+timeline, and keeps device buffers alive for the life of the ring.
+Attrs must be host scalars: hoist the value out with ``int()``/
+``float()``/``np.asarray`` *outside* any traced code first.
 """
 
 from __future__ import annotations
@@ -199,3 +208,91 @@ class SyncInJitRule(Rule):
                 if key not in seen:
                     seen.add(key)
                     yield f
+
+
+# ---------------------------------------------------------------------------
+# span-device-attr
+# ---------------------------------------------------------------------------
+
+# recording entry points whose KEYWORD attrs are serialized at dump time:
+# trace spans/instants (observability/trace.py) and flight-recorder
+# events (observability/flight.py — event / set_phase / finish)
+_ATTR_SINKS = {"span", "instant", "event", "set_phase", "finish"}
+# call-qualname prefixes that produce device arrays
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.")
+# ...except the jax spellings that are host-side by construction
+_HOST_CALLS = {"jax.named_scope", "jax.debug.print"}
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    qn = qualname(node.func)
+    if qn is None or qn in _HOST_CALLS:
+        return False
+    return any(qn == p[:-1] or qn.startswith(p) for p in _DEVICE_PREFIXES)
+
+
+class SpanDeviceAttrRule(Rule):
+    id = "span-device-attr"
+    summary = ("device-array attrs on span()/instant()/flight-recorder "
+               "events (forces a host sync at dump time)")
+
+    def _tainted(self, fn: ast.AST) -> Set[str]:
+        """Names bound (anywhere in ``fn``) to a device-producing call.
+        Deliberately flow-insensitive: a name that EVER holds a device
+        array in the function should not be an event attr under any
+        branch."""
+        tainted: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_device_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                tainted.add(el.id)
+        return tainted
+
+    def _scope_of(self, ctx: FileContext, node: ast.AST) -> ast.AST:
+        """Nearest enclosing function (the taint scope), else the
+        module."""
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = ctx.parent(cur)
+        return ctx.tree
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        taint_cache: Dict[ast.AST, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if name not in _ATTR_SINKS or not node.keywords:
+                continue
+            scope = self._scope_of(ctx, node)
+            if scope not in taint_cache:
+                taint_cache[scope] = self._tainted(scope)
+            tainted = taint_cache[scope]
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                bad = (_is_device_call(kw.value)
+                       or (isinstance(kw.value, ast.Name)
+                           and kw.value.id in tainted))
+                if bad:
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"attr {kw.arg!r} on {name}() is a device "
+                        f"array — the tracer/flight recorder "
+                        f"serializes attrs at dump time, forcing a "
+                        f"host sync then; record a host scalar "
+                        f"instead (int()/float() outside traced "
+                        f"code)")

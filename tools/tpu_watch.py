@@ -62,6 +62,20 @@ def _kernel_check_on_tpu(tail: str) -> bool:
     return "backend: tpu" in tail or "backend: TPU" in tail
 
 
+def _drift_ran(out: str) -> bool:
+    """Did the drift detector RUN?  bench_drift.py prints one JSON
+    verdict line and exits 0 (ok) / 1 (drift); either is captured —
+    drift is a finding to bisect, not a retryable failure.  Only a crash
+    (exit 2, no parseable verdict) should be retried."""
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return rec.get("bench_drift") == 1 and "verdict" in rec
+    return False
+
+
 def _graftcheck_ran(out: str) -> bool:
     """Did the analyzer RUN (clean or with findings)?  graftcheck --json
     prints a one-line summary and exits 0/1; a crash exits 2 with no
@@ -110,6 +124,12 @@ JOBS = [
      [sys.executable, "-m", "tools.graftcheck", "megatron_llm_tpu",
       "tools", "tasks", "tests", "--json"],
      True, _graftcheck_ran),
+    # ISSUE 12: bench-trajectory drift check right next to the static
+    # analysis — seconds, no TPU needed, and it reads only committed
+    # evidence.  The ROADMAP item-4 CPU-sanity drift (18.4s -> 52.2s
+    # step) trips it by design until someone bisects and fixes it.
+    ("bench_drift", [sys.executable, "tools/bench_drift.py"],
+     True, _drift_ran),
     ("kernel_check", [sys.executable, "tools/tpu_kernel_check.py", "--quick"],
      True, _kernel_check_on_tpu),
     # VERDICT round-4 item 4 promoted the sweep above the decode pair: the
