@@ -231,6 +231,15 @@ class TrainingConfig:
     # placed on device up to this many steps ahead of the consuming step.
     # 0 = pull + place inline on the critical path (legacy behavior).
     prefetch_depth: int = 2
+    # EQuARX-style int8 chunk-quantized DP gradient all-reduce
+    # (parallel/quantized.py, ISSUE 13): explicit
+    # quantize -> reduce-scatter -> dequant-accumulate -> all-gather sync
+    # replacing the implicit bf16 all-reduce on dp-pure meshes (dp > 1,
+    # tp == pp == cp == ep == 1).  OFF by default — the bf16 sync path is
+    # untouched; the loss-delta gate vs bf16 sync lives in
+    # tests/test_kv_quant.py and docs/guide/quantization.md documents the
+    # accepted delta and when NOT to enable this.
+    quantized_grad_allreduce: bool = False
 
 
 @dataclass
@@ -412,6 +421,13 @@ class InferenceConfig:
     page_size: int = 16
     kv_pool_pages: Optional[int] = None
     engine_max_seq: Optional[int] = None
+    # quantized paged KV cache (ISSUE 13, ops/kv_quant.py): --kv_dtype
+    # bf16|int8|fp8 picks the pool storage.  bf16 (default) is today's
+    # engine byte for byte; int8/fp8 store pages with per-page, per-head
+    # symmetric absmax scales for ~2x concurrent slots / prefix-cache
+    # capacity / speculative headroom at fixed pool bytes — target AND
+    # draft caches together (docs/guide/quantization.md "KV cache")
+    kv_dtype: str = "bf16"
     # prefix cache + chunked prefill (ISSUE 5): shared refcounted prompt
     # pages with copy-on-write, prefill split into --prefill_chunk-token
     # chunks interleaved one per decode tick (0 = monolithic PR-1 prefill,

@@ -122,6 +122,7 @@ from megatron_llm_tpu.models.language_model import (
     make_rope_cache,
     model_forward,
 )
+from megatron_llm_tpu.ops import kv_quant
 from megatron_llm_tpu.ops.paged_attention import PagedState
 
 NULL_PAGE = 0
@@ -175,16 +176,32 @@ class PagedKVPool:
     """
 
     def __init__(self, cfg, num_pages: int, page_size: int, dtype=None,
-                 mesh: Optional[Mesh] = None, draft_cfg=None):
+                 mesh: Optional[Mesh] = None, draft_cfg=None,
+                 kv_dtype: str = "bf16"):
         m = cfg.model
         dtype = dtype or _compute_dtype(cfg)
+        assert kv_dtype in kv_quant.KV_DTYPES, (
+            f"kv_dtype must be one of {kv_quant.KV_DTYPES}, got {kv_dtype!r}")
+        # --kv_dtype (ISSUE 13): "bf16" keeps plain compute-dtype arrays —
+        # byte-for-byte today's pool, every bitwise parity suite intact;
+        # int8/fp8 store QuantPagedKV containers (values + per-page,
+        # per-head scales, ops/kv_quant.py) for ~2x pages per chip.
+        self.kv_dtype = kv_dtype
+        self.compute_dtype = dtype
         shape = (m.num_layers, num_pages, page_size,
                  m.num_attention_heads_kv, m.kv_channels)
+
+        def _make(shp):
+            return kv_quant.make_pool(shp, kv_dtype, dtype)
+
         # Tensor parallelism shards the pool over the KV-heads dim (each tp
         # rank attends its own heads — the same decomposition as the qkv
         # column-parallel rule in parallel/tp.py). Block tables and the
         # allocator below stay host-side and apply to every shard alike;
         # tp=1 (or no mesh) degrades to a single-device replicated pool.
+        # Quantized pools shard the scale leaf over the same heads dim
+        # ([L, P, nkv] -> tp on nkv), so a page's values and its scales
+        # always live on the same shard.
         self.mesh = mesh
         tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
         if tp > 1:
@@ -193,13 +210,16 @@ class PagedKVPool:
                 f"tp {tp}")
             self.kv_sharding = NamedSharding(
                 mesh, P(None, None, None, TP_AXIS, None))
-            self.k = jax.device_put(jnp.zeros(shape, dtype), self.kv_sharding)
-            self.v = jax.device_put(jnp.zeros(shape, dtype), self.kv_sharding)
+            self._scale_sharding = NamedSharding(
+                mesh, P(None, None, TP_AXIS))
+            self.k = self._place(_make(shape))
+            self.v = self._place(_make(shape))
         else:
             self.kv_sharding = (NamedSharding(mesh, P())
                                 if mesh is not None else None)
-            self.k = jnp.zeros(shape, dtype)
-            self.v = jnp.zeros(shape, dtype)
+            self._scale_sharding = self.kv_sharding
+            self.k = _make(shape)
+            self.v = _make(shape)
         self.draft_cfg = draft_cfg
         self.draft_k = self.draft_v = None
         if draft_cfg is not None:
@@ -207,17 +227,19 @@ class PagedKVPool:
             ddtype = _compute_dtype(draft_cfg)
             dshape = (dm.num_layers, num_pages, page_size,
                       dm.num_attention_heads_kv, dm.kv_channels)
+
+            def _make_d(shp):
+                return kv_quant.make_pool(shp, kv_dtype, ddtype)
+
             if tp > 1:
                 assert dm.num_attention_heads_kv % tp == 0, (
                     f"draft kv heads {dm.num_attention_heads_kv} not "
                     f"divisible by tp {tp}")
-                self.draft_k = jax.device_put(
-                    jnp.zeros(dshape, ddtype), self.kv_sharding)
-                self.draft_v = jax.device_put(
-                    jnp.zeros(dshape, ddtype), self.kv_sharding)
+                self.draft_k = self._place(_make_d(dshape))
+                self.draft_v = self._place(_make_d(dshape))
             else:
-                self.draft_k = jnp.zeros(dshape, ddtype)
-                self.draft_v = jnp.zeros(dshape, ddtype)
+                self.draft_k = _make_d(dshape)
+                self.draft_v = _make_d(dshape)
         self.num_pages = num_pages
         self.page_size = page_size
         self.refcounts = np.zeros((num_pages,), np.int32)
@@ -227,6 +249,55 @@ class PagedKVPool:
         self.evict_hook = None  # PrefixCache.evict: (n) -> freed page list
         # page 0 reserved as the null page (never allocated)
         self._free: deque = deque(range(1, num_pages))
+
+    def _place(self, pool):
+        """device_put a pool (plain array or QuantPagedKV) under the tp
+        sharding — values over the heads dim, scales over their heads
+        dim."""
+        if kv_quant.is_quantized(pool):
+            return jax.device_put(pool, kv_quant.QuantPagedKV(
+                q=self.kv_sharding, scale=self._scale_sharding))
+        return jax.device_put(pool, self.kv_sharding)
+
+    @property
+    def kv_statics(self) -> Tuple:
+        """Compiled-program cache-key component for the KV storage mode
+        (ISSUE 13): kv-quantization mode, storage dtype AND scale dtype —
+        an int8 engine must never reuse a bf16 executable (and vice
+        versa), and a future scale-dtype change re-keys too.  Replaces
+        the old ``str(pool.k.dtype)`` key entry, which could not tell a
+        container apart from its storage array."""
+        if kv_quant.is_quantized(self.k):
+            return ("kv", self.kv_dtype, str(self.k.q.dtype),
+                    str(self.k.scale.dtype))
+        return ("kv", self.kv_dtype, str(self.k.dtype))
+
+    @property
+    def draft_kv_statics(self) -> Tuple:
+        if self.draft_k is None:
+            return ("draft_kv", None)
+        if kv_quant.is_quantized(self.draft_k):
+            return ("draft_kv", self.kv_dtype, str(self.draft_k.q.dtype),
+                    str(self.draft_k.scale.dtype))
+        return ("draft_kv", self.kv_dtype, str(self.draft_k.dtype))
+
+    def kv_pool_bytes(self) -> int:
+        """Device bytes of the KV value storage, target + draft caches —
+        the fixed budget the capacity bench holds constant while the
+        kv_dtype varies (published as ``mlt_engine_kv_pool_bytes``)."""
+        n = kv_quant.pool_nbytes(self.k) + kv_quant.pool_nbytes(self.v)
+        if self.draft_k is not None:
+            n += (kv_quant.pool_nbytes(self.draft_k)
+                  + kv_quant.pool_nbytes(self.draft_v))
+        return n
+
+    def kv_scale_bytes(self) -> int:
+        """Per-page scale overhead bytes (0 for bf16)."""
+        n = kv_quant.scale_nbytes(self.k) + kv_quant.scale_nbytes(self.v)
+        if self.draft_k is not None:
+            n += (kv_quant.scale_nbytes(self.draft_k)
+                  + kv_quant.scale_nbytes(self.draft_v))
+        return n
 
     @property
     def num_free(self) -> int:
@@ -487,6 +558,7 @@ class ContinuousBatchingEngine:
                  prefill_budget: Optional[int] = None,
                  flight_records: Optional[int] = None,
                  flight_events: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -624,8 +696,16 @@ class ContinuousBatchingEngine:
         self.pages_per_seq = -(-self.max_seq // self.page_size)
         num_pages = (num_pages or inf.kv_pool_pages
                      or self.max_slots * self.pages_per_seq + 1)
+        # quantized paged KV (ISSUE 13, ops/kv_quant.py): int8/fp8 pages
+        # with per-page scales multiply the concurrent slots a fixed pool
+        # byte budget carries; bf16 (default) is byte-for-byte today's
+        # engine.  Target AND draft caches quantize together — one flag,
+        # one storage discipline for every page.
+        self.kv_dtype = (kv_dtype if kv_dtype is not None
+                         else getattr(inf, "kv_dtype", "bf16"))
         self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh,
-                                draft_cfg=self.draft_cfg)
+                                draft_cfg=self.draft_cfg,
+                                kv_dtype=self.kv_dtype)
         # the prefix cache needs the block-table prefill path: a monolithic
         # dense prefill recomputes and rewrites the whole prompt, shared
         # pages included
@@ -688,6 +768,10 @@ class ContinuousBatchingEngine:
         # count — the single-launch claim tests assert on.
         self.tick_launches = 0
         self.last_tick_launches = 0
+        # capacity telemetry (ISSUE 13): the high-water mark of
+        # concurrently-decoding slots — THE "concurrent users per chip"
+        # number the fixed-pool-bytes capacity bench and /health report
+        self.peak_active_slots = 0  # guarded by _lock
         self.prefill_tokens_computed = 0  # rows pushed through prefill
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
@@ -832,6 +916,19 @@ class ContinuousBatchingEngine:
         reg.gauge("mlt_engine_pool_pages",
                   help="allocatable KV pool pages (null page excluded)"
                   ).set(self.pool.num_pages - 1)
+        # quantized-KV capacity telemetry (ISSUE 13): the byte budget the
+        # pool occupies (values, target + draft) and the per-page scale
+        # overhead, so capacity dashboards and the router can reason in
+        # bytes; the kv_dtype info gauge names the storage mode
+        reg.gauge("mlt_engine_kv_pool_bytes",
+                  help="device bytes of KV value storage (target + draft)"
+                  ).set(self.pool.kv_pool_bytes())
+        reg.gauge("mlt_engine_kv_scale_bytes",
+                  help="device bytes of per-page quantization scales "
+                       "(0 for bf16)").set(self.pool.kv_scale_bytes())
+        reg.gauge("mlt_engine_kv_dtype_info",
+                  help="KV storage mode (value always 1)",
+                  labels={"kv_dtype": self.kv_dtype}).set(1)
         if mesh is not None:
             for ax, size in dict(mesh.shape).items():
                 reg.gauge("mlt_mesh_axis_size", help="mesh axis size",
@@ -895,7 +992,7 @@ class ContinuousBatchingEngine:
 
         statics = ("engine_tick", self.max_slots, self.pages_per_seq,
                    self.page_size, self.pool.num_pages,
-                   str(self.pool.k.dtype), self._mesh_statics)
+                   self.pool.kv_statics, self._mesh_statics)
         self._tick_fn = gen.cached_jit(
             self.cfg, "engine_tick", statics, lambda: tick,
             donate_argnums=(1, 2))
@@ -915,9 +1012,9 @@ class ContinuousBatchingEngine:
 
         statics = ("engine_spec_tick", self.max_slots, self.pages_per_seq,
                    self.page_size, self.pool.num_pages,
-                   str(self.pool.k.dtype), self.spec_k,
+                   self.pool.kv_statics, self.spec_k,
                    gen.config_fingerprint(self.draft_cfg),
-                   str(self.pool.draft_k.dtype), self._mesh_statics)
+                   self.pool.draft_kv_statics, self._mesh_statics)
         self._spec_tick_fn = gen.cached_jit(
             self.cfg, "engine_spec_tick", statics,
             lambda: make_ragged_tick_fn(self.cfg, self.draft_cfg,
@@ -948,10 +1045,10 @@ class ContinuousBatchingEngine:
         if self.spec_k:
             statics = ("engine_ragged_tick", self.max_slots,
                        self.pages_per_seq, self.page_size,
-                       self.pool.num_pages, str(self.pool.k.dtype),
+                       self.pool.num_pages, self.pool.kv_statics,
                        self.spec_k, pre_rows, self._pre_tables_cap,
                        gen.config_fingerprint(self.draft_cfg),
-                       str(self.pool.draft_k.dtype), self._mesh_statics)
+                       self.pool.draft_kv_statics, self._mesh_statics)
             fn = gen.cached_jit(
                 self.cfg, "engine_ragged_tick", statics,
                 lambda: make_ragged_tick_fn(
@@ -961,7 +1058,7 @@ class ContinuousBatchingEngine:
         else:
             statics = ("engine_ragged_tick", self.max_slots,
                        self.pages_per_seq, self.page_size,
-                       self.pool.num_pages, str(self.pool.k.dtype),
+                       self.pool.num_pages, self.pool.kv_statics,
                        0, pre_rows, self._pre_tables_cap,
                        self._mesh_statics)
             fn = gen.cached_jit(
@@ -985,9 +1082,13 @@ class ContinuousBatchingEngine:
         nkv, d = cfg.model.num_attention_heads_kv, cfg.model.kv_channels
         page = self.page_size
         npg = s_pre // page
+        # the dense scratch cache always computes in the compute dtype;
+        # quantized pools quantize whole pages at the scatter (bf16 pools:
+        # pool dtype == compute dtype, the original expression bitwise)
+        cache_dtype = self.pool.compute_dtype
 
         def prefill(params, tokens, pool_k, pool_v, page_ids):
-            caches = gen.init_kv_caches(cfg, 1, s_pre, pool_k.dtype)
+            caches = gen.init_kv_caches(cfg, 1, s_pre, cache_dtype)
             out, (ck, cv) = model_forward(
                 cfg, params, tokens,
                 position_ids=jnp.arange(s_pre)[None, :],
@@ -997,8 +1098,8 @@ class ContinuousBatchingEngine:
             )
             pages_k = ck.reshape(L, npg, page, nkv, d)
             pages_v = cv.reshape(L, npg, page, nkv, d)
-            pool_k = pool_k.at[:, page_ids].set(pages_k)
-            pool_v = pool_v.at[:, page_ids].set(pages_v)
+            pool_k = kv_quant.scatter_whole_pages(pool_k, page_ids, pages_k)
+            pool_v = kv_quant.scatter_whole_pages(pool_v, page_ids, pages_v)
             if with_log_probs:
                 # teacher-forced prompt log-probs (api logprobs contract)
                 lp = gen._gather_token_log_probs(out[:, :-1], tokens[:, 1:])
@@ -1006,7 +1107,7 @@ class ContinuousBatchingEngine:
             return pool_k, pool_v
 
         statics = (s_pre, with_log_probs, self.page_size,
-                   self.pool.num_pages, str(self.pool.k.dtype),
+                   self.pool.num_pages, self.pool.kv_statics,
                    self._mesh_statics)
         fn = gen.cached_jit(self.cfg, "engine_prefill", statics,
                             lambda: prefill, donate_argnums=(2, 3))
@@ -1059,7 +1160,7 @@ class ContinuousBatchingEngine:
 
         statics = ("engine_prefill_chunk", rows, kv_pages, with_log_probs,
                    self.page_size, self.pool.num_pages,
-                   str(self.pool.k.dtype), self._mesh_statics)
+                   self.pool.kv_statics, self._mesh_statics)
         if self.spec_k:
             statics += ("spec", gen.config_fingerprint(draft_cfg))
             fn = gen.cached_jit(self.cfg, "engine_prefill_chunk", statics,
@@ -1078,8 +1179,15 @@ class ContinuousBatchingEngine:
             return self._copy_fn
 
         def copy(pool_k, pool_v, src, dst):
-            pool_k = pool_k.at[:, dst].set(pool_k[:, src])
-            pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+            # tree-mapped so quantized pools clone the page's scale row
+            # together with its values (plain pools: one leaf, the
+            # original expression bitwise) — a COW page is byte-identical
+            # to its source in BOTH leaves, so the refeed rewrite sees
+            # exactly the shared page's quantization state
+            pool_k = jax.tree.map(
+                lambda a: a.at[:, dst].set(a[:, src]), pool_k)
+            pool_v = jax.tree.map(
+                lambda a: a.at[:, dst].set(a[:, src]), pool_v)
             return pool_k, pool_v
 
         def copy_spec(pool_k, pool_v, draft_k, draft_v, src, dst):
@@ -1090,7 +1198,7 @@ class ContinuousBatchingEngine:
             return pool_k, pool_v, draft_k, draft_v
 
         statics = ("engine_copy_page", self.pool.num_pages, self.page_size,
-                   str(self.pool.k.dtype), self._mesh_statics)
+                   self.pool.kv_statics, self._mesh_statics)
         if self.spec_k:
             statics += ("spec", gen.config_fingerprint(self.draft_cfg))
             self._copy_fn = gen.cached_jit(
@@ -2007,6 +2115,8 @@ class ContinuousBatchingEngine:
                 self._note_launches_locked(
                     did_prefill, self.prefill_tokens_computed - pre0)
                 return did_prefill
+            self.peak_active_slots = max(self.peak_active_slots,
+                                         len(active))
             bt, pos, toks, keys, steps, temp, tk, tp = \
                 self._dev_state_locked()
 
@@ -2205,6 +2315,8 @@ class ContinuousBatchingEngine:
                         len(self.cache) if self.cache else 0)
                 self._publish_queued_locked()
                 return did_lp
+            self.peak_active_slots = max(self.peak_active_slots,
+                                         len(active))
             bt, pos, toks, keys, steps, temp, tk, tp = \
                 self._dev_state_locked()
 

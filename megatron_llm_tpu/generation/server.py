@@ -358,6 +358,7 @@ class MegatronServer:
                 cache = getattr(eng, "cache", None)
                 info.update(
                     active_slots=sum(r is not None for r in eng._slots),
+                    peak_active_slots=eng.peak_active_slots,
                     max_slots=eng.max_slots,
                     queued=len(eng._queue),
                     prefilling=sum(
@@ -371,6 +372,12 @@ class MegatronServer:
                     prefix_miss_tokens=eng.prefix_miss_tokens,
                     ticks=eng.ticks,
                     page_size=eng.page_size,
+                    # quantized paged KV (ISSUE 13): storage mode + byte
+                    # budget, so the router can route capacity-aware in
+                    # bytes rather than pages of unknown width
+                    kv_dtype=getattr(eng, "kv_dtype", "bf16"),
+                    kv_pool_bytes=eng.pool.kv_pool_bytes(),
+                    kv_scale_bytes=eng.pool.kv_scale_bytes(),
                 )
             mesh = getattr(eng, "mesh", None)
             info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}

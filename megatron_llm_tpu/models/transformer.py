@@ -232,6 +232,7 @@ def attention_sublayer(
         # (ops/paged_attention.py). Inactive slots' block tables point at
         # the reserved null page 0, so their writes land in garbage that is
         # never attended.
+        from megatron_llm_tpu.ops import kv_quant
         from megatron_llm_tpu.ops.paged_attention import (
             paged_attention_decode,
             paged_attention_prefill,
@@ -239,7 +240,7 @@ def attention_sublayer(
         )
 
         pk, pv = kv_cache
-        page_size = pk.shape[1]
+        page_size = kv_quant.page_size_of(pk)
         pos = paged.positions
         # ragged compressed tables (ISSUE 11): block_tables holds the
         # tick's UNIQUE tables and table_index maps rows onto them; the
@@ -256,8 +257,11 @@ def attention_sublayer(
                              row_tables.shape[1] - 1)
         page_ids = jnp.take_along_axis(row_tables, page_slot, axis=1)
         offs = wpos % page_size
-        pk = pk.at[page_ids, offs].set(k.astype(pk.dtype))
-        pv = pv.at[page_ids, offs].set(v.astype(pv.dtype))
+        # plain pools: the original scatter, byte for byte; quantized
+        # pools (--kv_dtype int8/fp8): page-granular quantizing write
+        # with per-page, per-head scales (ops/kv_quant.paged_write)
+        pk = kv_quant.paged_write(pk, page_ids, offs, k)
+        pv = kv_quant.paged_write(pv, page_ids, offs, v)
         new_cache = (pk, pv)
         if s == 1 and paged.horizons is not None:
             # ragged tick (ISSUE 11): one launch for a mixed

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.ops import attention as attn_ops
+from megatron_llm_tpu.ops import kv_quant
 
 
 class PagedState(NamedTuple):
@@ -66,12 +67,17 @@ class PagedState(NamedTuple):
     table_index: Optional[jax.Array] = None  # [R] int32 into block_tables
 
 
-def paged_gather_kv(k_pool: jax.Array, v_pool: jax.Array,
-                    block_tables: jax.Array):
+def paged_gather_kv(k_pool, v_pool, block_tables: jax.Array,
+                    dtype=None):
     """Dense [b, max_pages*page_size, nkv, d] view of each row's pages.
 
     The fallback's materialized gather — the tensor the Pallas kernel
-    exists to avoid."""
+    exists to avoid.  Quantized pools (ops/kv_quant.QuantPagedKV)
+    dequantize at the gather, into ``dtype`` (the query/compute dtype);
+    plain pools return the original gather bitwise."""
+    if kv_quant.is_quantized(k_pool):
+        return (kv_quant.dequant_gather(k_pool, block_tables, dtype),
+                kv_quant.dequant_gather(v_pool, block_tables, dtype))
     b = block_tables.shape[0]
     nkv, d = k_pool.shape[-2], k_pool.shape[-1]
     k_all = k_pool[block_tables].reshape(b, -1, nkv, d)
@@ -111,7 +117,7 @@ def paged_attention_decode(
             scale=scale, sliding_window=sliding_window,
         )
 
-    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables)
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables, q.dtype)
     kv_len = k_all.shape[1]
     kv_pos = jnp.arange(kv_len)[None, :]
     allowed = kv_pos <= positions[:, None]
@@ -181,9 +187,10 @@ def paged_attention_ragged(
     # xla_attention decode fallback (same contractions, same per-(row,
     # table) reduction order; only the batching layout moves)
     T = tables.shape[0]
-    nkv = k_pool.shape[2]
+    nkv = (k_pool.q if kv_quant.is_quantized(k_pool) else k_pool).shape[2]
     g = n // nkv
-    k_all, v_all = paged_gather_kv(k_pool, v_pool, tables)  # [T, kv, nkv, d]
+    # [T, kv, nkv, d]
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, tables, q.dtype)
     kv_len = k_all.shape[1]
     qg = q.reshape(b, 1, nkv, g, d)
     # [R, T, nkv, g, 1, kv] — the decode fallback's "bqhgd,bkhd->bhgqk"
@@ -249,7 +256,7 @@ def paged_attention_prefill(
             scale=scale, sliding_window=sliding_window,
         )
 
-    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables)
+    k_all, v_all = paged_gather_kv(k_pool, v_pool, block_tables, q.dtype)
     kv_len = k_all.shape[1]
     q_pos = start[:, None, None] + jnp.arange(s)[None, :, None]  # [b, s, 1]
     kv_pos = jnp.arange(kv_len)[None, None, :]
@@ -261,13 +268,13 @@ def paged_attention_prefill(
         q, k_all, v_all, bias=bias[:, None, :, :], scale=scale)
 
 
-def _kernel_ok(q: jax.Array, k_pool: jax.Array) -> bool:
+def _kernel_ok(q: jax.Array, k_pool) -> bool:
     """Kernel dispatch predicate — mirrors ops/attention.attention: TPU
     compile target, supported head_dim, lane-aligned page."""
     from megatron_llm_tpu.core.parallel_state import target_platform
 
     d = q.shape[-1]
-    page_size = k_pool.shape[1]
+    page_size = kv_quant.page_size_of(k_pool)
     try:
         from megatron_llm_tpu.ops.pallas import paged_attention  # noqa: F401
     except ImportError:

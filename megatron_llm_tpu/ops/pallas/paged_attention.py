@@ -22,6 +22,16 @@ expansion).
 
 Numerics match the fallback: fp32 logits/softmax/accumulator, outputs cast
 to the query dtype.
+
+Quantized pools (ops/kv_quant.QuantPagedKV, ``--kv_dtype int8/fp8``): the
+page blocks arrive in their storage dtype and each grid step additionally
+receives that (page, kv-head)'s scale as a ``[1, 1]`` block — the
+int8/fp8 -> fp32 cast and the scale multiply happen right after the page
+DMA, inside the same step that consumes the page, so HBM traffic is the
+quantized bytes (the whole point: ~2x the pages per chip at the same
+bandwidth).  The online-softmax math is unchanged — dequantized pages
+enter the identical fp32 score/accumulate pipeline, matching the jnp
+fallback's dequantize-at-gather numerics.
 """
 
 from __future__ import annotations
@@ -34,27 +44,37 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from megatron_llm_tpu.ops import kv_quant
+
 NEG_INF = -1e30
+
+
+def _split_quant(k_pool, v_pool):
+    """(k_arr, v_arr, k_scale, v_scale) — scales are None for plain
+    pools.  The wrappers pass scales as extra [1, 1]-blocked operands so
+    the kernels dequantize in-register after the page DMA."""
+    if kv_quant.is_quantized(k_pool):
+        return k_pool.q, v_pool.q, k_pool.scale, v_pool.scale
+    return k_pool, v_pool, None, None
 
 
 def _decode_kernel(
     # scalar prefetch
     bt_ref,      # [b, max_pages] int32 block tables
     pos_ref,     # [b] int32 query positions
-    # tensor refs
-    q_ref,       # block [1, 1, g, d]
-    k_ref,       # block [1, page, 1, d]
-    v_ref,       # block [1, page, 1, d]
-    o_ref,       # block [1, 1, g, d]
-    # scratch
-    m_s,         # [g, 1] fp32 running max
-    l_s,         # [g, 1] fp32 normalizer
-    acc_s,       # [g, d] fp32 accumulator
-    *,
+    # tensor refs: q, k-page, v-page [, k-scale, v-scale], out + scratch
+    # (quantized pools add two [1, 1] scale blocks — see _split_quant)
+    *refs,
     scale: float,
     page_size: int,
     sliding_window: Optional[int],
+    quantized: bool = False,
 ):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
+        ks_ref = vs_ref = None
     i = pl.program_id(0)
     j = pl.program_id(2)
     first = j * page_size
@@ -75,6 +95,10 @@ def _decode_kernel(
     def _step():
         q = q_ref[0, 0].astype(jnp.float32) * scale   # [g, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        if quantized:
+            # dequant fused into the page step: the DMA moved int8/fp8,
+            # the cast+scale happen here in-register
+            k = k * ks_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [g, page]
@@ -94,6 +118,8 @@ def _decode_kernel(
         l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
         m_s[:, 0] = m_cur
         v = v_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        if quantized:
+            v = v * vs_ref[0, 0]
         acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -109,20 +135,13 @@ def _prefill_kernel(
     # scalar prefetch
     bt_ref,      # [b, kv_pages] int32 block tables (chunk horizon)
     pos_ref,     # [b] int32 position of the chunk's first query
-    # tensor refs
-    q_ref,       # block [1, 1, s*g, d] — chunk queries, kv-head-major
-    k_ref,       # block [1, page, 1, d]
-    v_ref,       # block [1, page, 1, d]
-    o_ref,       # block [1, 1, s*g, d]
-    # scratch
-    m_s,         # [s*g, 1] fp32 running max
-    l_s,         # [s*g, 1] fp32 normalizer
-    acc_s,       # [s*g, d] fp32 accumulator
-    *,
+    # tensor refs: q [1,1,s*g,d], k/v pages [, k/v scales], out + scratch
+    *refs,
     scale: float,
     page_size: int,
     group: int,
     sliding_window: Optional[int],
+    quantized: bool = False,
 ):
     """Chunked-prefill sibling of :func:`_decode_kernel`: same grid layout
     and online-softmax page loop, but ``s*group`` query rows per
@@ -130,6 +149,11 @@ def _prefill_kernel(
     — the causal mask is per ROW, not per sequence.  Pages past the LAST
     query's position are skipped; rows whose own position is below a page
     mask it off inside the page step."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
+        ks_ref = vs_ref = None
     i = pl.program_id(0)
     j = pl.program_id(2)
     first = j * page_size
@@ -154,6 +178,8 @@ def _prefill_kernel(
     def _step():
         q = q_ref[0, 0].astype(jnp.float32) * scale    # [rows, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)       # [page, d]
+        if quantized:
+            k = k * ks_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [rows, page]
@@ -173,6 +199,8 @@ def _prefill_kernel(
         l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
         m_s[:, 0] = m_cur
         v = v_ref[0, :, 0, :].astype(jnp.float32)       # [page, d]
+        if quantized:
+            v = v * vs_ref[0, 0]
         acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -194,25 +222,23 @@ def _ragged_kernel(
     idx_ref,     # [R] int32 row -> table
     pos_ref,     # [R] int32 query positions
     hor_ref,     # [R] int32 kv horizons (tokens, 0 = dead row)
-    # tensor refs
-    q_ref,       # block [1, 1, g, d]
-    k_ref,       # block [1, page, 1, d]
-    v_ref,       # block [1, page, 1, d]
-    o_ref,       # block [1, 1, g, d]
-    # scratch
-    m_s,         # [g, 1] fp32 running max
-    l_s,         # [g, 1] fp32 normalizer
-    acc_s,       # [g, d] fp32 accumulator
-    *,
+    # tensor refs: q, k-page, v-page [, k-scale, v-scale], out + scratch
+    *refs,
     scale: float,
     page_size: int,
     sliding_window: Optional[int],
+    quantized: bool = False,
 ):
     """Ragged sibling of :func:`_decode_kernel`: one query row per grid
     step, same online-softmax page walk, but the page loop is bounded by
     the row's own data-carried horizon — a dead row (horizon 0, the fixed
     batch's padding) touches no page at all, and the accumulated work per
     row scales with that row's context, not the widest row's."""
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s = refs
+        ks_ref = vs_ref = None
     i = pl.program_id(0)
     j = pl.program_id(2)
     first = j * page_size
@@ -235,6 +261,8 @@ def _ragged_kernel(
     def _step():
         q = q_ref[0, 0].astype(jnp.float32) * scale   # [g, d]
         k = k_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        if quantized:
+            k = k * ks_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [g, page]
@@ -252,6 +280,8 @@ def _ragged_kernel(
         l_s[:, 0] = alpha * l_s[:, 0] + jnp.sum(p, axis=1)
         m_s[:, 0] = m_cur
         v = v_ref[0, :, 0, :].astype(jnp.float32)      # [page, d]
+        if quantized:
+            v = v * vs_ref[0, 0]
         acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -285,8 +315,10 @@ def paged_ragged_kernel(
     index map, with (position, horizon) scalar-prefetched alongside.
     All four operands are traced data — composition changes re-dispatch
     the same executable, never recompile."""
+    k_arr, v_arr, k_scale, v_scale = _split_quant(k_pool, v_pool)
+    quantized = k_scale is not None
     b, _, n, d = q.shape
-    num_pages, page_size, nkv, _ = k_pool.shape
+    num_pages, page_size, nkv, _ = k_arr.shape
     assert n % nkv == 0
     g = n // nkv
     max_pages = tables.shape[1]
@@ -296,21 +328,30 @@ def paged_ragged_kernel(
 
     kernel = functools.partial(
         _ragged_kernel, scale=scale, page_size=page_size,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, quantized=quantized,
     )
+    page_spec = pl.BlockSpec((1, page_size, 1, d),
+                             lambda i, h, j, tbl, idx, pos, hor:
+                             (tbl[idx[i], j], 0, h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda i, h, j, tbl, idx, pos, hor: (i, h, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_arr, v_arr]
+    if quantized:
+        # per-(page, head) dequant scale rides the page DMA as a [1, 1]
+        # block — the cast+multiply fuse into the page step
+        scale_spec = pl.BlockSpec((1, 1),
+                                  lambda i, h, j, tbl, idx, pos, hor:
+                                  (tbl[idx[i], j], h))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d),
-                         lambda i, h, j, tbl, idx, pos, hor: (i, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, tbl, idx, pos, hor:
-                         (tbl[idx[i], j], 0, h, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, tbl, idx, pos, hor:
-                         (tbl[idx[i], j], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d),
                                lambda i, h, j, tbl, idx, pos, hor:
                                (i, h, 0, 0)),
@@ -327,7 +368,7 @@ def paged_ragged_kernel(
         interpret=interpret,
     )(tables.astype(jnp.int32), table_index.astype(jnp.int32),
       positions.astype(jnp.int32), horizons.astype(jnp.int32),
-      qg, k_pool, v_pool)
+      *operands)
     return out.reshape(b, 1, n, d)
 
 
@@ -343,8 +384,10 @@ def paged_prefill_kernel(
     interpret: bool = False,
 ) -> jax.Array:
     """Dispatch wrapper; returns [b, s, n_heads, d] in q's dtype."""
+    k_arr, v_arr, k_scale, v_scale = _split_quant(k_pool, v_pool)
+    quantized = k_scale is not None
     b, s, n, d = q.shape
-    num_pages, page_size, nkv, _ = k_pool.shape
+    num_pages, page_size, nkv, _ = k_arr.shape
     assert n % nkv == 0
     g = n // nkv
     kv_pages = block_tables.shape[1]
@@ -357,19 +400,26 @@ def paged_prefill_kernel(
 
     kernel = functools.partial(
         _prefill_kernel, scale=scale, page_size=page_size, group=g,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, quantized=quantized,
     )
+    page_spec = pl.BlockSpec((1, page_size, 1, d),
+                             lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, s * g, d),
+                     lambda i, h, j, bt, pos: (i, h, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_arr, v_arr]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, 1),
+                                  lambda i, h, j, bt, pos: (bt[i, j], h))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, s * g, d),
-                         lambda i, h, j, bt, pos: (i, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, s * g, d),
                                lambda i, h, j, bt, pos: (i, h, 0, 0)),
         scratch_shapes=[
@@ -384,7 +434,7 @@ def paged_prefill_kernel(
         out_shape=jax.ShapeDtypeStruct((b, nkv, s * g, d), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), start.astype(jnp.int32),
-      qg, k_pool, v_pool)
+      *operands)
     return out.reshape(b, nkv, s, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, s, n, d)
 
@@ -401,8 +451,10 @@ def paged_decode_kernel(
     interpret: bool = False,
 ) -> jax.Array:
     """Dispatch wrapper; returns [b, 1, n_heads, d] in q's dtype."""
+    k_arr, v_arr, k_scale, v_scale = _split_quant(k_pool, v_pool)
+    quantized = k_scale is not None
     b, _, n, d = q.shape
-    num_pages, page_size, nkv, _ = k_pool.shape
+    num_pages, page_size, nkv, _ = k_arr.shape
     assert n % nkv == 0
     g = n // nkv
     max_pages = block_tables.shape[1]
@@ -412,19 +464,26 @@ def paged_decode_kernel(
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, page_size=page_size,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, quantized=quantized,
     )
+    page_spec = pl.BlockSpec((1, page_size, 1, d),
+                             lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda i, h, j, bt, pos: (i, h, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_arr, v_arr]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, 1),
+                                  lambda i, h, j, bt, pos: (bt[i, j], h))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d),
-                         lambda i, h, j, bt, pos: (i, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda i, h, j, bt, pos: (bt[i, j], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d),
                                lambda i, h, j, bt, pos: (i, h, 0, 0)),
         scratch_shapes=[
@@ -439,5 +498,5 @@ def paged_decode_kernel(
         out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
-      qg, k_pool, v_pool)
+      *operands)
     return out.reshape(b, 1, n, d)

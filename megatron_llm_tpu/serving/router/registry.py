@@ -82,6 +82,13 @@ class ReplicaView:
     prefix_miss_tokens: int = 0
     page_size: int = 0
     ticks: int = 0
+    # quantized paged KV (ISSUE 13): the replica's KV storage mode and
+    # byte budget — free_pages on an int8 replica are half-width, so
+    # capacity-aware policies compare byte headroom (free_kv_bytes),
+    # never raw page counts across mixed-dtype fleets
+    kv_dtype: str = "bf16"
+    kv_pool_bytes: int = 0
+    kv_scale_bytes: int = 0
     # scheduler control-plane payload (engine.scheduler_stats())
     policy: str = ""
     retry_after_s: Optional[float] = None
@@ -125,6 +132,9 @@ class ReplicaView:
             prefix_miss_tokens=int(payload.get("prefix_miss_tokens", 0)),
             page_size=int(payload.get("page_size", 0)),
             ticks=int(payload.get("ticks", 0)),
+            kv_dtype=str(payload.get("kv_dtype", "bf16")),
+            kv_pool_bytes=int(payload.get("kv_pool_bytes", 0)),
+            kv_scale_bytes=int(payload.get("kv_scale_bytes", 0)),
             policy=str(sched.get("policy", "")),
             retry_after_s=(None if sched.get("retry_after_s") is None
                            else float(sched["retry_after_s"])),
@@ -152,6 +162,16 @@ class ReplicaView:
     def load(self) -> float:
         """Occupancy fraction; > 1 means a backlog beyond the slots."""
         return self.depth / self.max_slots
+
+    @property
+    def free_kv_bytes(self) -> Optional[float]:
+        """KV byte headroom: free pages x bytes per page (ISSUE 13).
+        Comparable ACROSS kv_dtype modes — an int8 replica's page is half
+        a bf16 replica's — where raw free_pages is not.  None until the
+        replica publishes its pool byte budget."""
+        if not self.kv_pool_bytes or not self.total_pages:
+            return None
+        return self.free_pages * (self.kv_pool_bytes / self.total_pages)
 
     def drain_score(self) -> float:
         """Predicted seconds of work ahead of a new arrival: queue depth x

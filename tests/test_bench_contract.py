@@ -469,6 +469,85 @@ def test_committed_mixed_evidence_is_valid():
     assert not _bench_on_tpu(json.dumps(stamped))
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 13: quantized-KV capacity bench
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode capacity (ISSUE 13) reuses the off-TPU
+    contract: headline 0, the fixed-byte-budget int8-vs-bf16 comparison
+    rides under cpu_sanity with budget fields populated, TPU evidence
+    goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_kv_capacity_slot_ratio_llama470m_1chip",
+        "value": 2.3, "unit": "x", "backend": "cpu",
+        "capacity_ok": True, "greedy_match": True, "slot_ratio": 2.3,
+        "hit_rate_bf16": 0.44, "hit_rate_int8": 0.89,
+        "compile_time_s": 3.0, "step_time_s": 0.05,
+        "rows": [{"kv_dtype": "bf16", "peak_concurrent_slots": 3},
+                 {"kv_dtype": "int8", "peak_concurrent_slots": 7}],
+    }, tag="engine_decode_capacity")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["capacity_ok"] is True
+    assert line["budgets"]["compile_time_s"]["value"] == 3.0
+    assert line["budgets"]["step_time_s"]["budget"] == 120.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "engine_capacity", "value": 2.1,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_capacity")
+    assert bench.load_last_tpu(tag="engine_decode_capacity")["value"] == 2.1
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_capacity_bench_in_watch_jobs():
+    """ISSUE 13: the fixed-pool-bytes capacity bench is in the tunnel-up
+    capture list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_capacity" in by_name
+    cmd, bounded, pred = by_name["bench_decode_capacity"]
+    assert "--mode" in cmd and "capacity" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_capacity_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_capacity_cpu_
+    sanity.json) satisfies the acceptance bar: headline 0 off-TPU, the
+    int8 arm sustains >= 2x the bf16 arm's peak concurrent slots at the
+    SAME pool byte budget, the prefix hit rate is no worse, greedy
+    tokens matched on the sanity horizon, and budgets populated without
+    violations."""
+    from pathlib import Path
+
+    path = (Path(__file__).parent.parent
+            / "BENCH_decode_capacity_cpu_sanity.json")
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["capacity_ok"] is True
+    assert sanity["greedy_match"] is True
+    assert sanity["slot_ratio"] >= 2.0
+    by = {r["kv_dtype"]: r for r in sanity["rows"]
+          if "peak_concurrent_slots" in r}
+    assert set(by) == {"bf16", "int8"}
+    # SAME byte budget on both arms — the whole point of the bench
+    assert (by["int8"]["pool_budget_bytes"]
+            == by["bf16"]["pool_budget_bytes"])
+    assert (by["int8"]["peak_concurrent_slots"]
+            >= 2 * by["bf16"]["peak_concurrent_slots"])
+    # int8 value bytes actually fit the budget, scale overhead included
+    assert (by["int8"]["kv_pool_bytes"] + by["int8"]["kv_scale_bytes"]
+            <= by["int8"]["pool_budget_bytes"])
+    assert sanity["hit_rate_int8"] >= sanity["hit_rate_bf16"]
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+    stamped = dict(rec)
+    stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
+    assert not _bench_on_tpu(json.dumps(stamped))
+
+
 def test_trace_cost_budget_on_observability_line(evidence_dir):
     """ROADMAP item 4 leftover: the observability evidence line carries
     tracer-cost budget verdicts — within limits it annotates, a tracer

@@ -585,12 +585,23 @@ def test_generation_server_metrics_endpoint():
                       # ISSUE 12: honest TTFT decomposition histograms
                       "mlt_engine_queue_wait_seconds",
                       "mlt_engine_prefill_compute_seconds",
-                      "mlt_engine_preempted_seconds"):
+                      "mlt_engine_preempted_seconds",
+                      # ISSUE 13: quantized-KV capacity telemetry
+                      "mlt_engine_kv_pool_bytes",
+                      "mlt_engine_kv_scale_bytes",
+                      "mlt_engine_kv_dtype_info"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
+        assert 'mlt_engine_kv_dtype_info{kv_dtype="bf16"} 1' in body
         # /health still answers alongside
         code, body, _ = _get(f"http://127.0.0.1:{port}/health")
-        assert code == 200 and json.loads(body)["status"] == "ok"
+        health = json.loads(body)
+        assert code == 200 and health["status"] == "ok"
+        # ISSUE 13: /health names the KV storage mode + byte budget
+        assert health["kv_dtype"] == "bf16"
+        assert health["kv_pool_bytes"] > 0
+        assert health["kv_scale_bytes"] == 0
+        assert health["peak_active_slots"] == 0
     finally:
         srv.stop()
 

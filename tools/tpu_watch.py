@@ -186,6 +186,13 @@ JOBS = [
     ("bench_decode_mixed",
      [sys.executable, "bench_decode.py", "--mode", "mixed"],
      False, _bench_on_tpu),
+    # ISSUE 13: quantized paged KV capacity — peak concurrent slots and
+    # prefix-cache hit rate at a FIXED pool byte budget, --kv_dtype int8
+    # vs bf16, with the short-horizon greedy-agreement assert in-bench
+    # (bench_decode.py --mode capacity, engine_decode_capacity evidence)
+    ("bench_decode_capacity",
+     [sys.executable, "bench_decode.py", "--mode", "capacity"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
