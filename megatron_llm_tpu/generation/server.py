@@ -155,7 +155,10 @@ class MegatronServer:
     """text_generation_server.MegatronServer analog (:234-241)."""
 
     def __init__(self, engine):
-        self.engine = engine
+        # the lock-relevant type (the legacy InferenceEngine has no
+        # locks): the annotation below lets graftcheck's lock-order
+        # graph resolve `with eng._lock:` in health()/metrics_text()
+        self.engine = engine  # instance of ContinuousBatchingEngine
         self.lock = threading.Lock()
         # continuous-batching engines serialize device access internally
         # (enqueue + future); a server-level lock would undo the batching

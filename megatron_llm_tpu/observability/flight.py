@@ -82,7 +82,10 @@ class RequestRecord:
     def __init__(self, trace_id: str, lock: threading.Lock,
                  events_cap: int, t_submit: Optional[float] = None,
                  **meta: Any):
-        self._lock = lock  # the owning FlightRecorder's lock
+        # the owner hands one lock to every record it issues; the
+        # annotation below merges the two nodes in graftcheck's
+        # lock-order graph
+        self._lock = lock  # shared lock: FlightRecorder._lock
         self.enabled = True
         self.trace_id = trace_id
         self.meta = meta

@@ -11,11 +11,24 @@ state races exactly once a quarter, in production.  A regex line scanner
 docstrings and missed aliased calls — so every rule here works on the
 ``ast`` module's view of the file (stdlib only, no third-party deps).
 
+Since ISSUE 14 the analyzer is TWO-PASS: per-file rules run as before,
+and *project rules* collect per-file facts in pass 1 (JSON-serializable,
+cacheable) and run cross-file analyses in pass 2 over the whole target
+set — the lock-acquisition graph (rules/lockorder.py) and the
+wire-contract checks (rules/contracts.py) live there, because no single
+file contains a lock *order* or a producer/consumer pair.
+
 Vocabulary:
 
-* **Finding** — one (path, line, rule, message) diagnostic.
+* **Finding** — one (path, line, rule, message) diagnostic, with a
+  ``severity``: ``error`` findings gate the exit code, ``info`` findings
+  are advisory (by-design asymmetries like a /health field produced for
+  operators but not parsed by the router) and never fail a run.
 * **Rule** — a class with an ``id``, a one-line ``summary``, and
   ``check(ctx)`` yielding findings for one file.
+* **ProjectRule** — additionally implements ``collect(ctx)`` (pass 1,
+  returns JSON-serializable facts) and ``finalize(project)`` (pass 2,
+  yields findings computed over every file's facts).
 * **Suppression** — ``# graftcheck: noqa[rule-id]`` on the offending
   line (with a reason after it, by convention).  Bare
   ``# graftcheck: noqa`` suppresses every rule on that line.
@@ -36,6 +49,8 @@ Usage::
     python -m tools.graftcheck megatron_llm_tpu tools tasks tests
     python -m tools.graftcheck --json <targets>
     python -m tools.graftcheck --update-baseline <targets>
+    python -m tools.graftcheck --changed-only <targets>   # pre-commit
+    python -m tools.graftcheck --lockorder-out tools/graftcheck/lockorder.json <targets>
 """
 
 from __future__ import annotations
@@ -43,10 +58,12 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import os
 import re
+import subprocess
 import sys
 import time
 import tokenize
@@ -55,6 +72,15 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
+FACT_CACHE_DEFAULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  ".factcache.json")
+LOCKORDER_DEFAULT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "lockorder.json")
+
+#: Bump when the fact schema of any project rule changes shape — a
+#: version mismatch discards the whole cache (the invalidation rule,
+#: with the per-file sha256, documented in docs/guide/static-analysis.md).
+FACTS_VERSION = 1
 
 _NOQA_RE = re.compile(r"graftcheck:\s*noqa(?:\[([^\]]*)\])?")
 
@@ -62,7 +88,8 @@ _NOQA_RE = re.compile(r"graftcheck:\s*noqa(?:\[([^\]]*)\])?")
 @dataclasses.dataclass
 class Finding:
     """One diagnostic.  ``path`` is the path as reported (relative to the
-    invocation root when possible), ``line`` 1-based."""
+    invocation root when possible), ``line`` 1-based.  ``severity`` is
+    ``"error"`` (gates the exit code) or ``"info"`` (advisory)."""
 
     path: str
     line: int
@@ -70,14 +97,16 @@ class Finding:
     rule: str
     message: str
     baselined: bool = False
+    severity: str = "error"
 
     def text(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        sev = "" if self.severity == "error" else f" {self.severity}:"
+        return f"{self.path}:{self.line}: [{self.rule}]{sev} {self.message}"
 
     def json_obj(self) -> Dict:
         return {"path": self.path, "line": self.line, "col": self.col,
                 "rule": self.rule, "message": self.message,
-                "baselined": self.baselined}
+                "baselined": self.baselined, "severity": self.severity}
 
 
 def qualname(node: ast.AST) -> Optional[str]:
@@ -191,6 +220,73 @@ class Rule:
                        rule=self.id, message=message)
 
 
+class ProjectContext:
+    """Pass-2 state: every analyzed file's facts, keyed by rule id then
+    relpath, plus the invocation root (project rules resolve docs and
+    artifacts against it).  Facts are plain JSON values so
+    ``--changed-only`` can cache them between runs."""
+
+    def __init__(self, root: str, complete: bool = True):
+        self.root = root
+        # rule id -> relpath -> facts (JSON-serializable)
+        self.facts: Dict[str, Dict[str, object]] = {}
+        self.py_files: List[str] = []     # relpaths, analysis order
+        # finalize() outputs worth persisting (the lock graph)
+        self.artifacts: Dict[str, object] = {}
+        # True when the target set plausibly covers the whole code
+        # surface (the root itself, or the megatron_llm_tpu package
+        # dir).  Absence-style checks ("documented but registered
+        # nowhere") must consult this: a single-file run proves nothing
+        # about what exists elsewhere.
+        self.complete = complete
+
+    def add_facts(self, rule_id: str, relpath: str, facts) -> None:
+        if facts:
+            self.facts.setdefault(rule_id, {})[relpath] = facts
+
+    def facts_for(self, rule_id: str) -> Dict[str, object]:
+        return self.facts.get(rule_id, {})
+
+    def doc_paths(self) -> List[str]:
+        """Relpaths of every docs/guide/*.md under the root (the contract
+        rules' documentation side).  Docs are never fact-cached — pass 2
+        reads them fresh each run."""
+        doc_dir = os.path.join(self.root, "docs", "guide")
+        if not os.path.isdir(doc_dir):
+            return []
+        return sorted(
+            os.path.join("docs", "guide", n)
+            for n in os.listdir(doc_dir) if n.endswith(".md"))
+
+    def read_text(self, relpath: str) -> str:
+        with open(os.path.join(self.root, relpath), encoding="utf-8",
+                  errors="replace") as f:
+            return f.read()
+
+
+class ProjectRule(Rule):
+    """Cross-file rule: ``collect(ctx)`` gathers one file's facts in
+    pass 1 (must return a JSON-serializable value, or None for "nothing
+    here" — facts are cached by content hash for ``--changed-only``);
+    ``finalize(project)`` yields findings over the whole project in
+    pass 2.  ``check`` is intentionally a no-op: a project rule has
+    nothing to say about one file in isolation."""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def collect(self, ctx: FileContext):
+        raise NotImplementedError
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def project_finding(self, relpath: str, line: int, message: str,
+                        severity: str = "error") -> Finding:
+        return Finding(path=relpath.replace(os.sep, "/"), line=line, col=0,
+                       rule=self.id, message=message, severity=severity)
+
+
 # ---------------------------------------------------------------------------
 # Baseline
 # ---------------------------------------------------------------------------
@@ -217,12 +313,17 @@ def save_baseline(path: str, entries: List[Dict]) -> None:
 
 
 def apply_baseline(findings: List[Finding], entries: List[Dict],
-                   line_text_of) -> List[Dict]:
+                   line_text_of,
+                   known_rules: Optional[Set[str]] = None) -> List[Dict]:
     """Mark findings that match a baseline entry (by path + rule +
     stripped source line; each entry absorbs up to ``count`` findings,
     default 1).  Returns the STALE entries — present in the baseline but
-    matching nothing, which means the underlying code was fixed and the
-    entry should be deleted."""
+    matching nothing.  Each returned entry carries a ``stale_kind``:
+    ``"unknown-rule"`` when the entry's rule id is not in the active rule
+    set (a rule was renamed or removed — re-key the entry), else
+    ``"unmatched"`` (the underlying code was fixed — delete the entry).
+    The distinction matters: without it a rule rename silently orphans
+    its whole baseline and reads as "all fixed"."""
     remaining: Dict[tuple, int] = {}
     for e in entries:
         key = _baseline_key(e["path"], e["rule"], e["line"])
@@ -237,7 +338,11 @@ def apply_baseline(findings: List[Finding], entries: List[Dict],
         key = _baseline_key(e["path"], e["rule"], e["line"])
         if remaining.get(key, 0) > 0:
             remaining[key] = 0
-            stale.append(e)
+            stale_e = dict(e)
+            stale_e["stale_kind"] = (
+                "unknown-rule" if known_rules is not None
+                and e["rule"] not in known_rules else "unmatched")
+            stale.append(stale_e)
     return stale
 
 
@@ -270,18 +375,26 @@ class RuleCrash(Exception):
     predicate must see 'analyzer broken', not 'repo clean'."""
 
 
+def _relpath_under(path: str, root: Optional[str]) -> str:
+    if root is None:
+        return path
+    try:
+        rel = os.path.relpath(path, root)
+        if not rel.startswith(".."):
+            return rel
+    except ValueError:
+        pass
+    return path
+
+
 def check_file(path: str, rules: Sequence[Rule], root: Optional[str] = None,
-               source: Optional[str] = None) -> List[Finding]:
+               source: Optional[str] = None,
+               project: Optional[ProjectContext] = None) -> List[Finding]:
     """All (unsuppressed) findings for one file.  Raises RuleCrash when a
-    rule raises — callers decide whether that is fatal (CLI: exit 2)."""
-    relpath = path
-    if root is not None:
-        try:
-            rel = os.path.relpath(path, root)
-            if not rel.startswith(".."):
-                relpath = rel
-        except ValueError:
-            pass
+    rule raises — callers decide whether that is fatal (CLI: exit 2).
+    With ``project``, project rules in ``rules`` also run their pass-1
+    ``collect`` on the same parsed context (facts land in ``project``)."""
+    relpath = _relpath_under(path, root)
     ctx = FileContext(path, source=source, relpath=relpath)
     findings: List[Finding] = []
     if ctx.syntax_error is not None:
@@ -295,12 +408,103 @@ def check_file(path: str, rules: Sequence[Rule], root: Optional[str] = None,
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.line, rule.id):
                     findings.append(f)
+            if project is not None and isinstance(rule, ProjectRule):
+                project.add_facts(rule.id, ctx.relpath.replace(os.sep, "/"),
+                                  rule.collect(ctx))
         except Exception as e:
             raise RuleCrash(
                 f"rule {rule.id!r} crashed on {path}: "
                 f"{type(e).__name__}: {e}") from e
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
+
+
+def collect_facts(path: str, rules: Sequence["ProjectRule"],
+                  root: Optional[str] = None,
+                  source: Optional[str] = None) -> Dict[str, object]:
+    """Pass-1 facts only (no per-file findings): rule id -> facts.  The
+    cache-refill path of ``--changed-only``."""
+    relpath = _relpath_under(path, root)
+    ctx = FileContext(path, source=source, relpath=relpath)
+    out: Dict[str, object] = {}
+    if ctx.syntax_error is not None:
+        return out
+    for rule in rules:
+        try:
+            facts = rule.collect(ctx)
+        except Exception as e:
+            raise RuleCrash(
+                f"rule {rule.id!r} crashed collecting {path}: "
+                f"{type(e).__name__}: {e}") from e
+        if facts:
+            out[rule.id] = facts
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fact cache (--changed-only)
+# ---------------------------------------------------------------------------
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _cache_fingerprint(project_rules: Sequence["ProjectRule"]) -> List:
+    return [FACTS_VERSION, sorted(r.id for r in project_rules)]
+
+
+def load_fact_cache(path: str,
+                    project_rules: Sequence["ProjectRule"]) -> Dict:
+    """Cached per-file facts, or {} when absent/stale.  Invalidation
+    rule: the whole cache is dropped when FACTS_VERSION or the project
+    rule set changed; a single entry is dropped when its file's sha256
+    changed.  Docs are never cached (pass 2 re-reads them each run)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if doc.get("fingerprint") != _cache_fingerprint(project_rules):
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def save_fact_cache(path: str, files: Dict,
+                    project_rules: Sequence["ProjectRule"]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"fingerprint": _cache_fingerprint(project_rules),
+                   "files": files}, f)
+    os.replace(tmp, path)
+
+
+def git_changed_files(root: str) -> Optional[List[str]]:
+    """Paths (relative to ``root``) touched vs HEAD — staged, unstaged,
+    and untracked.  None when git is unavailable (the CLI then falls
+    back to a full run rather than guessing)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    changed = []
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        changed.append(path.strip().strip('"'))
+    return changed
 
 
 @dataclasses.dataclass
@@ -310,10 +514,19 @@ class RunResult:
     files: int
     seconds: float
     rules: List[str]
+    artifacts: Dict[str, object] = dataclasses.field(default_factory=dict)
+    changed_only: bool = False
 
     @property
     def active(self) -> List[Finding]:
-        return [f for f in self.findings if not f.baselined]
+        """Unbaselined error-severity findings — the ones that gate."""
+        return [f for f in self.findings
+                if not f.baselined and f.severity == "error"]
+
+    @property
+    def info(self) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.baselined and f.severity != "error"]
 
     @property
     def baselined(self) -> List[Finding]:
@@ -329,9 +542,12 @@ class RunResult:
             "rules": self.rules,
             "files": self.files,
             "seconds": round(self.seconds, 3),
+            "changed_only": self.changed_only,
             "findings": [f.json_obj() for f in self.findings],
+            "stale_baseline": self.stale_baseline,
             "counts": {"total": len(self.findings),
                        "active": len(self.active),
+                       "info": len(self.info),
                        "baselined": len(self.baselined),
                        "stale_baseline": len(self.stale_baseline)},
             "exit": self.exit_code,
@@ -340,35 +556,126 @@ class RunResult:
 
 def run(targets: Sequence[str], rules: Optional[Sequence[Rule]] = None,
         baseline_path: Optional[str] = BASELINE_DEFAULT,
-        root: Optional[str] = None) -> RunResult:
+        root: Optional[str] = None,
+        changed_files: Optional[Sequence[str]] = None,
+        fact_cache_path: Optional[str] = None) -> RunResult:
     """Analyze ``targets`` (files or directories) and apply the baseline.
     The library entry point — the CLI, the linter shim, and the tier-1
-    sweep test all come through here."""
-    from tools.graftcheck.rules import ALL_RULES
+    sweep test all come through here.
 
-    rules = list(rules if rules is not None else ALL_RULES)
+    Two passes: per-file rules + project-rule fact collection over each
+    file, then project-rule ``finalize`` over the whole fact set.  With
+    ``changed_files`` (relpaths under ``root``), pass-1 findings are
+    computed only for those files, while pass-2 facts still cover the
+    WHOLE project — unchanged files' facts come from ``fact_cache_path``
+    (keyed by content sha256) or are collected on a cache miss, so the
+    cross-file analyses never narrow.  Stale-baseline detection is
+    skipped in changed-only mode (pass-1 findings are incomplete, so
+    absence proves nothing)."""
+    from tools.graftcheck.rules import DEFAULT_RULES
+
+    rules = list(rules if rules is not None else DEFAULT_RULES)
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
     root = root if root is not None else os.getcwd()
     t0 = time.perf_counter()
     findings: List[Finding] = []
     line_texts: Dict[str, List[str]] = {}
+    complete = False
+    for t in targets:
+        if os.path.isdir(t):
+            rel = _relpath_under(os.path.abspath(t), root).replace(
+                os.sep, "/")
+            if rel in (".", "", "megatron_llm_tpu"):
+                complete = True
+    project = ProjectContext(root, complete=complete)
+    changed_set = None
+    if changed_files is not None:
+        changed_set = {c.replace(os.sep, "/") for c in changed_files}
+    cache = {}
+    if fact_cache_path and project_rules:
+        cache = load_fact_cache(fact_cache_path, project_rules)
     nfiles = 0
     for path in iter_py_files(targets):
         nfiles += 1
-        fs = check_file(path, rules, root=root)
-        if fs:
-            with open(path, encoding="utf-8", errors="replace") as f:
-                line_texts[fs[0].path] = f.read().splitlines()
-        findings.extend(fs)
+        relpath = _relpath_under(path, root).replace(os.sep, "/")
+        project.py_files.append(relpath)
+        is_changed = changed_set is None or relpath in changed_set
+        if is_changed:
+            fs = check_file(path, rules, root=root, project=project)
+            if fs:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    line_texts[fs[0].path] = f.read().splitlines()
+            findings.extend(fs)
+            if fact_cache_path and project_rules:
+                cache[relpath] = {
+                    "sha256": _sha256_file(path),
+                    "facts": {r.id: project.facts_for(r.id).get(relpath)
+                              for r in project_rules
+                              if project.facts_for(r.id).get(relpath)}}
+        elif project_rules:
+            # unchanged file: facts from the cache, collected on miss
+            entry = cache.get(relpath)
+            sha = _sha256_file(path)
+            if entry is None or entry.get("sha256") != sha:
+                entry = {"sha256": sha,
+                         "facts": collect_facts(path, project_rules,
+                                                root=root)}
+                cache[relpath] = entry
+            for rid, facts in (entry.get("facts") or {}).items():
+                project.add_facts(rid, relpath, facts)
+
+    # ---- pass 2: cross-file rules over the whole fact set ----
+    ctx_cache: Dict[str, Optional[FileContext]] = {}
+
+    def _suppressed(f: Finding) -> bool:
+        if f.path not in ctx_cache:
+            full = os.path.join(root, f.path)
+            if f.path.endswith(".py") and os.path.exists(full):
+                try:
+                    ctx_cache[f.path] = FileContext(full, relpath=f.path)
+                except OSError:
+                    ctx_cache[f.path] = None
+            else:
+                ctx_cache[f.path] = None
+        ctx = ctx_cache[f.path]
+        return ctx is not None and ctx.suppressed(f.line, f.rule)
+
+    for rule in project_rules:
+        try:
+            for f in rule.finalize(project):
+                if not _suppressed(f):
+                    findings.append(f)
+        except Exception as e:
+            raise RuleCrash(
+                f"project rule {rule.id!r} crashed in finalize: "
+                f"{type(e).__name__}: {e}") from e
 
     def line_text_of(f: Finding) -> str:
+        if f.path not in line_texts:
+            full = os.path.join(root, f.path)
+            if os.path.exists(full):
+                with open(full, encoding="utf-8", errors="replace") as fh:
+                    line_texts[f.path] = fh.read().splitlines()
+            else:
+                line_texts[f.path] = []
         lines = line_texts.get(f.path, [])
         return lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
 
     entries = load_baseline(baseline_path) if baseline_path else []
-    stale = apply_baseline(findings, entries, line_text_of)
+    known = {r.id for r in rules} | {"parse-error"}
+    stale = apply_baseline(findings, entries, line_text_of, known_rules=known)
+    if changed_set is not None:
+        stale = []  # incomplete pass-1 findings can't prove staleness
+    if fact_cache_path and project_rules:
+        try:
+            save_fact_cache(fact_cache_path, cache, project_rules)
+        except OSError:
+            pass  # a read-only checkout still analyzes fine
     return RunResult(findings=findings, stale_baseline=stale, files=nfiles,
                      seconds=time.perf_counter() - t0,
-                     rules=sorted(r.id for r in rules))
+                     rules=sorted(r.id for r in rules),
+                     artifacts=project.artifacts,
+                     changed_only=changed_set is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -430,29 +737,49 @@ def make_parser() -> argparse.ArgumentParser:
                          "(preserves reasons of surviving entries)")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="pass-1 findings for git-changed files only; "
+                         "pass-2 cross-file facts still cover the whole "
+                         "project via the fact cache (fast pre-commit)")
+    ap.add_argument("--fact-cache", default=FACT_CACHE_DEFAULT,
+                    help="per-file fact cache for --changed-only "
+                         "(default: tools/graftcheck/.factcache.json)")
+    ap.add_argument("--lockorder-out", default=None, metavar="PATH",
+                    help="write the discovered lock-acquisition graph "
+                         "(nodes, edges, topological order) as JSON")
+    ap.add_argument("--info", action="store_true",
+                    help="also print info-severity (advisory) findings")
     ap.add_argument("--list-rules", action="store_true")
     return ap
 
 
 def _main(argv: Optional[Sequence[str]]) -> int:
-    from tools.graftcheck.rules import ALL_RULES
+    from tools.graftcheck.rules import DEFAULT_RULES
 
     args = make_parser().parse_args(argv)
     if args.list_rules:
-        for rule in ALL_RULES:
-            print(f"{rule.id:24s} {rule.summary}")
+        for rule in DEFAULT_RULES:
+            kind = "project" if isinstance(rule, ProjectRule) else "file"
+            print(f"{rule.id:24s} [{kind:7s}] {rule.summary}")
         return 0
-    rules = ALL_RULES
+    rules = DEFAULT_RULES
     if args.select:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
-        known = {r.id for r in ALL_RULES}
+        known = {r.id for r in DEFAULT_RULES}
         unknown = wanted - known
         if unknown:
             print(f"graftcheck: unknown rule(s): {sorted(unknown)}; "
                   f"known: {sorted(known)}", file=sys.stderr)
             return 2
-        rules = [r for r in ALL_RULES if r.id in wanted]
+        rules = [r for r in DEFAULT_RULES if r.id in wanted]
     baseline = None if args.no_baseline else args.baseline
+    changed = None
+    if args.changed_only:
+        changed = git_changed_files(os.getcwd())
+        if changed is None:
+            print("graftcheck: --changed-only needs git; running full",
+                  file=sys.stderr)
+    fact_cache = args.fact_cache if args.changed_only else None
 
     if args.update_baseline:
         # findings need their source line for stable keys
@@ -472,20 +799,37 @@ def _main(argv: Optional[Sequence[str]]) -> int:
                              if 1 <= f.line <= len(lines) else "")
         return _update_baseline(result, args.baseline)
 
-    result = run(args.targets, rules=rules, baseline_path=baseline)
+    result = run(args.targets, rules=rules, baseline_path=baseline,
+                 changed_files=changed, fact_cache_path=fact_cache)
+    if args.lockorder_out and "lockorder" in result.artifacts:
+        with open(args.lockorder_out, "w", encoding="utf-8") as f:
+            json.dump(result.artifacts["lockorder"], f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
     if args.json:
         print(json.dumps(result.json_obj(), sort_keys=True))
     else:
         for f in result.active:
             print(f.text())
+        if args.info:
+            for f in result.info:
+                print(f.text())
         for e in result.stale_baseline:
-            print(f"graftcheck: stale baseline entry (code was fixed — "
-                  f"delete it): {e['path']} [{e['rule']}] {e['line']!r}")
+            if e.get("stale_kind") == "unknown-rule":
+                print(f"graftcheck: stale baseline entry (rule id "
+                      f"{e['rule']!r} no longer exists — renamed? re-key "
+                      f"or delete it): {e['path']} [{e['rule']}] "
+                      f"{e['line']!r}")
+            else:
+                print(f"graftcheck: stale baseline entry (code was fixed "
+                      f"— delete it): {e['path']} [{e['rule']}] "
+                      f"{e['line']!r}")
         n = len(result.active)
+        mode = " (changed-only)" if result.changed_only else ""
         print(f"graftcheck: {n} finding(s) "
-              f"({len(result.baselined)} baselined) in {result.files} "
-              f"files, {len(result.rules)} rules, "
-              f"{result.seconds:.1f}s")
+              f"({len(result.info)} info, {len(result.baselined)} "
+              f"baselined) in {result.files} files{mode}, "
+              f"{len(result.rules)} rules, {result.seconds:.1f}s")
     return result.exit_code
 
 

@@ -1,11 +1,21 @@
-"""Rule registry.  Adding a rule: write a ``Rule`` subclass in a module
-here, instantiate it in ``ALL_RULES``, document it in
+"""Rule registry.  Adding a per-file rule: write a ``Rule`` subclass in
+a module here, instantiate it in ``ALL_RULES``, document it in
 docs/guide/static-analysis.md, and give it positive/negative/suppressed
-fixtures in tests/test_graftcheck.py.
+fixtures in tests/test_graftcheck.py.  Adding a cross-file rule: write a
+``ProjectRule`` subclass (``collect`` + ``finalize``), instantiate it in
+``PROJECT_RULES``, and give it a multi-file fixture in the
+PROJECT_FIXTURES matrix (see docs/guide/static-analysis.md, "Adding a
+cross-file rule").
 """
 
 from __future__ import annotations
 
+from tools.graftcheck.rules.contracts import (
+    FlagsContractRule,
+    HealthContractRule,
+    MetricsContractRule,
+)
+from tools.graftcheck.rules.lockorder import LockOrderRule
 from tools.graftcheck.rules.locks import LockDisciplineRule
 from tools.graftcheck.rules.recompile import RecompileHazardRule
 from tools.graftcheck.rules.rng import RngKeyReuseRule
@@ -38,6 +48,17 @@ ALL_RULES = [
     TrailingWhitespaceRule(),
 ]
 
-RULES_BY_ID = {r.id: r for r in ALL_RULES}
+# cross-file analyzers (ISSUE 14): pass-1 fact collection + pass-2
+# whole-project rules (tools/graftcheck/core.py ProjectRule)
+PROJECT_RULES = [
+    LockOrderRule(),
+    MetricsContractRule(),
+    HealthContractRule(),
+    FlagsContractRule(),
+]
 
-__all__ = ["ALL_RULES", "RULES_BY_ID"]
+DEFAULT_RULES = ALL_RULES + PROJECT_RULES
+
+RULES_BY_ID = {r.id: r for r in DEFAULT_RULES}
+
+__all__ = ["ALL_RULES", "DEFAULT_RULES", "PROJECT_RULES", "RULES_BY_ID"]

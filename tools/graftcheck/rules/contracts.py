@@ -1,0 +1,616 @@
+"""wire-contract family: producer/consumer + code/docs drift detection.
+
+Three tiers now talk through hand-maintained contracts: ~60 Prometheus
+metric names must agree between registration sites and the docs tables
+that operators build dashboards from; the ``/health`` payload is
+produced field-by-field in ``generation/server.py`` and re-parsed
+field-by-field by the router's ``ReplicaView``; and every serving knob
+exists twice — as a config dataclass field and as a row in a guide's
+flag table.  None of these break tests when they drift; they break
+dashboards, routing decisions, and operators.  These rules extract both
+sides statically and diff them:
+
+* **wire-metrics** — every ``reg.counter/gauge/histogram("mlt_...")``
+  registration (name + label keys, one level of local ``labels = {...}``
+  resolution) vs every ``mlt_*`` mention in ``docs/guide/*.md``.
+  Flags: registered-but-undocumented (error), documented-but-never-
+  registered (error), and a documented label set (``{kind,phase}``)
+  matching no registered label set (error).  Wildcard prose mentions
+  (``mlt_engine_prefix_*``) make no claim.
+* **wire-health** — keys ``MegatronServer.health()`` emits (dict
+  literals, ``.update(k=...)``, ``d["k"] = ...``), plus the nested
+  ``scheduler``/``spec`` payloads from the engine's
+  ``scheduler_stats``/``spec_stats``, vs keys ``ReplicaView.parse``
+  consumes (``payload.get``/``[...]``, namespace-local helpers like
+  ``_ms("ema_tick_ms")`` inlined), vs the serving.md "/health payload"
+  table.  Parsed-but-never-produced is an **error** (the router is
+  reading a field nobody sends — a routing decision on a default);
+  produced-but-never-parsed is **info** (operator-facing fields are
+  fine, but the asymmetry should be visible); table drift in either
+  direction is an error.
+* **wire-flags** — the config dataclass fields of ``arguments.py``
+  (spelled ``--field`` by the auto-CLI) + every literal
+  ``add_argument("--flag")`` + the parallel alias table, vs every
+  ``--flag`` mention in ``docs/guide/*.md``.  A documented flag that no
+  parser accepts is an error anywhere; an ``InferenceConfig`` field
+  (the serving surface this repo documents exhaustively) missing from
+  every guide is an error at the field.
+
+Each rule stores an extraction-count artifact so the anti-vacuity tests
+can pin that the extractors still see the real surfaces — a silent
+extraction regression must not pass as "0 findings".
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftcheck.core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    ProjectRule,
+)
+
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# wire-metrics
+# ---------------------------------------------------------------------------
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_DOC_METRIC_RE = re.compile(r"(mlt_[a-z0-9_]+)(\{([^}\n`]*)\})?")
+
+
+def _label_keys_of(node: ast.AST, fn: Optional[ast.AST]) -> Optional[object]:
+    """Label keys of a ``labels=`` argument: sorted key list, None for
+    no labels, or ``"?"`` when not statically resolvable.  A Name
+    resolves through one level of ``labels = {...}`` assignment in the
+    enclosing function."""
+    if isinstance(node, ast.Name) and fn is not None:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and sub.targets[0].id == node.id:
+                node = sub.value
+                break
+    if isinstance(node, ast.Dict):
+        keys = [_const_str(k) for k in node.keys]
+        if all(k is not None for k in keys):
+            return sorted(keys)  # type: ignore[arg-type]
+        return "?"
+    if isinstance(node, ast.Constant) and node.value is None:
+        return None
+    return "?"
+
+
+class MetricsContractRule(ProjectRule):
+    id = "wire-metrics"
+    summary = ("registered mlt_* metric names + label sets must agree "
+               "with the docs/guide tables (both directions, labels "
+               "included)")
+
+    def collect(self, ctx: FileContext):
+        if ctx.tree is None:
+            return None
+        regs: List[dict] = []
+        # enclosing-function map for one-level labels= resolution
+        func_of: Dict[ast.AST, ast.AST] = {}
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    func_of.setdefault(sub, fn)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args):
+                continue
+            name = _const_str(node.args[0])
+            if name is None or not name.startswith("mlt_"):
+                continue
+            labels = None
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    labels = _label_keys_of(kw.value, func_of.get(node))
+            regs.append({"name": name, "kind": node.func.attr,
+                         "labels": labels, "line": node.lineno})
+        return {"registrations": regs} if regs else None
+
+    @staticmethod
+    def _doc_mentions(project: ProjectContext):
+        """name -> [(docpath, line, labelkeys-or-None)] from every
+        docs/guide/*.md.  A ``{...}`` suffix is a label claim when every
+        comma-part's key parses as an identifier; wildcard names
+        (``mlt_engine_prefix_*``) are skipped."""
+        mentions: Dict[str, List[Tuple[str, int, Optional[tuple]]]] = {}
+        for doc in project.doc_paths():
+            text = project.read_text(doc)
+            for lineno, line in enumerate(text.splitlines(), 1):
+                for m in _DOC_METRIC_RE.finditer(line):
+                    name = m.group(1)
+                    end = m.end(1)
+                    if end < len(line) and line[end] == "*":
+                        continue  # wildcard prose, no claim
+                    claim: Optional[tuple] = None
+                    if m.group(3) is not None:
+                        keys = []
+                        ok = True
+                        for part in m.group(3).split(","):
+                            key = part.split("=", 1)[0].strip().strip("`")
+                            key = key.replace("\\", "")
+                            if not _IDENT_RE.match(key):
+                                ok = False
+                                break
+                            keys.append(key)
+                        if ok and keys:
+                            claim = tuple(sorted(keys))
+                    mentions.setdefault(name, []).append(
+                        (doc, lineno, claim))
+        return mentions
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        facts = project.facts_for(self.id)
+        # name -> registration sites; label sets registered per name
+        sites: Dict[str, List[Tuple[str, int]]] = {}
+        label_sets: Dict[str, Set[object]] = {}
+        dynamic_labels: Set[str] = set()
+        for relpath in sorted(facts):
+            for reg in facts[relpath]["registrations"]:
+                name = reg["name"]
+                sites.setdefault(name, []).append((relpath, reg["line"]))
+                if reg["labels"] == "?":
+                    dynamic_labels.add(name)
+                else:
+                    key = (tuple(reg["labels"])
+                           if isinstance(reg["labels"], list) else None)
+                    label_sets.setdefault(name, set()).add(key)
+        mentions = self._doc_mentions(project)
+        project.artifacts[self.id] = {
+            "registered": len(sites), "documented": len(mentions)}
+        if not sites:
+            return  # nothing registered in the target set at all
+        do_absence = project.complete
+        # code-not-documented is partial-safe: docs are always read in
+        # full, so a registration seen in ANY run can demand its row
+
+        in_package = {n for n, ss in sites.items()
+                      if any(p.startswith("megatron_llm_tpu/")
+                             for p, _ in ss)}
+        for name in sorted(in_package):
+            if name not in mentions:
+                p, line = sorted(sites[name])[0]
+                yield self.project_finding(
+                    p, line,
+                    f"metric {name!r} is registered but documented "
+                    f"nowhere in docs/guide/*.md — operators can't find "
+                    f"it; add it to the owning guide's metric table")
+        if not do_absence:
+            # a partial-target run (one file via the linter shim,
+            # --select on a subdir) proves nothing about what is
+            # registered elsewhere — skip the doc-side directions
+            return
+        for name in sorted(mentions):
+            if name not in sites:
+                doc, line, _ = mentions[name][0]
+                yield self.project_finding(
+                    doc, line,
+                    f"documented metric {name!r} is registered nowhere "
+                    f"in the swept code — stale docs row (renamed or "
+                    f"removed metric?)")
+                continue
+            if name in dynamic_labels:
+                continue  # label sets not statically known; no claim check
+            registered = label_sets.get(name, set())
+            for doc, line, claim in mentions[name]:
+                if claim is None:
+                    continue
+                if set(claim) not in [set(r) if r else set()
+                                      for r in registered]:
+                    have = sorted(
+                        "{" + ",".join(r) + "}" if r else "(no labels)"
+                        for r in registered)
+                    yield self.project_finding(
+                        doc, line,
+                        f"metric {name!r} documented with label set "
+                        f"{{{','.join(claim)}}} but registered with "
+                        f"{', '.join(have)} — label drift breaks every "
+                        f"dashboard query")
+
+
+# ---------------------------------------------------------------------------
+# wire-health
+# ---------------------------------------------------------------------------
+
+
+def _dict_producer_keys(fn: ast.AST) -> List[Tuple[str, int]]:
+    """Keys a function emits into its result dict: literal dict keys,
+    ``X.update(k=...)`` kwargs, and ``X["k"] = ...`` assignments."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                s = _const_str(k) if k is not None else None
+                if s is not None:
+                    out.append((s, k.lineno))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update":
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    out.append((kw.arg, node.lineno))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    s = _const_str(tgt.slice)
+                    if s is not None:
+                        out.append((s, tgt.lineno))
+    return out
+
+
+class HealthContractRule(ProjectRule):
+    id = "wire-health"
+    summary = ("/health keys the server emits vs keys ReplicaView "
+               "parses vs the serving.md schema table (parsed-but-"
+               "never-produced = error)")
+
+    #: producer methods -> payload namespace ("" = top level)
+    PRODUCERS = {("MegatronServer", "health"): "",
+                 ("ContinuousBatchingEngine", "scheduler_stats"):
+                     "scheduler",
+                 ("ContinuousBatchingEngine", "spec_stats"): "spec"}
+    CONSUMER = ("ReplicaView", "parse")
+    DOC_HEADING = "/health payload"
+
+    def collect(self, ctx: FileContext):
+        if ctx.tree is None:
+            return None
+        producer: Dict[str, List] = {}
+        consumer: Dict[str, List] = {}
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                ns = self.PRODUCERS.get((cls.name, fn.name))
+                if ns is not None:
+                    producer.setdefault(ns, []).extend(
+                        [k, ln] for k, ln in _dict_producer_keys(fn))
+                if (cls.name, fn.name) == self.CONSUMER:
+                    for ns2, keys in self._consumer_keys(fn).items():
+                        consumer.setdefault(ns2, []).extend(keys)
+        out = {}
+        if producer:
+            out["producer"] = producer
+        if consumer:
+            out["consumer"] = consumer
+        return out or None
+
+    @staticmethod
+    def _consumer_keys(fn: ast.AST) -> Dict[str, List]:
+        """namespace -> [[key, line], ...] consumed by a parse function.
+        The payload argument is the first non-self/url parameter; a
+        local ``sched = payload.get("scheduler") or {}`` binds a
+        namespace name, and a single-argument local helper whose body
+        does ``ns.get(param)`` is inlined (``_ms("ema_tick_ms")``)."""
+        args = [a.arg for a in fn.args.args if a.arg != "self"]
+        payload = args[1] if len(args) > 1 else (args[0] if args else "")
+        ns_of: Dict[str, str] = {payload: ""}
+        # namespace bindings: name = payload.get("x") [or {}]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                value = node.value
+                if isinstance(value, ast.BoolOp):
+                    value = value.values[0]
+                if isinstance(value, ast.Call) \
+                        and isinstance(value.func, ast.Attribute) \
+                        and value.func.attr == "get" \
+                        and isinstance(value.func.value, ast.Name) \
+                        and value.func.value.id == payload and value.args:
+                    key = _const_str(value.args[0])
+                    if key is not None:
+                        ns_of[node.targets[0].id] = key
+        # helpers: def h(k): ... ns.get(k) ...  ->  h("lit") reads ns
+        helper_ns: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn \
+                    and len(node.args.args) == 1:
+                param = node.args.args[0].arg
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "get" \
+                            and isinstance(sub.func.value, ast.Name) \
+                            and sub.func.value.id in ns_of \
+                            and sub.args \
+                            and isinstance(sub.args[0], ast.Name) \
+                            and sub.args[0].id == param:
+                        helper_ns[node.name] = ns_of[sub.func.value.id]
+        out: Dict[str, List] = {}
+
+        def add(ns: str, key: str, line: int) -> None:
+            out.setdefault(ns, []).append([key, line])
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in ns_of and node.args:
+                key = _const_str(node.args[0])
+                if key is not None:
+                    add(ns_of[node.func.value.id], key, node.lineno)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in ns_of:
+                key = _const_str(node.slice)
+                if key is not None:
+                    add(ns_of[node.value.id], key, node.lineno)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in helper_ns and node.args:
+                key = _const_str(node.args[0])
+                if key is not None:
+                    add(helper_ns[node.func.id], key, node.lineno)
+        return out
+
+    def _doc_table_keys(self, project: ProjectContext):
+        """Top-level keys of the serving.md "/health payload" table:
+        backticked names in the first cell of each row."""
+        keys: Dict[str, Tuple[str, int]] = {}
+        for doc in project.doc_paths():
+            text = project.read_text(doc)
+            lines = text.splitlines()
+            in_section = False
+            for lineno, line in enumerate(lines, 1):
+                if line.startswith("#"):
+                    in_section = self.DOC_HEADING in line
+                    continue
+                if not in_section or not line.strip().startswith("|"):
+                    continue
+                first_cell = line.strip().strip("|").split("|", 1)[0]
+                for m in re.finditer(r"`([A-Za-z_][\w]*)`", first_cell):
+                    keys.setdefault(m.group(1), (doc, lineno))
+        return keys
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        facts = project.facts_for(self.id)
+        produced: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        consumed: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        for relpath in sorted(facts):
+            for ns, keys in (facts[relpath].get("producer") or {}).items():
+                for key, line in keys:
+                    produced.setdefault(ns, {}).setdefault(
+                        key, (relpath, line))
+            for ns, keys in (facts[relpath].get("consumer") or {}).items():
+                for key, line in keys:
+                    consumed.setdefault(ns, {}).setdefault(
+                        key, (relpath, line))
+        doc_keys = self._doc_table_keys(project)
+        project.artifacts[self.id] = {
+            "produced": sum(len(v) for v in produced.values()),
+            "consumed": sum(len(v) for v in consumed.values()),
+            "documented": len(doc_keys),
+        }
+        if not consumed or not produced or not project.complete:
+            return  # partial target set: absence proves nothing
+        for ns in sorted(consumed):
+            prod_ns = produced.get(ns, {})
+            for key in sorted(consumed[ns]):
+                if key not in prod_ns:
+                    p, line = consumed[ns][key]
+                    where = f"{ns}.{key}" if ns else key
+                    yield self.project_finding(
+                        p, line,
+                        f"/health field {where!r} is parsed by "
+                        f"ReplicaView but produced by no server — the "
+                        f"router is routing on a default value")
+        for ns in sorted(produced):
+            cons_ns = consumed.get(ns, {})
+            for key in sorted(produced[ns]):
+                if key not in cons_ns:
+                    p, line = produced[ns][key]
+                    where = f"{ns}.{key}" if ns else key
+                    yield self.project_finding(
+                        p, line,
+                        f"/health field {where!r} is produced but never "
+                        f"parsed by ReplicaView (operator-facing only)",
+                        severity="info")
+        if doc_keys:
+            top_produced = produced.get("", {})
+            for key in sorted(top_produced):
+                if key not in doc_keys:
+                    p, line = top_produced[key]
+                    yield self.project_finding(
+                        p, line,
+                        f"/health field {key!r} is missing from the "
+                        f"serving.md \"/health payload\" table — the "
+                        f"schema table is the wire contract, keep it "
+                        f"complete")
+            for key in sorted(doc_keys):
+                if key not in top_produced:
+                    doc, line = doc_keys[key]
+                    yield self.project_finding(
+                        doc, line,
+                        f"documented /health field {key!r} is produced "
+                        f"by no server — stale schema row")
+
+
+# ---------------------------------------------------------------------------
+# wire-flags
+# ---------------------------------------------------------------------------
+
+
+class FlagsContractRule(ProjectRule):
+    id = "wire-flags"
+    summary = ("--flags in docs/guide tables/code blocks must exist in "
+               "code; every InferenceConfig field must be documented")
+
+    _DOC_FLAG_RE = re.compile(r"(?<![\w-])--([A-Za-z][A-Za-z0-9_-]*)")
+    #: argparse provides these on every parser; docs may show them freely
+    _IMPLICIT = {"help"}
+    #: scripts outside the sweep targets whose flags docs legitimately
+    #: show (repo-root benches/drivers + the weights converters) —
+    #: finalize parses them directly, so `bench_decode.py --mode` in a
+    #: guide's code block resolves without widening the sweep
+    _EXTRA_SCRIPT_GLOBS = ("*.py", "weights_conversion/*.py")
+
+    def collect(self, ctx: FileContext):
+        if ctx.tree is None:
+            return None
+        out: Dict[str, object] = {}
+        is_arguments = ctx.relpath.replace("\\", "/").endswith(
+            "arguments.py")
+        fields: List = []
+        inference_fields: List = []
+        aliases: List[str] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and is_arguments:
+                is_dc = any(
+                    (isinstance(d, ast.Name) and d.id == "dataclass")
+                    or (isinstance(d, ast.Attribute)
+                        and d.attr == "dataclass")
+                    or (isinstance(d, ast.Call)
+                        and getattr(d.func, "id", "") == "dataclass")
+                    for d in node.decorator_list)
+                if not is_dc:
+                    continue
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name) \
+                            and not stmt.target.id.startswith("_"):
+                        fields.append([stmt.target.id, stmt.lineno])
+                        if node.name == "InferenceConfig":
+                            inference_fields.append(
+                                [stmt.target.id, stmt.lineno])
+            elif isinstance(node, ast.Assign) and is_arguments \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "_PARALLEL_ALIASES" \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    s = _const_str(k)
+                    if s and s.startswith("--"):
+                        aliases.append(s[2:])
+        add_args: List[str] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add_argument" and node.args:
+                s = _const_str(node.args[0])
+                if s and s.startswith("--"):
+                    add_args.append(s[2:])
+        if fields:
+            out["dataclass_fields"] = fields
+        if inference_fields:
+            out["inference_fields"] = inference_fields
+        if aliases:
+            out["aliases"] = aliases
+        if add_args:
+            out["add_argument"] = add_args
+        return out or None
+
+    def _extra_script_flags(self, project: ProjectContext) -> Set[str]:
+        """add_argument flags of repo-root scripts and the weights
+        converters — outside the sweep targets but legitimately shown in
+        guide code blocks."""
+        import glob
+
+        out: Set[str] = set()
+        seen = set(project.py_files)
+        for pattern in self._EXTRA_SCRIPT_GLOBS:
+            for path in sorted(glob.glob(
+                    os.path.join(project.root, pattern))):
+                rel = os.path.relpath(path, project.root).replace(
+                    os.sep, "/")
+                if rel in seen:
+                    continue
+                try:
+                    tree = ast.parse(project.read_text(rel))
+                except (SyntaxError, OSError):
+                    continue
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "add_argument" \
+                            and node.args:
+                        s = _const_str(node.args[0])
+                        if s and s.startswith("--"):
+                            out.add(s[2:])
+        return out
+
+    @classmethod
+    def _doc_flag_claims(cls, text: str) -> Iterable[Tuple[str, int]]:
+        """(flag, line) claims from one guide: table rows and fenced
+        code blocks only.  Prose may name another system's flags (the
+        reference's ``--rank``, Megatron-LM's split-rank layout) — prose
+        makes no claim about THIS repo's parsers."""
+        in_fence = False
+        for lineno, line in enumerate(text.splitlines(), 1):
+            stripped = line.strip()
+            if stripped.startswith("```"):
+                in_fence = not in_fence
+                continue
+            if not in_fence and not stripped.startswith("|"):
+                continue
+            for m in cls._DOC_FLAG_RE.finditer(line):
+                yield m.group(1), lineno
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        facts = project.facts_for(self.id)
+        code_flags: Set[str] = set(self._IMPLICIT)
+        inference: Dict[str, Tuple[str, int]] = {}
+        for relpath in sorted(facts):
+            f = facts[relpath]
+            for name, _line in f.get("dataclass_fields", []):
+                code_flags.add(name)
+            code_flags.update(f.get("aliases", []))
+            code_flags.update(f.get("add_argument", []))
+            for name, line in f.get("inference_fields", []):
+                inference.setdefault(name, (relpath, line))
+        have_parsers = len(code_flags) > len(self._IMPLICIT)
+        if have_parsers:
+            code_flags |= self._extra_script_flags(project)
+        doc_flags: Dict[str, Tuple[str, int]] = {}
+        for doc in project.doc_paths():
+            text = project.read_text(doc)
+            for flag, lineno in self._doc_flag_claims(text):
+                doc_flags.setdefault(flag, (doc, lineno))
+        project.artifacts[self.id] = {
+            "code_flags": len(code_flags),
+            "doc_flags": len(doc_flags),
+            "inference_fields": len(inference),
+        }
+        if not have_parsers:
+            return  # fixture runs without an arguments.py
+        if project.complete:
+            # docs-not-in-code needs the whole flag surface in view
+            for flag in sorted(doc_flags):
+                if flag not in code_flags:
+                    doc, line = doc_flags[flag]
+                    yield self.project_finding(
+                        doc, line,
+                        f"documented flag --{flag} is accepted by no "
+                        f"parser (no dataclass field, add_argument, or "
+                        f"alias) — stale docs")
+        for name in sorted(inference):
+            if name not in doc_flags:
+                relpath, line = inference[name]
+                yield self.project_finding(
+                    relpath, line,
+                    f"InferenceConfig.{name} (--{name}) is documented in "
+                    f"no docs/guide flag table — serving knobs must be "
+                    f"discoverable")

@@ -120,9 +120,16 @@ JOBS = [
     # codes: 0 clean / 1 findings / 2 internal error (the
     # resilience_smoke convention); the predicate treats 0/1 as captured
     # and only a crash (no JSON summary) as retryable.
+    # ISSUE 14: the sweep is two-pass now (per-file rules + the
+    # whole-repo lock-order and wire-contract analyzers); the job also
+    # refreshes the committed lock-graph evidence, which the watch
+    # evidence autocommit picks up like a BENCH file.  The predicate is
+    # unchanged: a parseable one-line JSON summary = captured (clean or
+    # findings), rc 2 with no summary = analyzer crash, retry.
     ("graftcheck",
      [sys.executable, "-m", "tools.graftcheck", "megatron_llm_tpu",
-      "tools", "tasks", "tests", "--json"],
+      "tools", "tasks", "tests", "--json",
+      "--lockorder-out", "tools/graftcheck/lockorder.json"],
      True, _graftcheck_ran),
     # ISSUE 12: bench-trajectory drift check right next to the static
     # analysis — seconds, no TPU needed, and it reads only committed
