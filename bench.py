@@ -379,8 +379,13 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
         loss0, loss = first[0], last[0]
 
         # secondary: per-dispatch step time (what a host-driven loop sees
-        # through this tunnel; on directly attached TPUs dispatch is ~us)
-        dispatch_dt = dt
+        # through this tunnel; on directly attached TPUs dispatch is ~us).
+        # Only measured on TPU — the CPU fallback used to COPY the full
+        # step time here, which tripped the 5 s dispatch budget on every
+        # CPU contract line and (worse) stamped ``error`` on the round
+        # records the drift detector reads, silently hiding fresh
+        # trajectory points.  Un-measured fields are omitted, not faked.
+        dispatch_dt = None
         if not on_cpu:
             t0 = time.perf_counter()
             for i in range(5):
@@ -404,7 +409,8 @@ def run_bench(iters: int, mbs: int, seq: int, recompute: str = "full",
         "vs_baseline": round(mfu / BASELINE_MFU, 3),
         "tokens_per_sec": round(mbs * seq / dt, 1),
         "step_time_s": round(dt, 4),
-        "step_time_dispatch_s": round(dispatch_dt, 4),
+        **({"step_time_dispatch_s": round(dispatch_dt, 4)}
+           if dispatch_dt is not None else {}),
         "compile_time_s": round(compile_s, 1),
         "n_params": n_params,
         "loss": round(loss, 4),

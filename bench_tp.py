@@ -15,6 +15,15 @@ the timing:
   contractions change the reduction order; nothing else may drift);
 * engine decode on a tp-sharded pool emits the same tokens as tp=1.
 
+The ISSUE 15 overlap arm (``--tp_overlap ring``, default on) re-runs
+every tp>1 layout with the chunked collective-matmul forward
+(parallel/overlap.py) and machine-checks the mechanism: ppermute chain +
+``forward-tp{N}-overlap`` scope in the compiled HLO, loss rel <= 1e-4
+vs the overlap-off row (chunked-GEMM reassociation — tolerance, not
+bitwise), and engine greedy-token identity.  On TPU the per-layout
+ring-vs-off steps/sec IS the overlap payoff; on CPU the arm is a
+mechanism/parity record.
+
 On a CPU host the virtual devices share one core, so "scaling" numbers are
 NOT speedups — the CPU line is a correctness/liveness record (headline 0
 by contract, run under ``cpu_sanity``) whose compile/dispatch fields feed
@@ -48,11 +57,13 @@ METRIC = "tp_mesh_train_steps_s"
 EVIDENCE_TAG = "tp"
 
 
-def tiny_cfg(tp: int, dp: int, seq: int, layers: int, hidden: int):
+def tiny_cfg(tp: int, dp: int, seq: int, layers: int, hidden: int,
+             overlap: str = "off"):
     from megatron_llm_tpu.config import Config, apply_architecture
 
     cfg = Config()
     apply_architecture(cfg, "llama2")
+    cfg.parallel.tp_overlap = overlap
     cfg.model.num_layers = layers
     cfg.model.hidden_size = hidden
     cfg.model.num_attention_heads = 4
@@ -98,9 +109,12 @@ def _sharded_param_report(params, shardings) -> dict:
 
 
 def bench_train_layout(tp: int, dp: int, iters: int, seq: int,
-                       layers: int, hidden: int) -> dict:
+                       layers: int, hidden: int,
+                       overlap: str = "off") -> dict:
     """Run the real jitted train step on a (tp, dp) mesh; return timings +
-    mechanism checks."""
+    mechanism checks.  ``overlap='ring'`` exercises the ISSUE 15 chunked
+    collective-matmul forward; its rows carry the ring mechanism
+    evidence (ppermute chain + overlap scope asserted in compiled HLO)."""
     import jax
     import numpy as np
 
@@ -110,7 +124,7 @@ def bench_train_layout(tp: int, dp: int, iters: int, seq: int,
     from megatron_llm_tpu.parallel.tp import param_shardings
     from megatron_llm_tpu.training_step import make_jitted_train_step
 
-    cfg = tiny_cfg(tp, dp, seq, layers, hidden)
+    cfg = tiny_cfg(tp, dp, seq, layers, hidden, overlap=overlap)
     mesh = ps.build_mesh_from_config(cfg)
     with ps.global_mesh(mesh):
         key = rng_mod.init_key(1234)
@@ -131,10 +145,15 @@ def bench_train_layout(tp: int, dp: int, iters: int, seq: int,
         placed = shardings["place_batch"](batch)
         lr = jax.numpy.float32(1e-3)
 
-        # mechanism: the collectives GSPMD inserted for this layout
+        # mechanism: the collectives GSPMD inserted for this layout; the
+        # ring arm additionally asserts the decomposed structure — a
+        # ppermute chain (collective-permute ops) and the
+        # forward-tp{N}-overlap scope in the HLO op metadata
         lowered = step_fn.lower(params, opt_state, placed, lr)
         hlo = lowered.compile().as_text()
         all_reduce_count = hlo.count("all-reduce")
+        ppermute_count = hlo.count("collective-permute")
+        overlap_scope_in_hlo = f"forward-tp{tp}-overlap" in hlo
 
         t0 = time.perf_counter()
         params2, opt2, metrics = step_fn(params, opt_state, placed, lr)
@@ -155,17 +174,20 @@ def bench_train_layout(tp: int, dp: int, iters: int, seq: int,
         report = _sharded_param_report(params, p_shard)
     return {
         "tp": tp, "dp": dp,
+        "tp_overlap": overlap,
         "step_time_s": round(best, 4),
         "steps_per_sec": round(1.0 / best, 3),
         "step_time_dispatch_s": round(dispatch, 4),
         "compile_time_s": round(compile_s, 1),
-        "loss": round(loss, 6),
+        "loss": loss,
         "all_reduce_count": all_reduce_count,
+        "collective_permute_count": ppermute_count,
+        "overlap_scope_in_hlo": overlap_scope_in_hlo,
         **report,
     }
 
 
-def bench_engine_layout(tp: int, ticks: int) -> dict:
+def bench_engine_layout(tp: int, ticks: int, overlap: str = "off") -> dict:
     """Decode ticks/sec + token stream on a (possibly tp-sharded) engine."""
     import jax
 
@@ -173,7 +195,7 @@ def bench_engine_layout(tp: int, ticks: int) -> dict:
     from megatron_llm_tpu.generation.engine import ContinuousBatchingEngine
     from megatron_llm_tpu.models import init_model_params
 
-    cfg = tiny_cfg(1, 1, 64, 2, 64)
+    cfg = tiny_cfg(1, 1, 64, 2, 64, overlap=overlap)
     params = init_model_params(cfg, jax.random.PRNGKey(0))
     mesh = None
     if tp > 1:
@@ -192,6 +214,7 @@ def bench_engine_layout(tp: int, ticks: int) -> dict:
     toks = [r.result()[0] for r in reqs]
     return {
         "tp": tp,
+        "tp_overlap": overlap,
         "decode_wall_s": round(wall, 3),
         "ticks": eng.ticks,
         "ticks_per_sec": round(eng.ticks / wall, 2) if wall else 0.0,
@@ -199,8 +222,59 @@ def bench_engine_layout(tp: int, ticks: int) -> dict:
     }
 
 
+def run_overlap_arm(tps, iters: int, seq: int, layers: int, hidden: int,
+                    engine_ticks: int, base_rows, base_eng) -> dict:
+    """The ISSUE 15 overlap on/off arm: for every tp > 1 layout, run the
+    SAME train step and engine with ``--tp_overlap ring`` and verify the
+    mechanism + numerics against the overlap-off rows measured above:
+
+    * the compiled ring HLO carries a ppermute chain (collective-permute
+      ops beyond the off layout's) and the ``forward-tp{N}-overlap``
+      scope in op metadata — overlap asserted, not assumed;
+    * training loss matches overlap-off within rel 1e-4 (chunked-GEMM
+      reassociation: tolerance, NOT bitwise — parallel/overlap.py
+      documents why);
+    * engine greedy decode emits identical tokens.
+    """
+    off_by_tp = {r["tp"]: r for r in base_rows if "skipped" not in r}
+    eng_by_tp = {r["tp"]: r for r in (base_eng or [])}
+    rows, mechanism_ok = [], True
+    for tp in tps:
+        if tp <= 1 or tp not in off_by_tp:
+            continue
+        row = bench_train_layout(tp, 1, iters, seq, layers, hidden,
+                                 overlap="ring")
+        off = off_by_tp[tp]
+        loss_rel = (abs(row["loss"] - off["loss"])
+                    / max(abs(off["loss"]), 1e-12))
+        checks = {
+            "overlap_scope_in_hlo": row["overlap_scope_in_hlo"],
+            "ppermute_chain": (row["collective_permute_count"]
+                               > off.get("collective_permute_count", 0)),
+            "loss_rel_vs_off": round(loss_rel, 9),
+            "loss_parity_ok": loss_rel <= 1e-4,
+        }
+        entry = {**row, **checks,
+                 "speedup_vs_off": round(off["step_time_s"]
+                                         / row["step_time_s"], 3)}
+        if engine_ticks and tp in eng_by_tp:
+            ering = bench_engine_layout(tp, engine_ticks, overlap="ring")
+            entry["engine_ticks_per_sec"] = ering["ticks_per_sec"]
+            entry["engine_tokens_match_off"] = (
+                ering.pop("tokens") == eng_by_tp[tp].get("tokens"))
+            checks["engine_tokens_match_off"] = entry[
+                "engine_tokens_match_off"]
+        ok = (checks["overlap_scope_in_hlo"] and checks["ppermute_chain"]
+              and checks["loss_parity_ok"]
+              and checks.get("engine_tokens_match_off", True))
+        entry["mechanism_ok"] = ok
+        mechanism_ok = mechanism_ok and ok
+        rows.append(entry)
+    return {"layouts": rows, "mechanism_ok": mechanism_ok}
+
+
 def run(iters: int, tps, seq: int, layers: int, hidden: int,
-        engine_ticks: int) -> dict:
+        engine_ticks: int, overlap_arm: str = "ring") -> dict:
     import jax
 
     n_dev = len(jax.devices())
@@ -230,8 +304,15 @@ def run(iters: int, tps, seq: int, layers: int, hidden: int,
         if eb is not None:
             eng_parity = all(r["tokens"] == eb["tokens"]
                              for r in eng_rows if r["tp"] != 1)
-        for r in eng_rows:
-            r.pop("tokens", None)
+
+    # the ISSUE 15 overlap arm rides on the off rows just measured
+    # (needs the engine token streams, so it runs before the pop)
+    overlap = None
+    if overlap_arm == "ring":
+        overlap = run_overlap_arm(tps, iters, seq, layers, hidden,
+                                  engine_ticks, ok_rows, eng_rows)
+    for r in eng_rows:
+        r.pop("tokens", None)
 
     head = max(ok_rows, key=lambda r: r["tp"], default=None)
     result = {
@@ -242,6 +323,7 @@ def run(iters: int, tps, seq: int, layers: int, hidden: int,
         "loss_parity_vs_tp1": parity,
         "engine_layouts": eng_rows,
         "engine_tokens_match_tp1": eng_parity,
+        "overlap": overlap,
         "n_devices": n_dev,
         "backend": jax.devices()[0].platform,
     }
@@ -263,6 +345,10 @@ def main() -> None:
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--engine_ticks", type=int, default=8,
                     help="decode ticks per engine parity row (0 = skip)")
+    ap.add_argument("--tp_overlap", default="ring",
+                    choices=["off", "ring"],
+                    help="run the compute/collective-overlap arm for "
+                         "tp > 1 layouts (ISSUE 15; 'off' skips it)")
     ap.add_argument("--watchdog_s", type=float, default=1200.0)
     args = ap.parse_args()
     tps = [int(x) for x in args.tp.split(",") if x]
@@ -279,8 +365,15 @@ def main() -> None:
     timer.start()
 
     backend = probe_backend()
+    if backend == "cpu":
+        # host-device-count sanity mode: the layout sweep needs virtual
+        # devices (the committed evidence is an 8-device CPU record);
+        # without this pin a bare host would skip every tp > 1 row
+        from megatron_llm_tpu.utils.platform import pin_cpu_platform
+
+        pin_cpu_platform(n_devices=8)
     result = run(args.iters, tps, args.seq, args.layers, args.hidden,
-                 args.engine_ticks)
+                 args.engine_ticks, overlap_arm=args.tp_overlap)
     timer.cancel()
 
     if backend == "tpu" and result["backend"] == "tpu":

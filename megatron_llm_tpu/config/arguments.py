@@ -150,6 +150,20 @@ class ParallelConfig:
     # Megatron-style sequence parallelism: shard seq dim over tp in LN/dropout
     # regions (activation memory / TP).
     sequence_parallel: bool = False
+    # Fine-grained compute/collective overlap (parallel/overlap.py, ROADMAP
+    # item 3): 'ring' decomposes the row-parallel all-reduce/reduce-scatter
+    # (and the column-parallel all-gather under SP) into a chunked
+    # collective matmul — tp GEMM chunks pipelined against ppermute hops —
+    # inside a full-manual shard_map region.  'off' (default) keeps
+    # today's XLA-inserted collectives byte for byte.  Silently inert at
+    # tp == 1 and on pp/cp layouts (those own their manual regions).
+    tp_overlap: str = "off"
+    # int8-quantize the ring's wire chunks (per-chunk f32 scales, compute-
+    # dtype accumulate; straight-through backward) — the forward-collective
+    # member of the --quantized_* family, closing the PR 13 follow-on.
+    # Only meaningful with --tp_overlap ring; error bound documented in
+    # docs/guide/quantization.md.
+    quantized_tp_collectives: bool = False
     # declares that cp batches follow the STANDARD zigzag layout
     # (parallel/ring.py:apply_zigzag) — lets causal ring attention use the
     # striped Pallas kernels instead of the jnp fallback; set it alongside
@@ -175,6 +189,8 @@ class ParallelConfig:
     distribute_saved_activations: bool = False
 
     def finalize(self, n_devices: Optional[int] = None) -> None:
+        assert self.tp_overlap in ("off", "ring"), (
+            f"--tp_overlap must be 'off' or 'ring', got {self.tp_overlap!r}")
         if self.data_parallel_size is None and n_devices is not None:
             mp = (
                 self.tensor_model_parallel_size

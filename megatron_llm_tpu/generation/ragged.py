@@ -82,8 +82,14 @@ def row_horizons(positions: jax.Array) -> jax.Array:
 
 
 def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
-                        *, tp: int = 1):
+                        *, tp: int = 1, mesh=None):
     """Build the fused ragged tick the engine compiles once per geometry.
+
+    ``mesh`` (with ``--tp_overlap ring``) activates the chunked
+    collective-matmul interception (parallel/overlap.py) for every
+    forward in the tick — target, draft and prefill rows alike; the
+    engine keys its compiled-program cache on the effective mode, so
+    overlap and non-overlap engines never share executables.
 
     Returned signature, ``spec_k >= 1`` (draft model present)::
 
@@ -119,6 +125,9 @@ def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
     block, or all-decode, or all-prefill — re-dispatches the same
     executable.
     """
+    from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+
+    ovl = tp_overlap_mod.overlap_params(cfg, mesh)
     K = spec_k
     vocab = cfg.model.vocab_size
     scope_t = ("ragged-fwd" if tp == 1 else f"ragged-fwd-tp{tp}") \
@@ -295,4 +304,15 @@ def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
         return (pool_k, pool_v, next_tok, logp,
                 positions + 1, steps + 1)
 
-    return spec_tick if K else tick
+    base_fn = spec_tick if K else tick
+    if ovl is None:
+        return base_fn
+
+    def overlapped(*args, **kw):
+        # trace-time context: every model_forward in the tick — target,
+        # draft scan, prefill rows — routes its row-parallel projections
+        # through the ring while this builder's closure is being traced
+        with tp_overlap_mod.activate(ovl):
+            return base_fn(*args, **kw)
+
+    return overlapped

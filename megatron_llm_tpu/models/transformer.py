@@ -207,8 +207,13 @@ def attention_sublayer(
     b, s, _ = x.shape
     n, nkv, d = m.num_attention_heads, m.num_attention_heads_kv, m.kv_channels
 
+    from megatron_llm_tpu.parallel.tp import (
+        apply_column_parallel,
+        apply_row_parallel,
+    )
+
     linear = _linear_impl(cfg)
-    qkv = linear(p["qkv"], x)
+    qkv = apply_column_parallel(cfg, p["qkv"], x, linear)
     q, k, v = split_qkv(qkv, n, nkv, d)
 
     if rope is not None:
@@ -322,7 +327,8 @@ def attention_sublayer(
     from jax.ad_checkpoint import checkpoint_name
 
     ctx = checkpoint_name(ctx, "attn_out")
-    out = linear(p["dense"], ctx.reshape(b, s, n * d))
+    out = apply_row_parallel(cfg, p["dense"], ctx.reshape(b, s, n * d),
+                             linear)
     return out, new_cache
 
 
@@ -372,15 +378,22 @@ def mlp_sublayer(cfg, p: Params, x: jax.Array) -> jax.Array:
     gate is x1 * act(x2) matching the reference chunk-2 convention
     (glu_activations.py:14-16).
     """
+    from megatron_llm_tpu.parallel.tp import (
+        apply_column_parallel,
+        apply_row_parallel,
+    )
+
     m = cfg.model
     linear = _linear_impl(cfg)
     if m.glu_activation is not None:
         act = GLU_BASE_ACTIVATIONS[m.glu_activation]
-        y = linear(p["fc1"], x)  # [..., 2, ffn] (both impls restore the axis)
+        # [..., 2, ffn] (both impls restore the axis)
+        y = apply_column_parallel(cfg, p["fc1"], x, linear)
         gated = y[..., 0, :] * act(y[..., 1, :])
-        return linear(p["fc2"], gated)
+        return apply_row_parallel(cfg, p["fc2"], gated, linear)
     act = get_mlp_activation(None, m.activation)
-    return linear(p["fc2"], act(linear(p["fc1"], x)))
+    h = act(apply_column_parallel(cfg, p["fc1"], x, linear))
+    return apply_row_parallel(cfg, p["fc2"], h, linear)
 
 
 # ---------------------------------------------------------------------------

@@ -200,3 +200,32 @@ def make_sp_constraint(cfg, mesh: Optional[Mesh] = None):
 def logits_spec() -> P:
     """Logits [b, s, vocab]: vocab sharded over tp (vocab-parallel CE)."""
     return P(DATA_AXES, None, TP_AXIS)
+
+
+# ---------------------------------------------------------------------------
+# Overlap-aware apply functions (parallel/overlap.py)
+# ---------------------------------------------------------------------------
+#
+# The spec rules above tell XLA *where* tensors live; these apply functions
+# are the explicit interception point for *how* the TP collectives run.
+# The transformer sublayers route their row/column projections through
+# them: inactive (the default --tp_overlap off, tp == 1, pp/cp layouts,
+# quantized/fp8 kernels) they ARE the plain projection, byte for byte;
+# active, the projection becomes the chunked collective-matmul ring that
+# pipelines the all-reduce/reduce-scatter (row) or all-gather (column+SP)
+# against its own GEMM.  Lazy import keeps tp.py free of a hard overlap
+# dependency for spec-only users (checkpoint resharding tools).
+
+
+def apply_row_parallel(cfg, p, x, linear):
+    """Row-parallel projection (attention ``dense``, ``fc2``)."""
+    from megatron_llm_tpu.parallel import overlap
+
+    return overlap.row_parallel(cfg, p, x, linear)
+
+
+def apply_column_parallel(cfg, p, x, linear):
+    """Column-parallel projection (``qkv``, ``fc1``)."""
+    from megatron_llm_tpu.parallel import overlap
+
+    return overlap.column_parallel(cfg, p, x, linear)

@@ -105,14 +105,23 @@ def make_train_step(cfg, optimizer: Optional[optax.GradientTransformation] = Non
     # collectives GSPMD inserts inherit this scope in their HLO op
     # metadata, so device profiles (observability/profiler.py) attribute
     # the TP all-reduces to the forward instead of an anonymous fusion.
+    # With --tp_overlap ring the scope carries the overlap marker
+    # (forward-tp{N}-overlap) and the sublayers' row/column projections
+    # run as chunked collective-matmul rings (parallel/overlap.py).
+    from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+
     _tp_deg = (mesh.shape.get("tp", 1) if mesh is not None else 1)
-    _fwd_scope = "forward" if _tp_deg == 1 else f"forward-tp{_tp_deg}"
+    _ovl = tp_overlap_mod.overlap_params(cfg, mesh)
+    if _ovl is not None:
+        _fwd_scope = tp_overlap_mod.overlap_scope_name(_tp_deg)
+    else:
+        _fwd_scope = "forward" if _tp_deg == 1 else f"forward-tp{_tp_deg}"
 
     def micro_loss(params, mb, dropout_key, rope):
         deterministic = (
             cfg.model.hidden_dropout == 0.0 and cfg.model.attention_dropout == 0.0
         ) or dropout_key is None
-        with jax.named_scope(_fwd_scope):
+        with jax.named_scope(_fwd_scope), tp_overlap_mod.activate(_ovl):
             return loss_fn(
                 cfg, params, mb,
                 dropout_key=dropout_key,

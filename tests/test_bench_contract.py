@@ -885,6 +885,42 @@ def test_tp_bench_committed_cpu_evidence():
     assert sanity["engine_tokens_match_tp1"] is True
 
 
+def test_tp_bench_committed_overlap_evidence():
+    """ISSUE 15 acceptance: the committed bench_tp evidence carries the
+    overlap arm with the mechanism MACHINE-asserted — ppermute chain +
+    forward-tp{N}-overlap scope in the compiled HLO, loss parity within
+    rel 1e-4 of the overlap-off row (chunked-GEMM reassociation:
+    tolerance, not bitwise), engine greedy tokens identical."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_tp_cpu_sanity.json")
+    with open(path) as f:
+        line = json.load(f)
+    arm = line["cpu_sanity"]["overlap"]
+    assert arm["mechanism_ok"] is True
+    rows = [r for r in arm["layouts"] if "skipped" not in r]
+    assert rows, "overlap arm has no measured layouts"
+    for r in rows:
+        assert r["tp_overlap"] == "ring"
+        assert r["overlap_scope_in_hlo"] is True
+        assert r["ppermute_chain"] is True
+        assert r["loss_rel_vs_off"] <= 1e-4
+        assert r["engine_tokens_match_off"] is True
+        # the ring re-associates but must not lose the tp collectives'
+        # semantics: the layout still reports tp-sharded params
+        assert r["tp_sharded_leaves"] > 0
+
+
+def test_tp_bench_overlap_arm_shape():
+    """run_overlap_arm contract on synthetic rows: mechanism_ok goes
+    false when any check fails, and tp=1 rows are never ring-armed."""
+    import bench_tp
+
+    base = [{"tp": 1, "step_time_s": 1.0, "loss": 6.0,
+             "collective_permute_count": 0}]
+    arm = bench_tp.run_overlap_arm([1], 1, 64, 2, 64, 0, base, [])
+    assert arm["layouts"] == [] and arm["mechanism_ok"] is True
+
+
 # ---------------------------------------------------------------------------
 # ISSUE 12: bench-trajectory drift detector (tools/bench_drift.py)
 # ---------------------------------------------------------------------------
@@ -938,22 +974,33 @@ def test_bench_drift_computation_synthetic():
 
 
 def test_bench_drift_flags_committed_trajectory():
-    """ROADMAP item 4 made measurable: on the committed BENCH_r*
-    evidence the detector reports the un-bisected CPU-sanity drift
-    (step 18.4s -> 52.2s, compile 38s -> 100s) as a drift verdict —
-    this test starts failing the day someone fixes the regression and
-    refreshes the evidence, which is exactly when the thresholds should
-    become a regression gate instead."""
+    """ROADMAP item 3 CLOSED (ISSUE 15): the r02->r05 "drift" was
+    root-caused as host contention, not code — the round-5 record
+    (step 52.2s / compile 100.4s) was measured while the staged 470M
+    e2e jobs shared the single-core host (both metrics inflated by the
+    same ~2.1x, the signature of CPU-time division), and re-measuring
+    the EXACT r05 tree on an idle host gives 24.4s/47.6s, matching the
+    r04 tree (23.6s/47.8s) and HEAD.  BENCH_r06.json is the clean
+    re-measurement (its ``note`` carries the bisect evidence).  This
+    test now pins the FIX: the refreshed trajectory must stay within
+    the drift thresholds — any future round that trips them is a real
+    regression to bisect, not carried debt."""
     from tools.bench_drift import compute_drift, load_trajectory
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     rows = load_trajectory(repo)
-    assert len(rows) >= 4, "committed BENCH_r* trajectory went missing"
+    assert len(rows) >= 5, "committed BENCH_r* trajectory went missing"
+    assert rows[-1][1] == "BENCH_r06.json", (
+        "the root-cause refresh round went missing — newest round is "
+        f"{rows[-1][1]}")
     res = compute_drift(rows)
-    assert res["verdict"] == "drift"
-    assert res["metrics"]["step_time_s"]["exceeded"] is True
-    assert res["metrics"]["step_time_s"]["ratio"] > 2.0
-    assert res["metrics"]["compile_time_s"]["exceeded"] is True
+    assert res["verdict"] == "ok", res
+    for field in ("step_time_s", "compile_time_s", "tokens_per_sec"):
+        assert res["metrics"][field]["exceeded"] is False, res["metrics"]
+    # the contaminated r05 point stays committed (history is honest);
+    # only the newest-vs-earliest ratio gates
+    assert res["metrics"]["step_time_s"]["ratio"] < 1.5
+    assert res["metrics"]["compile_time_s"]["ratio"] < 1.5
 
 
 # ---------------------------------------------------------------------------

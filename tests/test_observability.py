@@ -589,10 +589,14 @@ def test_generation_server_metrics_endpoint():
                       # ISSUE 13: quantized-KV capacity telemetry
                       "mlt_engine_kv_pool_bytes",
                       "mlt_engine_kv_scale_bytes",
-                      "mlt_engine_kv_dtype_info"):
+                      "mlt_engine_kv_dtype_info",
+                      # ISSUE 15: compute/collective overlap mode
+                      "mlt_tp_overlap_info"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
         assert 'mlt_engine_kv_dtype_info{kv_dtype="bf16"} 1' in body
+        # a no-mesh engine reports the off mode at tp=1
+        assert 'mlt_tp_overlap_info{mode="off",tp="1"} 1' in body
         # /health still answers alongside
         code, body, _ = _get(f"http://127.0.0.1:{port}/health")
         health = json.loads(body)

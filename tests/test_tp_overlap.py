@@ -1,0 +1,342 @@
+"""ISSUE 15: fine-grained compute/collective overlap (parallel/overlap.py).
+
+The parity matrix the acceptance criteria name:
+
+* train-step loss + grad parity at tp=2/4, with and without sequence
+  parallelism — ring vs off within rel 1e-4 (chunked-GEMM reassociation:
+  tolerance, NOT bitwise — the overlap.py docstring documents why),
+  with the ring mechanism machine-asserted in the compiled HLO
+  (ppermute chain + ``forward-tp{N}-overlap`` scope metadata);
+* engine greedy-token identity at tp=4, ragged AND legacy tick, with
+  per-token log-probs within 5e-6 and the overlap span in a trace dump;
+* int8 wire chunks vs the f32 ring (bounded by the per-hop rounding
+  analysis) and vs the plain path;
+* single-chip degradation: ``--tp_overlap ring`` at tp=1 is silently
+  off — bitwise the no-mesh engine;
+* cached_jit key regression: overlap engines never reuse non-overlap
+  executables;
+* graftcheck fixture: the overlap module passes the sweep with zero
+  findings and zero ``noqa`` waivers.
+"""
+
+import copy
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu.core import parallel_state as ps
+from megatron_llm_tpu.core import rng as rng_mod
+from megatron_llm_tpu.models import init_model_params, make_config
+from megatron_llm_tpu.parallel import overlap as ovl_mod
+from megatron_llm_tpu.parallel.tp import param_shardings
+
+VOCAB = 512  # pads identically at tp in {1, 2, 4} (test_tp_mesh.py note)
+
+
+def _toy_cfg(tp: int, sp: bool = False, overlap: str = "off",
+             quantized: bool = False):
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=4, ffn_hidden_size=128, seq_length=64,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False,
+    )
+    cfg.parallel.tensor_model_parallel_size = tp
+    cfg.parallel.data_parallel_size = 1
+    cfg.parallel.sequence_parallel = sp
+    cfg.parallel.tp_overlap = overlap
+    cfg.parallel.quantized_tp_collectives = quantized
+    return cfg
+
+
+def _train_step_once(cfg, mesh):
+    """One jitted train step; returns (loss, grad_norm, compiled HLO)."""
+    from megatron_llm_tpu.training_step import make_jitted_train_step
+
+    with ps.global_mesh(mesh):
+        key = rng_mod.init_key(7)
+        p_shard = param_shardings(
+            mesh, jax.eval_shape(lambda k: init_model_params(cfg, k), key))
+        # per-cell compile is the point of the parity matrix
+        params = jax.jit(  # graftcheck: noqa[recompile-hazard]
+            lambda k: init_model_params(cfg, k), out_shardings=p_shard)(key)
+        step_fn, optimizer, sh = make_jitted_train_step(cfg, mesh, params)
+        opt_state = optimizer.init(params)
+        rng = np.random.RandomState(1)
+        batch = {
+            "tokens": rng.randint(2, VOCAB, (4, 64)).astype(np.int32),
+            "labels": rng.randint(2, VOCAB, (4, 64)).astype(np.int32),
+            "loss_mask": np.ones((4, 64), np.float32),
+        }
+        placed = sh["place_batch"](batch)
+        lr = jnp.float32(1e-3)
+        hlo = step_fn.lower(params, opt_state, placed, lr).compile().as_text()
+        _, _, metrics = step_fn(params, opt_state, placed, lr)
+        return float(metrics["lm loss"]), float(metrics["grad_norm"]), hlo
+
+
+@pytest.mark.parametrize("tp,sp", [(2, False), (2, True),
+                                   (4, False), (4, True)])
+def test_train_parity_matrix(eight_devices, tp, sp):
+    """Ring vs off at the same (tp, sp): loss rel <= 1e-4, grad norm rel
+    <= 1e-3, and the ring program carries the decomposed mechanism."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=tp,
+                         data_parallel_size=1, devices=eight_devices[:tp])
+    off = _train_step_once(_toy_cfg(tp, sp, "off"), mesh)
+    ring = _train_step_once(_toy_cfg(tp, sp, "ring"), mesh)
+    loss_rel = abs(ring[0] - off[0]) / abs(off[0])
+    gn_rel = abs(ring[1] - off[1]) / max(abs(off[1]), 1e-12)
+    assert loss_rel <= 1e-4, (off[0], ring[0])
+    assert gn_rel <= 1e-3, (off[1], ring[1])
+    # mechanism, not vibes: the overlap scope is stamped on the ring HLO
+    # and the ppermute chain exists beyond whatever XLA emits on its own
+    scope = f"forward-tp{tp}-overlap"
+    assert scope in ring[2], "ring HLO lost the overlap scope"
+    assert scope not in off[2], "off HLO must stay byte-for-byte un-ringed"
+    assert (ring[2].count("collective-permute")
+            > off[2].count("collective-permute"))
+
+
+def test_quantized_wire_bounded_vs_f32_ring(eight_devices):
+    """--quantized_tp_collectives: int8 wire chunks vs the f32 ring,
+    bounded by the per-hop rounding analysis (<= (tp-1) * scale/2 per
+    element, scale = absmax/127 of the largest in-flight accumulator)."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=eight_devices[:4])
+    cfg_f32 = _toy_cfg(4, overlap="ring")
+    cfg_q = _toy_cfg(4, overlap="ring", quantized=True)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 12).astype(np.float32))
+
+    def run(cfg):
+        with ps.global_mesh(mesh):
+            ovl = ovl_mod.overlap_params(cfg, mesh)
+            assert ovl is not None
+
+            def f(xx, ww):
+                with ovl_mod.activate(ovl):
+                    return ovl_mod.row_parallel(
+                        cfg, {"kernel": ww}, xx,
+                        lambda p, x_: x_ @ p["kernel"])
+
+            return np.asarray(jax.jit(f)(x, w))
+
+    y32 = run(cfg_f32)
+    yq = run(cfg_q)
+    # worst-case wire scale from the largest partial product; 3 hops
+    partial_max = float(jnp.max(jnp.abs(x @ w))) * 4
+    bound = 3 * (partial_max / 127.0) / 2 * 4  # generous: 4x analysis slack
+    assert float(np.max(np.abs(yq - y32))) <= bound
+    # and the f32 ring itself matches the plain matmul tightly
+    assert float(np.max(np.abs(y32 - np.asarray(x @ w)))) < 1e-4
+
+
+def _run_engine(cfg, params, mesh, ragged=True, n_req=3, tokens=8):
+    from megatron_llm_tpu.generation.engine import ContinuousBatchingEngine
+
+    eng = ContinuousBatchingEngine(cfg, params, None, max_slots=4,
+                                   num_pages=64, page_size=16,
+                                   ragged=ragged, mesh=mesh)
+    prompts = [[2 + (7 * i + j) % (VOCAB - 2) for j in range(13)]
+               for i in range(n_req)]
+    reqs = [eng.submit(p, tokens, temperature=1.0, top_k=0, top_p=0.0,
+                       seed=11 + i) for i, p in enumerate(prompts)]
+    eng.run_until_idle()
+    return eng, [(r.result()[0], list(r.log_probs)) for r in reqs]
+
+
+@pytest.mark.parametrize("ragged", [True, False])
+def test_engine_tp4_token_identity(eight_devices, ragged):
+    """Engine greedy decode at tp=4: ring emits the SAME tokens as off
+    (both tick modes); per-token log-probs within 5e-6."""
+    cfg = _toy_cfg(1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=eight_devices[:4])
+    c_off = copy.deepcopy(cfg)
+    c_ring = copy.deepcopy(cfg)
+    c_ring.parallel.tp_overlap = "ring"
+    _, off = _run_engine(c_off, params, mesh, ragged=ragged)
+    from megatron_llm_tpu.observability import trace as obs_trace
+
+    tracer = obs_trace.configure()
+    eng, ring = _run_engine(c_ring, params, mesh, ragged=ragged)
+    for (t0, l0), (t1, l1) in zip(off, ring):
+        assert t0 == t1
+        np.testing.assert_allclose(l0, l1, atol=5e-6)
+    # overlap observable: the forward-tp4-overlap span in the trace dump
+    names = {e[1] for e in tracer.snapshot()}
+    assert "forward-tp4-overlap" in names, sorted(names)
+    assert eng._overlap_mode == "ring"
+    obs_trace.disable()
+
+
+def test_engine_tp4_quantized_wire_tokens(eight_devices):
+    """int8 wire chunks keep greedy tokens identical on the toy shape
+    (deterministic quantization; real-margin models — the PR 13 int8-KV
+    lesson — are why the BENCH gate stays a short sanity horizon)."""
+    cfg = _toy_cfg(1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=eight_devices[:4])
+    c_off = copy.deepcopy(cfg)
+    c_q = copy.deepcopy(cfg)
+    c_q.parallel.tp_overlap = "ring"
+    c_q.parallel.quantized_tp_collectives = True
+    _, off = _run_engine(c_off, params, mesh)
+    _, q = _run_engine(c_q, params, mesh)
+    for (t0, _), (t1, _) in zip(off, q):
+        assert t0 == t1
+
+
+def test_single_chip_degradation_silently_off(eight_devices):
+    """--tp_overlap ring at tp=1: overlap resolves to None (the flag is
+    inert) and the engine is BITWISE the no-mesh engine."""
+    cfg = _toy_cfg(1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    _, base = _run_engine(cfg, params, None)
+    c_ring = copy.deepcopy(cfg)
+    c_ring.parallel.tp_overlap = "ring"
+    mesh1 = ps.build_mesh(devices=eight_devices[:1])
+    assert ovl_mod.overlap_params(c_ring, mesh1) is None
+    eng, one = _run_engine(c_ring, params, mesh1)
+    assert eng._overlap_mode == "off"
+    for (t0, l0), (t1, l1) in zip(base, one):
+        assert t0 == t1
+        assert l0 == l1  # bitwise: no ring, no collectives at tp=1
+
+
+def test_overlap_gating():
+    """overlap_params returns None exactly when the ring must not build:
+    mode off, no mesh, tp == 1, pp/cp layouts (foreign manual regions),
+    fp8 forwards."""
+    cfg = _toy_cfg(1, overlap="ring")
+    devs = jax.devices()
+    assert ovl_mod.overlap_params(cfg, None) is None
+    mesh_tp4 = ps.build_mesh(tensor_model_parallel_size=4,
+                             data_parallel_size=1, devices=devs[:4])
+    assert ovl_mod.overlap_params(cfg, mesh_tp4) is not None
+    off = _toy_cfg(1, overlap="off")
+    assert ovl_mod.overlap_params(off, mesh_tp4) is None
+    mesh_pp = ps.build_mesh(tensor_model_parallel_size=2,
+                            pipeline_model_parallel_size=2,
+                            data_parallel_size=1, devices=devs[:4])
+    assert ovl_mod.overlap_params(cfg, mesh_pp) is None
+    mesh_cp = ps.build_mesh(tensor_model_parallel_size=2,
+                            context_parallel_size=2,
+                            data_parallel_size=1, devices=devs[:4])
+    assert ovl_mod.overlap_params(cfg, mesh_cp) is None
+    fp8 = _toy_cfg(1, overlap="ring")
+    fp8.model.fp8 = "e4m3"
+    assert ovl_mod.overlap_params(fp8, mesh_tp4) is None
+    bad = _toy_cfg(1)
+    bad.parallel.tp_overlap = "banana"
+    with pytest.raises(AssertionError):
+        ovl_mod.overlap_params(bad, mesh_tp4)
+
+
+def test_cached_jit_keys_never_cross_overlap_modes(eight_devices):
+    """Regression: an overlap engine and a plain engine on the SAME mesh
+    must key different executables — the effective mode rides in
+    _mesh_statics (the config fingerprint alone cannot separate engines
+    whose cfg matches but whose mesh makes the flag inert)."""
+    from megatron_llm_tpu.generation.engine import ContinuousBatchingEngine
+
+    cfg = _toy_cfg(1)
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=eight_devices[:4])
+    c_ring = copy.deepcopy(cfg)
+    c_ring.parallel.tp_overlap = "ring"
+    e_off = ContinuousBatchingEngine(cfg, params, None, max_slots=4,
+                                     num_pages=64, page_size=16, mesh=mesh)
+    e_ring = ContinuousBatchingEngine(c_ring, params, None, max_slots=4,
+                                      num_pages=64, page_size=16, mesh=mesh)
+    assert ("tp_overlap", "off") == e_off._mesh_statics[-2:]
+    assert ("tp_overlap", "ring") == e_ring._mesh_statics[-2:]
+    assert e_off._mesh_statics != e_ring._mesh_statics
+    # and the compiled tick programs are distinct cache entries
+    assert e_off._tick() is not e_ring._tick()
+    # a no-mesh engine also never collides with a ring engine even under
+    # an overlap-requesting cfg (the inert-flag case)
+    e_none = ContinuousBatchingEngine(c_ring, params, None, max_slots=4,
+                                      num_pages=64, page_size=16)
+    assert e_none._mesh_statics[-2:] == ("tp_overlap", "off")
+    assert e_none._mesh_statics != e_ring._mesh_statics
+
+
+def test_row_ring_under_dp_mesh(eight_devices):
+    """The full-manual region names every mesh axis: a (dp=2, tp=4) mesh
+    runs the ring with the batch sharded over dp and reduces only over
+    tp — parity vs the plain projection."""
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=2, devices=eight_devices[:8])
+    cfg = _toy_cfg(4, overlap="ring")
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 6, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    with ps.global_mesh(mesh):
+        ovl = ovl_mod.overlap_params(cfg, mesh)
+        assert ovl is not None and ovl.data == 2
+
+        def f(xx, ww):
+            with ovl_mod.activate(ovl):
+                return ovl_mod.row_parallel(cfg, {"kernel": ww}, xx,
+                                            lambda p, x_: x_ @ p["kernel"])
+
+        y = np.asarray(jax.jit(f)(x, w))
+    np.testing.assert_allclose(y, np.asarray(x @ w), atol=1e-4)
+
+
+def test_fallbacks_keep_plain_path():
+    """Ineligible operands fall back to the plain projection even with an
+    active context: int8-quantized kernels (kernel_q trees), shapes the
+    tp cannot divide, and code already inside a foreign manual region."""
+    devs = jax.devices()
+    mesh = ps.build_mesh(tensor_model_parallel_size=4,
+                         data_parallel_size=1, devices=devs[:4])
+    cfg = _toy_cfg(1, overlap="ring")
+    ovl = ovl_mod.overlap_params(cfg, mesh)
+    x = jnp.ones((2, 4, 16), jnp.float32)
+    sentinel = []
+
+    def fb(p, x_):
+        sentinel.append(True)
+        return x_ @ p.get("kernel", jnp.eye(16, dtype=jnp.float32))
+
+    with ovl_mod.activate(ovl):
+        # quantized leaf: no "kernel" key
+        ovl_mod.row_parallel(cfg, {"kernel_q": jnp.ones((16, 8))}, x, fb)
+        assert sentinel.pop()
+        # contraction dim not divisible by tp
+        ovl_mod.row_parallel(
+            cfg, {"kernel": jnp.ones((18, 8), jnp.float32)},
+            jnp.ones((2, 4, 18), jnp.float32), fb)
+        assert sentinel.pop()
+        # column without SP: nothing to overlap
+        ovl_mod.column_parallel(
+            cfg, {"kernel": jnp.ones((16, 8), jnp.float32)}, x, fb)
+        assert sentinel.pop()
+
+
+def test_graftcheck_overlap_module_clean():
+    """Tooling fixture (ISSUE 15): the overlap module passes the
+    graftcheck sweep with ZERO findings and ZERO noqa waivers — new
+    collective code enters the repo lint-clean, not baselined."""
+    from tools.graftcheck import core
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "megatron_llm_tpu", "parallel", "overlap.py")
+    with open(path) as f:
+        src = f.read()
+    assert "noqa" not in src, "overlap.py must not carry lint waivers"
+    res = core.run([path], root=repo)
+    errors = [f for f in res.findings if f.severity == "error"]
+    assert res.files == 1
+    assert not errors, [f"{f.rule}: {f.message}" for f in errors]

@@ -133,8 +133,10 @@ JOBS = [
      True, _graftcheck_ran),
     # ISSUE 12: bench-trajectory drift check right next to the static
     # analysis — seconds, no TPU needed, and it reads only committed
-    # evidence.  The ROADMAP item-4 CPU-sanity drift (18.4s -> 52.2s
-    # step) trips it by design until someone bisects and fixes it.
+    # evidence.  ISSUE 15 root-caused the r02->r05 trajectory (host
+    # contention during round 5, re-measured clean in BENCH_r06.json);
+    # the thresholds are a standing regression gate now — a "drift"
+    # verdict means bisect the code (after checking host load).
     ("bench_drift", [sys.executable, "tools/bench_drift.py"],
      True, _drift_ran),
     ("kernel_check", [sys.executable, "tools/tpu_kernel_check.py", "--quick"],
@@ -215,7 +217,11 @@ JOBS = [
     # with sharded-param/collective/loss-parity mechanism checks and engine
     # decode-token parity; CPU hosts run it as a host-device-count sanity
     # mode (own watchdog, bench contract with host-cost budgets; evidence
-    # in BENCH_LAST_TPU_tp.json, CPU record in BENCH_tp_cpu_sanity.json)
+    # in BENCH_LAST_TPU_tp.json, CPU record in BENCH_tp_cpu_sanity.json).
+    # ISSUE 15: the default run now includes the --tp_overlap ring arm —
+    # on TPU the ring-vs-off steps/sec is the fine-grained-overlap payoff
+    # evidence; the arm's HLO mechanism checks (ppermute chain + overlap
+    # scope) and parity gates ride the same contract line.
     ("bench_tp", [sys.executable, "bench_tp.py"],
      False, _bench_on_tpu),
     # ISSUE 3: resilience chaos smoke — kill-9/corrupt/hang round-trips on
