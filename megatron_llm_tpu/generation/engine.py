@@ -559,6 +559,7 @@ class ContinuousBatchingEngine:
                  flight_records: Optional[int] = None,
                  flight_events: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
+                 tick_pipeline_depth: Optional[int] = None,
                  mesh: Optional[Mesh] = None):
         inf = cfg.inference
         self.cfg = cfg
@@ -712,6 +713,15 @@ class ContinuousBatchingEngine:
         # one storage discipline for every page.
         self.kv_dtype = (kv_dtype if kv_dtype is not None
                          else getattr(inf, "kv_dtype", "bf16"))
+        # pipelined multi-tick dispatch (ISSUE 17): keep one N-tick
+        # CHAINED launch in flight and apply its results at a one-launch
+        # lag, so per-tick host work (scheduling, emission fetch, apply)
+        # amortizes 1/N.  0 = today's one-tick-per-launch driver, byte
+        # for byte.  Speculative decoding keeps depth-0 stepping — its
+        # adaptive k_eff needs per-tick acceptance counts on the host.
+        self.pipeline_depth = max(0, int(
+            tick_pipeline_depth if tick_pipeline_depth is not None
+            else getattr(inf, "tick_pipeline_depth", 0)))
         self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh,
                                 draft_cfg=self.draft_cfg,
                                 kv_dtype=self.kv_dtype)
@@ -767,6 +777,22 @@ class ContinuousBatchingEngine:
         # whenever admission/retirement changes the slot layout
         self._dev_state: Optional[Tuple] = None  # guarded by _lock
         self._dirty = True  # guarded by _lock
+        # pipelined dispatch state (ISSUE 17).  _inflight holds launched-
+        # but-unapplied chained launches as (active slots, request
+        # identities, device tokens [C,b], device log-probs [C,b],
+        # launch time); _pipe_state is the device-resident
+        # (term_ids, stop_modes, done, remaining) carry the next chain
+        # consumes — None means the next launch must rebuild it from the
+        # (then-current) host mirrors — guarded by _lock
+        self._inflight: deque = deque()
+        self._pipe_state: Optional[Tuple] = None  # guarded by _lock
+        self._chained_fn = None
+        # inter-launch host-gap samples for the pipeline bench (bounded;
+        # host_gap_stats() summarizes) — guarded by _lock
+        self._host_gaps: deque = deque(maxlen=4096)
+        # wall time the last device dispatch call returned (driver-thread
+        # only; reads/writes serialize under _drive_lock)
+        self._last_dispatch_end: Optional[float] = None
         # tick/cache telemetry for the decode bench
         self.ticks = 0
         self.ticked_tokens = 0
@@ -893,6 +919,24 @@ class ContinuousBatchingEngine:
             "mlt_engine_preempted_seconds",
             help="seconds retired requests spent preempted (observed "
                  "only for requests that were preempted at least once)")
+        # pipelined-dispatch telemetry (ISSUE 17): the host gap is the
+        # wall time between one tick launch returning and the next being
+        # dispatched — scheduling + emission fetch + apply, THE overhead
+        # --tick_pipeline_depth amortizes across a chain
+        self._m_host_gap = reg.histogram(
+            "mlt_engine_host_gap_seconds",
+            help="host time between consecutive tick-program dispatches "
+                 "(fetch + apply + scheduling; pipelining amortizes it)",
+            buckets=[1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2,
+                     0.1, 0.3])
+        self._m_inflight = reg.gauge(
+            "mlt_engine_inflight_ticks",
+            help="device ticks launched but not yet applied "
+                 "(--tick_pipeline_depth chains in flight)")
+        reg.gauge("mlt_engine_tick_pipeline_depth",
+                  help="configured chained-ticks-per-launch depth "
+                       "(--tick_pipeline_depth; 0 = unpipelined)"
+                  ).set(self.pipeline_depth)
         # speculative-decoding instruments, registered only when the spec
         # path can run (mlt_engine_spec_* stays absent from scrapes of
         # non-speculating engines)
@@ -1109,6 +1153,29 @@ class ContinuousBatchingEngine:
                 donate_argnums=(1, 2))
         self._ragged_fns[pre_rows] = fn
         return fn
+
+    def _chained_tick(self):
+        """The CHAINED steady-state tick (ISSUE 17,
+        generation/ragged.py:make_chained_tick_fn): ``pipeline_depth``
+        consecutive decode ticks as one compiled program, with position
+        advance, stop detection and the remaining-token budget running
+        device-to-device.  Chain length is a geometry static (one
+        executable per depth); everything else — which rows are live,
+        their stop rules, budgets and tables — is traced data."""
+        if self._chained_fn is not None:
+            return self._chained_fn
+        from megatron_llm_tpu.generation.ragged import make_chained_tick_fn
+
+        statics = ("engine_chained_tick", self.max_slots,
+                   self.pages_per_seq, self.page_size,
+                   self.pool.num_pages, self.pool.kv_statics,
+                   self.pipeline_depth, self._mesh_statics)
+        self._chained_fn = gen.cached_jit(
+            self.cfg, "engine_chained_tick", statics,
+            lambda: make_chained_tick_fn(self.cfg, self.pipeline_depth,
+                                         tp=self._tp, mesh=self.mesh),
+            donate_argnums=(1, 2))
+        return self._chained_fn
 
     def _prefill(self, s_pre: int, with_log_probs: bool):
         """Monolithic dense prefill (the ``prefill_chunk=0`` legacy path):
@@ -2049,7 +2116,18 @@ class ContinuousBatchingEngine:
         Ragged mode (the default): the whole tick — decode slots, verify
         blocks, prefill-chunk rows — is ONE compiled launch
         (:meth:`_step_ragged`).  Legacy split mode dispatches the
-        decode/spec tick plus one program per prefill chunk."""
+        decode/spec tick plus one program per prefill chunk.
+
+        Pipelined mode (``--tick_pipeline_depth N``, ISSUE 17): steady-
+        state steps chain N ticks per launch and apply results at a one-
+        launch lag (:meth:`_step_pipelined`); any boundary — admission,
+        prefill, preemption fallout — drains the pipeline and runs this
+        depth-0 path for that step.  Speculative engines always step at
+        depth 0 (adaptive k_eff needs per-tick acceptance)."""
+        if self.pipeline_depth and not self.spec_k:
+            n = self._step_pipelined()
+            if n is not None:
+                return n
         with obs_trace.span("engine-admit"):
             self._admit()
         if self.ragged:
@@ -2139,6 +2217,308 @@ class ContinuousBatchingEngine:
             if prefill_tokens:
                 self._m_prefill_per_tick.observe(prefill_tokens)
 
+    # -- pipelined multi-tick dispatch (ISSUE 17) --------------------------
+
+    def _note_host_gap(self, gap: Optional[float]) -> None:
+        """Record one inter-launch host gap (scheduling + emission fetch
+        + apply time between device dispatches — the overhead pipelining
+        amortizes; fed to the bench via :meth:`host_gap_stats`)."""
+        if gap is None:
+            return
+        with self._lock:
+            self._host_gaps.append(gap)
+        if obs_registry.publishing():
+            self._m_host_gap.observe(gap)
+
+    def host_gap_stats(self) -> dict:
+        """Inter-launch host-gap summary (bench_decode --mode pipeline
+        reports the p50/p99 reduction as depth grows)."""
+        with self._lock:
+            gaps = sorted(self._host_gaps)
+        if not gaps:
+            return {"count": 0, "total_s": 0.0,
+                    "p50_ms": None, "p99_ms": None}
+
+        def q(p: float) -> float:
+            return gaps[min(len(gaps) - 1, int(p * (len(gaps) - 1)))]
+
+        return {"count": len(gaps),
+                "total_s": round(sum(gaps), 4),
+                "p50_ms": round(q(0.50) * 1e3, 4),
+                "p99_ms": round(q(0.99) * 1e3, 4)}
+
+    def _pregrant_locked(self, active,
+                         horizon: int) -> bool:  # holds _lock
+        """Pre-grant every page the next ``horizon`` chained positions
+        may write, per active row: page slots covering the HOST position
+        through ``host position + horizon - 1`` (capped at the row's
+        worst-case budget) are allocated now and debited from the
+        commitment ledger — the in-program position advance then crosses
+        page boundaries without consulting the host, and the device-
+        resident ``remaining`` budget freezes a row before it can outrun
+        its final granted page.  The ledger's admission invariant makes
+        the allocs infallible while the slot is in flight, exactly as
+        for :meth:`_prepare_decode_locked`.  Rows that stop early via a
+        stop token simply retire holding a few unwritten pages — they
+        release with the rest.  Returns True when any block table
+        changed (the launch then re-uploads ONLY the table operand;
+        positions/tokens/steps keep chaining on device)."""
+        changed = False
+        for i in list(active):
+            req = self._slots[i]
+            p0 = int(self._positions[i]) // self.page_size
+            last_pos = min(int(self._positions[i]) + horizon - 1,
+                           req._max_pages * self.page_size - 1)
+            p1 = last_pos // self.page_size
+            for idx in range(p0, min(p1, self.pages_per_seq - 1) + 1):
+                if self._block_tables[i][idx] != NULL_PAGE:
+                    continue
+                got = self.pool.alloc(1)
+                if got is None:  # ledger-unreachable; fail just the row
+                    self._fail_locked(req, RuntimeError(
+                        "KV pool exhausted for an in-flight slot — "
+                        "commitment ledger violated"))
+                    active.remove(i)
+                    changed = True
+                    break
+                self._block_tables[i][idx] = got[0]
+                req._pages.append(got[0])
+                self._committed -= 1
+                changed = True
+        return changed
+
+    def _apply_chain_locked(self, active, reqs, toks_np, logps_np,
+                            now) -> int:  # holds _lock
+        """Fold one in-flight chain's results into the slots — the spec
+        apply's block shape over the chain axis: each surviving row
+        appends its whole column up to the first stop in ONE pass, so
+        host apply cost is per CHAIN, not per tick (the pipelined mode's
+        other half: chains amortize dispatch, this amortizes apply).
+        Bit-for-bit the per-tick ``_apply_plain_locked`` fold: same stop
+        rules in the same order, rows discarded when their slot no
+        longer holds the launched request."""
+        chain = toks_np.shape[0]
+        emitted = 0
+        for i, req in zip(active, reqs):
+            if self._slots[i] is not req or req._phase != "decode":
+                continue  # retired / preempted / failed at the boundary
+            col = toks_np[:, i].tolist()
+            room = min(req.max_new_tokens - len(req.generated),
+                       self.max_seq - len(req.prompt)
+                       - len(req.generated))
+            if (not req.stop_on_eol and not req.stop_on_double_eol
+                    and (not req.use_eod_for_termination
+                         or req.termination_id is None)):
+                # length-limited row: bulk-extend the column
+                took = min(chain, room)
+                done = took == room
+                req.generated.extend(col[:took])
+                req.log_probs.extend(logps_np[:took, i].tolist())
+            else:
+                lcol = logps_np[:, i].tolist()
+                took = 0
+                done = False
+                for t in range(chain):
+                    tok = col[t]
+                    req.generated.append(tok)
+                    req.log_probs.append(lcol[t])
+                    took += 1
+                    done = (self._stopped_by_token(req, tok)
+                            or took >= room)
+                    if done:
+                        break
+            if not took:
+                continue
+            if req._step == 0:
+                req._t_first = now
+                req._flight.mark_first_token(now)
+                self._note_ttft_locked(now - req._t_submit)
+            req._step += took
+            self._positions[i] += took
+            self._tokens[i] = col[took - 1]
+            self._steps[i] += took
+            emitted += took
+            if done:
+                self._retire(i)
+        return emitted
+
+    def _apply_oldest(self) -> int:
+        """Fetch and fold the OLDEST in-flight chain: ONE batched
+        ``jax.device_get`` for all of its ticks' tokens and log-probs
+        (the drain point), then per-tick application under the host's
+        own stop rules — the lag boundary where admission/stop/
+        preemption decisions land.  A row whose slot no longer holds the
+        launched request (retired, preempted or failed meanwhile) is
+        discarded tick by tick; a preempted victim's discarded tokens
+        regenerate bitwise on resume because its sampling stream is
+        ``fold_in(key, step)`` replay.  Returns tokens emitted."""
+        with self._lock:
+            if not self._inflight:
+                return 0
+            active, reqs, ctoks, clogps, t0 = self._inflight.popleft()
+        toks_np, logps_np = jax.device_get((ctoks, clogps))
+        now = time.monotonic()
+        emitted = 0
+        with self._lock:
+            chain = toks_np.shape[0]
+            dt = (now - t0) / max(chain, 1)
+            self._ema_tick_s = (dt if self._ema_tick_s is None
+                                else 0.8 * self._ema_tick_s + 0.2 * dt)
+            emitted = self._apply_chain_locked(active, reqs, toks_np,
+                                               logps_np, now)
+            self.ticks += chain
+            self.ticked_tokens += emitted
+            if obs_registry.publishing():
+                self._m_ticks.inc(chain)
+                self._m_tokens.inc(emitted)
+                self._m_inflight.set(
+                    self.pipeline_depth * len(self._inflight))
+                self._m_active.set(
+                    sum(r is not None and r._phase == "decode"
+                        for r in self._slots))
+                self._m_free_pages.set(self.pool.num_free)
+                self._m_pages_cached.set(
+                    len(self.cache) if self.cache else 0)
+            self._publish_queued_locked()
+        return emitted
+
+    def _drain_pipeline(self) -> int:
+        """Apply every in-flight chain and invalidate the device-resident
+        pipeline carry — the boundary synchronization point: after this
+        the host mirrors are exact and depth-0 stepping (admission,
+        prefill, preemption) may run.  Returns tokens emitted."""
+        emitted = 0
+        while True:
+            with self._lock:
+                pending = bool(self._inflight)
+            if not pending:
+                break
+            emitted += self._apply_oldest()
+        with self._lock:
+            self._pipe_state = None
+            if obs_registry.publishing():
+                self._m_inflight.set(0)
+        return emitted
+
+    def _step_pipelined(self) -> Optional[int]:
+        """One pipelined driver step (``--tick_pipeline_depth N > 0``):
+        launch the next N-tick chained program from DEVICE-RESIDENT slot
+        state FIRST, then apply the previous launch's results while the
+        device computes — scheduler decisions land at a one-launch
+        (up-to-N-tick) lag.  Steady state only: any queued admission,
+        live prefill or non-decode slot drains the pipeline and returns
+        None, and the caller falls back to the depth-0 step for that
+        boundary.
+
+        Losslessness rests on three facts: the in-program stop/budget
+        rules mirror the host's apply rules bit for bit, so a row the
+        host retires was already frozen (null-routed) on device from the
+        same tick onward — an in-flight chain never writes a page the
+        host has released; the per-row sampling stream is
+        ``fold_in(key, step)``, so discarded overrun draws replay
+        bitwise after preemption; and per-row bits are batch-composition
+        invariant, so freezing one row never changes another's tokens."""
+        with self._lock:
+            steady = (not self._queue and not self._prefill_q
+                      and all(r is None or r._phase == "decode"
+                              for r in self._slots))
+            active = [i for i, r in enumerate(self._slots)
+                      if r is not None and r._phase == "decode"]
+        if not steady or not active:
+            self._drain_pipeline()
+            return None
+        C = self.pipeline_depth
+        with self._lock:
+            # pre-grant pages out to TWO chains past the host's applied
+            # frontier: the launch below starts up to C device ticks
+            # ahead of the host positions (one unapplied chain) and runs
+            # C more
+            n0 = len(active)
+            changed = self._pregrant_locked(active, 2 * C)
+            if len(active) < n0:
+                # a ledger-unreachable alloc failure just mutated slot
+                # state under us — the device carry no longer matches the
+                # host; resynchronize through the depth-0 boundary path
+                active = []
+            if not active:
+                pass
+            elif self._pipe_state is None:
+                # boundary rebuild: the pipeline is drained, host
+                # mirrors are exact — upload the full device state and
+                # the per-row stop rules/budgets fresh
+                if changed:
+                    self._dirty = True
+                (bt, pos, toks, keys, steps, temp, tk,
+                 tp) = self._dev_state_locked()
+                term = np.full((self.max_slots,), -1, np.int32)
+                mode = np.zeros((self.max_slots,), np.int32)
+                rem = np.zeros((self.max_slots,), np.int32)
+                done = np.ones((self.max_slots,), np.bool_)
+                for i in active:
+                    req = self._slots[i]
+                    done[i] = False
+                    rem[i] = min(
+                        req.max_new_tokens - len(req.generated),
+                        self.max_seq - len(req.seq_tokens))
+                    if req.stop_on_double_eol:
+                        mode[i] = 2
+                    elif req.stop_on_eol:
+                        mode[i] = 1
+                    elif (req.use_eod_for_termination
+                          and req.termination_id is not None):
+                        term[i] = req.termination_id
+                self._pipe_state = (
+                    self._asarray(term), self._asarray(mode),
+                    self._asarray(done), self._asarray(rem))
+            else:
+                # steady chain: slot state and the stop/budget carry are
+                # the previous launch's outputs, device-to-device; only
+                # a pre-grant refreshes the (host-owned) table operand
+                (bt, pos, toks, keys, steps, temp, tk,
+                 tp) = self._dev_state
+                if changed:
+                    bt = self._asarray(self._block_tables)
+                    self._dev_state = (bt, pos, toks, keys, steps,
+                                       temp, tk, tp)
+            if active:
+                self.peak_active_slots = max(self.peak_active_slots,
+                                             len(active))
+                term_d, mode_d, done_d, rem_d = self._pipe_state
+                reqs = [self._slots[i] for i in active]
+        if not active:
+            self._drain_pipeline()
+            return None
+
+        t0 = time.monotonic()
+        gap = (None if self._last_dispatch_end is None
+               else t0 - self._last_dispatch_end)
+        with obs_trace.span("engine-chained-tick", active=len(active),
+                            chain=C, tp=self._tp,
+                            host_gap_ms=(None if gap is None
+                                         else round(gap * 1e3, 4))), \
+                self._overlap_span():
+            (self.pool.k, self.pool.v, ctoks, clogps, new_pos, new_tok,
+             new_steps, new_done, new_rem) = self._chained_tick()(
+                self.params, self.pool.k, self.pool.v, bt, pos, toks,
+                keys, steps, temp, tk, tp, term_d, mode_d, done_d,
+                rem_d)
+            self._last_dispatch_end = time.monotonic()
+        self._note_host_gap(gap)
+        with self._lock:
+            self._dev_state = (bt, new_pos, new_tok, keys, new_steps,
+                               temp, tk, tp)
+            self._pipe_state = (term_d, mode_d, new_done, new_rem)
+            self._inflight.append((active, reqs, ctoks, clogps, t0))
+            depth_now = len(self._inflight)
+            self._note_launches_locked(1, 0)
+            if obs_registry.publishing():
+                self._m_inflight.set(C * depth_now)
+        if depth_now > 1:
+            # apply the previous launch WHILE the device runs this one —
+            # the overlap the whole mode exists for
+            self._apply_oldest()
+        return len(active)
+
     def _step_legacy(self) -> int:
         with self._lock:
             budget = self._prefill_budget_tokens()
@@ -2172,9 +2552,13 @@ class ContinuousBatchingEngine:
                 self._dev_state_locked()
 
         t_tick = time.monotonic()
+        gap = (None if self._last_dispatch_end is None
+               else t_tick - self._last_dispatch_end)
+        gap_ms = None if gap is None else round(gap * 1e3, 4)
         if self.spec_k:
             with obs_trace.span("engine-spec-tick", active=len(active),
-                                k=self.spec_k, tp=self._tp), \
+                                k=self.spec_k, tp=self._tp,
+                                host_gap_ms=gap_ms), \
                     self._overlap_span():
                 (self.pool.k, self.pool.v, self.pool.draft_k,
                  self.pool.draft_v, emit, emit_lp, acc, cnt,
@@ -2184,19 +2568,21 @@ class ContinuousBatchingEngine:
                     self.pool.draft_k, self.pool.draft_v,
                     bt, pos, toks, keys, steps, temp, tk, tp,
                     self._asarray(k_eff))
-                emit_np = np.asarray(emit)
-                lp_np = np.asarray(emit_lp)
-                acc_np = np.asarray(acc)
-                m_np = np.asarray(cnt)
+                self._last_dispatch_end = time.monotonic()
+                # ONE batched host sync for the tick's emissions
+                emit_np, lp_np, acc_np, m_np = jax.device_get(
+                    (emit, emit_lp, acc, cnt))
         else:
             with obs_trace.span("engine-tick", active=len(active),
-                                tp=self._tp), self._overlap_span():
+                                tp=self._tp, host_gap_ms=gap_ms), \
+                    self._overlap_span():
                 (self.pool.k, self.pool.v, next_tok, logp,
                  new_pos, new_steps) = self._tick()(
                     self.params, self.pool.k, self.pool.v,
                     bt, pos, toks, keys, steps, temp, tk, tp)
-                next_np = np.asarray(next_tok)
-                logp_np = np.asarray(logp)
+                self._last_dispatch_end = time.monotonic()
+                next_np, logp_np = jax.device_get((next_tok, logp))
+        self._note_host_gap(gap)
 
         now = time.monotonic()
         with self._lock:
@@ -2380,9 +2766,13 @@ class ContinuousBatchingEngine:
                         _bucket_up(n_pre, self.prefill_chunk))
                     if n_pre else 0)
         t_tick = time.monotonic()
+        gap = (None if self._last_dispatch_end is None
+               else t_tick - self._last_dispatch_end)
         with obs_trace.span("engine-ragged-tick", active=len(active),
                             prefill_tokens=n_pre, launches=1,
-                            k=self.spec_k, tp=self._tp), \
+                            k=self.spec_k, tp=self._tp,
+                            host_gap_ms=(None if gap is None
+                                         else round(gap * 1e3, 4))), \
                 self._overlap_span():
             pre_args = () if not n_bucket else (
                 self._asarray(pre_tok[:n_bucket]),
@@ -2400,18 +2790,19 @@ class ContinuousBatchingEngine:
                     self.pool.draft_k, self.pool.draft_v,
                     bt, pos, toks, keys, steps, temp, tk, tp,
                     self._asarray(k_eff), *pre_args)
-                emit_np = np.asarray(emit)
-                lp_np = np.asarray(emit_lp)
-                acc_np = np.asarray(acc)
-                m_np = np.asarray(cnt)
+                self._last_dispatch_end = time.monotonic()
+                # ONE batched host sync for the tick's emissions
+                emit_np, lp_np, acc_np, m_np = jax.device_get(
+                    (emit, emit_lp, acc, cnt))
             else:
                 (self.pool.k, self.pool.v, next_tok, logp,
                  new_pos, new_steps) = tick_fn(
                     self.params, self.pool.k, self.pool.v,
                     bt, pos, toks, keys, steps, temp, tk, tp,
                     *pre_args)
-                next_np = np.asarray(next_tok)
-                logp_np = np.asarray(logp)
+                self._last_dispatch_end = time.monotonic()
+                next_np, logp_np = jax.device_get((next_tok, logp))
+        self._note_host_gap(gap)
 
         now = time.monotonic()
         with self._lock:
@@ -2484,13 +2875,19 @@ class ContinuousBatchingEngine:
     def _loop(self) -> None:
         while True:
             with self._work:
+                # an in-flight chained launch keeps the loop stepping:
+                # its apply may retire rows (and must not be stranded
+                # when every slot empties before it lands)
                 while (not self._stopping and not self._queue
-                       and all(r is None for r in self._slots)):
+                       and all(r is None for r in self._slots)
+                       and not self._inflight):
                     self._work.wait()
                 if self._stopping:
-                    return
+                    break
             with self._drive_lock:
                 self.step()
+        with self._drive_lock:
+            self._drain_pipeline()
 
     # -- server-facing API (api.InferenceEngine surface) -------------------
 

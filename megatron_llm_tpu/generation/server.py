@@ -381,6 +381,11 @@ class MegatronServer:
                     kv_dtype=getattr(eng, "kv_dtype", "bf16"),
                     kv_pool_bytes=eng.pool.kv_pool_bytes(),
                     kv_scale_bytes=eng.pool.kv_scale_bytes(),
+                    # pipelined dispatch (ISSUE 17): the chained-ticks-
+                    # per-launch depth this engine runs steady-state
+                    # decode at (0 = unpipelined)
+                    tick_pipeline_depth=getattr(
+                        eng, "pipeline_depth", 0),
                 )
             mesh = getattr(eng, "mesh", None)
             info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}

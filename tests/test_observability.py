@@ -591,7 +591,11 @@ def test_generation_server_metrics_endpoint():
                       "mlt_engine_kv_scale_bytes",
                       "mlt_engine_kv_dtype_info",
                       # ISSUE 15: compute/collective overlap mode
-                      "mlt_tp_overlap_info"):
+                      "mlt_tp_overlap_info",
+                      # ISSUE 17: pipelined-dispatch telemetry
+                      "mlt_engine_host_gap_seconds",
+                      "mlt_engine_inflight_ticks",
+                      "mlt_engine_tick_pipeline_depth"):
             assert field in body, f"missing {field}"
         assert "mlt_engine_max_slots 4" in body
         assert 'mlt_engine_kv_dtype_info{kv_dtype="bf16"} 1' in body
@@ -606,6 +610,8 @@ def test_generation_server_metrics_endpoint():
         assert health["kv_pool_bytes"] > 0
         assert health["kv_scale_bytes"] == 0
         assert health["peak_active_slots"] == 0
+        # ISSUE 17: /health names the configured pipeline depth
+        assert health["tick_pipeline_depth"] == 0
     finally:
         srv.stop()
 

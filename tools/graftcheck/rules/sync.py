@@ -153,6 +153,30 @@ class SyncInJitRule(Rule):
                     if build is not None:
                         traced.update(self._resolve(build, defs,
                                                     nested_only=True))
+        # builder-factory convention (ISSUE 17): the engine reaches the
+        # ragged/chained tick builders through cross-module thunks
+        # (``build=lambda: make_chained_tick_fn(...)``) that the per-file
+        # resolver above cannot follow — the thunk body is a Call, not a
+        # Name.  Module-level ``make_*_fn`` factories that touch jax are
+        # therefore cached_jit builders by convention: the factory body
+        # runs at build time (host side), every function it defines is
+        # the traced program.  Factories with no jax reference (REST
+        # client builders and the like) are host-side and exempt.
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (node.name.startswith("make_")
+                    and node.name.endswith("_fn")):
+                continue
+            if not any(isinstance(sub, ast.Name)
+                       and sub.id in {"jnp", "jax", "lax"}
+                       for sub in ast.walk(node)):
+                continue
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                    traced.add(sub)
         return traced
 
     def _check_body(self, ctx: FileContext, fn: ast.AST

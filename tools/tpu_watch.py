@@ -202,6 +202,13 @@ JOBS = [
     ("bench_decode_capacity",
      [sys.executable, "bench_decode.py", "--mode", "capacity"],
      False, _bench_on_tpu),
+    # ISSUE 17: pipelined multi-tick dispatch — decode tok/s and host-gap
+    # reduction per --tick_pipeline_depth vs depth 0, with the in-bench
+    # lossless-token assert (bench_decode.py --mode pipeline,
+    # engine_decode_pipeline evidence)
+    ("bench_decode_pipeline",
+     [sys.executable, "bench_decode.py", "--mode", "pipeline"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
