@@ -119,6 +119,7 @@ METRIC_SPEC = "engine_spec_decode_speedup_llama470m_c1_1chip"
 METRIC_ROUTER = "router_prefix_affinity_ttft_speedup_llama470m_2rep_1chip"
 METRIC_MIXED = "engine_ragged_launch_reduction_llama470m_mixed_1chip"
 METRIC_PIPELINE = "engine_pipeline_decode_speedup_llama470m_c8_1chip"
+METRIC_STREAMING = "serving_stream_first_token_speedup_llama470m_c8_2rep_1chip"
 
 # every mode decodes greedily with termination disabled: runs are
 # workload-shaped, never content-shaped
@@ -970,6 +971,234 @@ def bench_router(cfg, params, n_replicas: int, groups: int, per_group: int,
     }
 
 
+def bench_streaming(cfg, params, n_replicas: int, concurrency: int,
+                    prompt_len: int, gen: int, vocab: int, slots: int,
+                    burst: int) -> dict:
+    """Streaming serving tier (ISSUE 18): client-observed TTFT streamed
+    vs buffered through a real 2-replica fleet + router, plus the
+    router admission-queue arm.
+
+    Section 1 (first-token honesty): ``concurrency`` concurrent clients
+    stream through the router; each client's time-to-first-body-byte is
+    compared against the replica's own ``X-MLT-TTFT-S`` stamp riding
+    the response headers.  Gate: streamed client TTFT within 1.2x of
+    the stamp (+ a small absolute loopback slack) — the stamp, the
+    headers, and the first flushed byte describe the same instant.  The
+    SAME payloads run buffered: there the first body byte IS the whole
+    response, so buffered first-byte ~= total latency, and the headline
+    is how much earlier streaming delivers the first token.  An
+    in-bench identity assert pins the streamed terminal ``done`` body
+    byte-equal to the buffered body on the same seeded request.
+
+    Section 2 (admission queue): a ``burst``-client saturation burst
+    against a deliberately tiny fleet (1 slot + 1-deep engine queue per
+    replica).  The baseline router (no admission queue, no proxy
+    retries) surfaces replica 503s to clients; the admission-queue
+    router holds arrivals in its bounded FIFO and drops nothing."""
+    import http.client
+    import random
+    import string
+    from concurrent.futures import ThreadPoolExecutor
+    from urllib.parse import urlparse
+
+    from megatron_llm_tpu.generation.server import MegatronServer
+    from megatron_llm_tpu.serving.router.server import RouterServer
+    from megatron_llm_tpu.serving.streaming import parse_sse
+
+    rng = random.Random(13)
+    letters = string.ascii_letters + string.digits
+
+    def prompt():
+        return "".join(rng.choice(letters) for _ in range(prompt_len))
+
+    def client_put(base: str, payload: dict):
+        """PUT via http.client with incremental reads: returns (status,
+        headers, raw_body, t_first_body_byte_s, t_total_s)."""
+        u = urlparse(base)
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=600)
+        t0 = time.perf_counter()
+        conn.request("PUT", "/api", body=json.dumps(payload).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        hdrs = dict(resp.getheaders())
+        raw, t_first = b"", None
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            if t_first is None:
+                t_first = time.perf_counter() - t0
+            raw += chunk
+        t_total = time.perf_counter() - t0
+        conn.close()
+        return resp.status, hdrs, raw, t_first, t_total
+
+    def spawn_fleet(*, fleet_slots: int, max_queue=None, admission=False):
+        servers, urls = [], []
+        for _ in range(n_replicas):
+            ekw = dict(max_slots=fleet_slots,
+                       max_seq=prompt_len + gen + 1)
+            if max_queue is not None:
+                ekw["max_queue"] = max_queue
+            eng = make_engine(cfg, params, tokenizer=_CharTok(vocab),
+                              **ekw)
+            srv = MegatronServer(eng)
+            port = srv.start_background(port=0)
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{port}")
+        rkw = dict(policy="round_robin", poll_interval=0.25,
+                   forward_timeout_s=600.0)
+        if admission:
+            # limit = fleet decode capacity, so replicas never even see
+            # the overflow; deep-enough FIFO that the burst fits
+            rkw.update(max_retries=0, admission_depth=max(burst, 8),
+                       admission_limit=n_replicas * fleet_slots,
+                       admission_timeout_s=600.0)
+        else:
+            rkw.update(max_retries=0)
+        router = RouterServer(urls, **rkw)
+        rport = router.start_background()
+        return servers, router, f"http://127.0.0.1:{rport}"
+
+    gen_kw = {"tokens_to_generate": gen, "top_k": 1, "random_seed": 3}
+
+    # ---- section 1: streamed vs buffered TTFT at `concurrency` ----------
+    servers, router, base = spawn_fleet(fleet_slots=slots)
+    try:
+        # warm both write paths (compiles ride the first requests)
+        t0 = time.perf_counter()
+        code, _, _, _, _ = client_put(base, {"prompts": [prompt()],
+                                             **gen_kw})
+        assert code == 200, f"warm buffered request failed: {code}"
+        code, _, _, _, _ = client_put(base, {"prompts": [prompt()],
+                                             **gen_kw, "stream": True})
+        assert code == 200, f"warm streamed request failed: {code}"
+        compile_s = time.perf_counter() - t0
+
+        # identity probe: the streamed done body == the buffered body
+        probe = {"prompts": [prompt()], **gen_kw, "logprobs": True}
+        code, _, braw, _, _ = client_put(base, probe)
+        assert code == 200
+        buffered_body = json.loads(braw)
+        buffered_body.pop("timing", None)
+        code, _, sraw, _, _ = client_put(base, {**probe, "stream": True})
+        assert code == 200
+        frames = parse_sse(sraw)
+        assert frames[-1][0] == "done", f"stream ended with {frames[-1][0]}"
+        done = frames[-1][1]
+        done.pop("timing", None)
+        assert done == buffered_body, (
+            "streamed terminal body diverged from the buffered response")
+
+        prompts = [prompt() for _ in range(concurrency)]
+
+        def measure(stream: bool):
+            def one(p):
+                payload = {"prompts": [p], **gen_kw}
+                if stream:
+                    payload["stream"] = True
+                code, hdrs, _, t_first, t_total = client_put(base, payload)
+                assert code == 200, f"request failed: {code}"
+                stamp = hdrs.get("X-MLT-TTFT-S")
+                return (t_first, t_total,
+                        float(stamp) if stamp is not None else None)
+            with ThreadPoolExecutor(max_workers=concurrency) as ex:
+                return list(ex.map(one, prompts))
+
+        streamed = measure(stream=True)
+        buffered = measure(stream=False)
+    finally:
+        router.stop()
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+    s_ttft = [t for t, _, _ in streamed]
+    s_total = [t for _, t, _ in streamed]
+    stamps = [s for _, _, s in streamed if s is not None]
+    b_ttfb = [t for t, _, _ in buffered]
+    b_total = [t for _, t, _ in buffered]
+    mean = lambda xs: sum(xs) / max(len(xs), 1)  # noqa: E731
+    # the honesty gate: the client sees the first byte when the stamp
+    # says the first token existed (1.2x + loopback/GIL slack)
+    stamp_ratio = mean(s_ttft) / max(mean(stamps), 1e-9)
+    stamp_ok = mean(s_ttft) <= 1.2 * mean(stamps) + 0.25
+    # buffered responses deliver nothing until everything: first byte
+    # lands with the full body
+    buffered_is_total = mean(b_ttfb) >= 0.9 * mean(b_total)
+    first_token_speedup = mean(b_ttfb) / max(mean(s_ttft), 1e-9)
+    stream_rows = [
+        {"arm": "streamed",
+         "client_ttft_mean_ms": round(1e3 * mean(s_ttft), 2),
+         "client_ttft_p99_ms": round(1e3 * _percentile(s_ttft, 99), 2),
+         "replica_stamp_mean_ms": round(1e3 * mean(stamps), 2),
+         "total_mean_ms": round(1e3 * mean(s_total), 2),
+         "stamped": len(stamps)},
+        {"arm": "buffered",
+         "client_ttft_mean_ms": round(1e3 * mean(b_ttfb), 2),
+         "client_ttft_p99_ms": round(1e3 * _percentile(b_ttfb, 99), 2),
+         "total_mean_ms": round(1e3 * mean(b_total), 2)},
+    ]
+
+    # ---- section 2: admission queue absorbs a saturation burst ----------
+    def run_burst(admission: bool) -> dict:
+        servers, router, base = spawn_fleet(fleet_slots=1, max_queue=1,
+                                            admission=admission)
+        try:
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=burst) as ex:
+                codes = list(ex.map(
+                    lambda p: client_put(base, {"prompts": [p],
+                                                **gen_kw})[0],
+                    [prompt() for _ in range(burst)]))
+            wall = time.perf_counter() - t0
+            row = {
+                "admission_queue": admission,
+                "requests": burst,
+                "ok": sum(c == 200 for c in codes),
+                "dropped": sum(c != 200 for c in codes),
+                "wall_s": round(wall, 4),
+            }
+            if admission:
+                row["admission_stats"] = router.admission.stats()
+            return row
+        finally:
+            router.stop()
+            for srv in servers:
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+
+    baseline = run_burst(admission=False)
+    gated = run_burst(admission=True)
+
+    return {
+        "n_replicas": n_replicas,
+        "concurrency": concurrency,
+        "prompt_len": prompt_len,
+        "gen_len": gen,
+        "slots": slots,
+        "burst": burst,
+        "first_token_speedup": round(first_token_speedup, 2),
+        "stamp_ratio": round(stamp_ratio, 3),
+        "stamp_ok": stamp_ok,
+        "buffered_first_byte_is_total": buffered_is_total,
+        "identity_ok": True,  # asserted above
+        "baseline_dropped": baseline["dropped"],
+        "admission_dropped": gated["dropped"],
+        "stream_ok": (stamp_ok and buffered_is_total
+                      and first_token_speedup >= 1.0
+                      and baseline["dropped"] > 0
+                      and gated["dropped"] == 0),
+        "compile_time_s": round(compile_s, 1),
+        "step_time_s": round(mean(s_total) / max(gen, 1), 6),
+        "rows": stream_rows + [baseline, gated],
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
@@ -980,7 +1209,9 @@ def _run(args, finished):
     mixed_mode = args.mode == "mixed"
     cap_mode = args.mode == "capacity"
     pipe_mode = args.mode == "pipeline"
+    stream_mode = args.mode == "streaming"
     pipe_depths = (0, 1, 2, 8)
+    burst = 12  # admission-arm clients (streaming mode section 2)
     draft_layers = 2
     # mixed-mode workload shape (TPU defaults; CPU sanity overrides below)
     mx = dict(slots=8, n_short=6, n_long=4, prompt_long=256,
@@ -1034,6 +1265,13 @@ def _run(args, finished):
             # walls
             layers, hidden, heads, ffn, vocab = 1, 32, 2, 64, 128
             args.prompt, args.gen, args.reps = 16, 96, 3
+        if stream_mode:
+            # enough decode ticks (gen=24) that a streamed client's first
+            # byte lands visibly before the buffered client's only byte;
+            # 4 slots/replica so the c=8 streamed arm saturates a
+            # 2-replica fleet without queueing
+            args.prompt, args.gen = 48, 24
+            args.slots = 4
         if cap_mode:
             # over-subscribe a 3-sequence bf16 budget 4x; 4 tenants whose
             # shared pages (4 x 4 pages) outgrow the bf16 budget but fit
@@ -1066,7 +1304,11 @@ def _run(args, finished):
     with global_mesh(mesh):
         params = init_model_params(cfg, jax.random.PRNGKey(0))
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        if router_mode:
+        if stream_mode:
+            row = bench_streaming(cfg, params, args.replicas, levels[-1],
+                                  args.prompt, args.gen, vocab, args.slots,
+                                  burst)
+        elif router_mode:
             row = bench_router(cfg, params, args.replicas, args.groups,
                                args.per_group, args.shared, args.tail,
                                args.gen, vocab, args.slots)
@@ -1121,7 +1363,32 @@ def _run(args, finished):
             rows = [bench_engine(cfg, params, c, args.prompt, args.gen,
                                  vocab, args.reps) for c in levels]
 
-    if router_mode:
+    if stream_mode:
+        result = {
+            "metric": METRIC_STREAMING,
+            "value": row["first_token_speedup"],
+            "unit": "x",
+            "first_token_speedup": row["first_token_speedup"],
+            "stream_ok": row["stream_ok"],
+            "stamp_ratio": row["stamp_ratio"],
+            "stamp_ok": row["stamp_ok"],
+            "buffered_first_byte_is_total":
+                row["buffered_first_byte_is_total"],
+            "identity_ok": row["identity_ok"],
+            "baseline_dropped": row["baseline_dropped"],
+            "admission_dropped": row["admission_dropped"],
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in
+                         ("n_replicas", "concurrency", "prompt_len",
+                          "gen_len", "slots", "burst")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_streaming"
+    elif router_mode:
         result = {
             "metric": METRIC_ROUTER,
             "value": row["ttft_mean_speedup"],
@@ -1284,7 +1551,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode",
                     choices=("occupancy", "shared_prefix", "slo", "spec",
-                             "router", "mixed", "capacity", "pipeline"),
+                             "router", "mixed", "capacity", "pipeline",
+                             "streaming"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
@@ -1325,9 +1593,11 @@ def main():
     metric = {"shared_prefix": METRIC_PREFIX, "slo": METRIC_SLO,
               "spec": METRIC_SPEC, "router": METRIC_ROUTER,
               "mixed": METRIC_MIXED, "pipeline": METRIC_PIPELINE,
-              "capacity": METRIC_CAPACITY}.get(args.mode, METRIC)
+              "capacity": METRIC_CAPACITY,
+              "streaming": METRIC_STREAMING}.get(args.mode, METRIC)
     unit = ("x" if args.mode in ("shared_prefix", "slo", "spec", "router",
-                                 "mixed", "capacity", "pipeline")
+                                 "mixed", "capacity", "pipeline",
+                                 "streaming")
             else "tok/s")
     finished = threading.Event()
 

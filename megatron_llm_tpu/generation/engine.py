@@ -505,6 +505,10 @@ class EngineRequest:
     # when the recorder is disabled, so every call site stays branch-free
     _flight: object = dataclasses.field(
         default=obs_flight.NULL_RECORD, repr=False)
+    # token streaming (serving/streaming/): the per-request emission
+    # queue submit_stream attached, fed by the apply/retire paths under
+    # _lock; None = plain request/response submit
+    _stream: object = dataclasses.field(default=None, repr=False)
 
     def result(self, timeout: Optional[float] = None):
         """Wait for completion; returns (full token list, gen log-probs)."""
@@ -933,6 +937,18 @@ class ContinuousBatchingEngine:
             "mlt_engine_inflight_ticks",
             help="device ticks launched but not yet applied "
                  "(--tick_pipeline_depth chains in flight)")
+        # token streaming (ISSUE 18, serving/streaming/): live
+        # subscriptions + incremental events shed by slow consumers
+        # (drop-to-terminal — the terminal event is never shed)
+        self._stream_subs = 0  # live submit_stream queues — guarded by _lock
+        self._m_stream_subs = reg.gauge(
+            "mlt_engine_stream_subscribers",
+            help="live submit_stream subscriptions (emission queues "
+                 "attached to in-flight requests)")
+        self._m_stream_dropped = reg.counter(
+            "mlt_engine_stream_dropped_events_total",
+            help="incremental stream events shed because a consumer "
+                 "fell behind its bounded emission queue")
         reg.gauge("mlt_engine_tick_pipeline_depth",
                   help="configured chained-ticks-per-launch depth "
                        "(--tick_pipeline_depth; 0 = unpipelined)"
@@ -1382,11 +1398,66 @@ class ContinuousBatchingEngine:
                 req._seqno = self._seqno
                 self._queue.append(req)
                 req._flight.event("enqueue", queued=len(self._queue))
+                if req._stream is not None:
+                    self._stream_subs += 1
+                    if obs_registry.publishing():
+                        self._m_stream_subs.set(self._stream_subs)
                 if obs_registry.publishing():
                     self._m_requests.inc()
                 self._publish_queued_locked()
                 self._work.notify()
         return req
+
+    def submit_stream(self, prompt: Sequence[int], max_new_tokens: int,
+                      *, stream_events: int = 256, **kw):
+        """Enqueue a generation with a live token stream attached.
+
+        Returns ``(req, queue)`` — the same :class:`EngineRequest` future
+        ``submit`` returns plus the :class:`StreamQueue
+        <megatron_llm_tpu.serving.streaming.StreamQueue>` the apply paths
+        feed under the engine lock: one ``token`` event per applied batch
+        (chained dispatch retires several tokens per flush), then exactly
+        one terminal ``done``/``error`` event carrying the flight-record
+        timing payload.  ``stream_events`` bounds the queue; a consumer
+        that falls behind sheds incremental events (counted in
+        ``mlt_engine_stream_dropped_events_total`` and in the terminal
+        event's ``dropped_events``) but always gets the terminal —
+        drop-to-terminal, never backpressure into the tick loop."""
+        from megatron_llm_tpu.serving.streaming import StreamQueue
+
+        q = StreamQueue(maxsize=stream_events)
+        req = self.submit(prompt, max_new_tokens, _stream=q, **kw)
+        return req, q
+
+    def _stream_emit_locked(self, req: EngineRequest, tokens,
+                            log_probs) -> None:  # holds _lock
+        """Publish one incremental token batch to the request's stream
+        (no-op for plain submits).  The queue is a leaf lock and the
+        publish never blocks — the committed lock-order edge
+        ContinuousBatchingEngine._lock -> StreamQueue._lock mirrors the
+        engine→FlightRecorder discipline."""
+        q = req._stream
+        if q is None or not tokens:
+            return
+        shed = q.publish_tokens(tokens, log_probs)
+        if shed and obs_registry.publishing():
+            self._m_stream_dropped.inc(shed)
+
+    def _stream_finish_locked(self, req: EngineRequest, kind: str,
+                              **data) -> None:  # holds _lock
+        """Publish the terminal stream event and detach the queue (a
+        request reaches exactly one of _retire/_fail_locked/_shed_locked,
+        but detaching keeps a double finish structurally impossible)."""
+        q = req._stream
+        if q is None:
+            return
+        req._stream = None
+        self._stream_subs -= 1
+        if obs_registry.publishing():
+            self._m_stream_subs.set(self._stream_subs)
+        from megatron_llm_tpu.serving.streaming import StreamEvent
+
+        q.publish_terminal(StreamEvent(kind, data=data))
 
     def _drain_eta(self, depth: int) -> float:  # holds _lock
         """Seconds until ``depth`` queued requests likely drain — the
@@ -1573,6 +1644,8 @@ class ContinuousBatchingEngine:
         req.finished = True
         req._flight.finish("shed", reason=reason)
         self.flight.close(req._flight)
+        self._stream_finish_locked(req, "error", error=req.error, shed=True,
+                                   retry_after=req.shed_retry_after)
         self.shed_requests += 1
         if obs_registry.publishing():
             self._m_shed.inc()
@@ -1805,6 +1878,7 @@ class ContinuousBatchingEngine:
         req.finished = True
         req._flight.finish("error", error=req.error)
         self.flight.close(req._flight)
+        self._stream_finish_locked(req, "error", error=req.error)
         req._done.set()
 
     def _retire(self, slot: int) -> None:  # holds _lock
@@ -1836,6 +1910,16 @@ class ContinuousBatchingEngine:
         rec.finish("ok", now=now, tokens=len(req.generated))
         self.flight.close(rec)
         ttft = req.ttft
+        if req._stream is not None:
+            # terminal stream event: the flight-record timing payload
+            # (what the buffered response's "timing" block is built from)
+            timing = {"ttft_s": None if ttft is None else round(ttft, 6),
+                      "latency_s": round(now - req._t_submit, 6),
+                      "tokens": len(req.generated)}
+            if rec.enabled:
+                timing["decomposition"] = rec.to_dict()["decomposition"]
+            self._stream_finish_locked(req, "done", outcome="ok",
+                                       timing=timing)
         missed = False
         publishing = obs_registry.publishing()
         if rec.enabled and publishing:
@@ -1921,6 +2005,9 @@ class ContinuousBatchingEngine:
                 req._t_first = now
                 req._flight.mark_first_token(now)
                 self._note_ttft_locked(now - req._t_submit)
+            if took:
+                self._stream_emit_locked(req, req.generated[-took:],
+                                         req.log_probs[-took:])
             req._step += took
             self._positions[i] += took
             self._tokens[i] = int(emit_np[i, took - 1])
@@ -1964,6 +2051,7 @@ class ContinuousBatchingEngine:
                 req._t_first = now
                 req._flight.mark_first_token(now)
                 self._note_ttft_locked(now - req._t_submit)
+            self._stream_emit_locked(req, (tok,), (req.log_probs[-1],))
             self._positions[i] += 1
             self._tokens[i] = tok
             self._steps[i] += 1
@@ -2333,6 +2421,8 @@ class ContinuousBatchingEngine:
                 req._t_first = now
                 req._flight.mark_first_token(now)
                 self._note_ttft_locked(now - req._t_submit)
+            self._stream_emit_locked(req, req.generated[-took:],
+                                     req.log_probs[-took:])
             req._step += took
             self._positions[i] += took
             self._tokens[i] = col[took - 1]
@@ -2958,6 +3048,74 @@ class ContinuousBatchingEngine:
         else:
             log_probs = None
         return texts, segments, log_probs, tokens
+
+    def submit_stream_request(
+        self,
+        prompt: str,
+        tokens_to_generate: int,
+        return_output_log_probs: bool = False,
+        top_k_sampling: int = 0,
+        top_p_sampling: float = 0.0,
+        temperature: float = 1.0,
+        add_BOS: bool = False,
+        stop_on_double_eol: bool = False,
+        stop_on_eol: bool = False,
+        random_seed: int = -1,
+        priority: int = 1,
+        ttft_deadline_ms: Optional[float] = None,
+        tpot_deadline_ms: Optional[float] = None,
+        trace_id: str = "",
+        stream_events: int = 256,
+    ):
+        """``submit_stream`` with ``generate_and_post_process``'s exact
+        tokenization and submit kwargs for ONE prompt — the streamed
+        request must sample the identical token sequence the buffered
+        path would (same seed handling, same termination id), or the
+        ``done`` event could not carry the identical body."""
+        tok = self.tokenizer
+        if tokens_to_generate < 1:
+            raise ValueError("streaming requires tokens_to_generate >= 1")
+        termination_id = getattr(self.cfg.model, "eos_id", None) or tok.eod
+        bos = getattr(tok, "bos_token_id", None) or getattr(tok, "bos", None)
+        ids = tok.tokenize(prompt)
+        if add_BOS:
+            ids = [bos if bos is not None else tok.eod] + ids
+        return self.submit_stream(
+            ids, tokens_to_generate,
+            stream_events=stream_events,
+            temperature=temperature, top_k=top_k_sampling,
+            top_p=top_p_sampling, termination_id=termination_id,
+            stop_on_double_eol=stop_on_double_eol,
+            stop_on_eol=stop_on_eol,
+            seed=None if random_seed == -1 else random_seed,
+            return_log_probs=return_output_log_probs,
+            priority=priority,
+            ttft_deadline_ms=ttft_deadline_ms,
+            tpot_deadline_ms=tpot_deadline_ms,
+            trace_id=trace_id,
+        )
+
+    def finalize_stream_request(self, req: EngineRequest,
+                                return_output_log_probs: bool = False):
+        """Post-process one FINISHED streamed request with the exact
+        ``generate_and_post_process`` tail (same padding, detokenization
+        and log-prob slicing), so a streamed ``done`` body and the
+        buffered response for the same request are token-identical.
+        Returns ``(texts, segments, log_probs)``."""
+        assert req.finished and not req.error, "request not cleanly finished"
+        tok = self.tokenizer
+        row = list(req.prompt) + req.generated
+        tokens = np.zeros((1, len(row)), np.int32)
+        tokens[0, :] = row
+        tokens, texts, segments = detokenize_generations(
+            tok, tokens, np.asarray([len(row)]), True)
+        if return_output_log_probs:
+            log_probs = [(req.prompt_log_probs or []) + req.log_probs]
+            log_probs = [
+                lp[: len(seg) - 1] for lp, seg in zip(log_probs, segments)]
+        else:
+            log_probs = None
+        return texts, segments, log_probs
 
     def _legacy(self):
         """A dense-path InferenceEngine view over the SAME (already

@@ -22,6 +22,7 @@ responses, GET / serves the static UI).  Differences by design:
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 import uuid
@@ -33,6 +34,7 @@ from urllib.parse import parse_qs
 from megatron_llm_tpu.generation.engine import EngineOverloaded
 from megatron_llm_tpu.generation.scheduling import RequestShed
 from megatron_llm_tpu.observability import trace as obs_trace
+from megatron_llm_tpu.serving.streaming import SSE_CONTENT_TYPE, sse_encode
 
 _STATIC_DIR = Path(__file__).parent / "static"
 
@@ -140,7 +142,27 @@ def _validate(payload: dict):
                                 or isinstance(val, bool) or val <= 0):
             return None, f"{field} must be a positive number of milliseconds"
         p[field] = None if val is None else float(val)
+
+    # token streaming (ISSUE 18, serving/streaming/): SSE response
+    # instead of a buffered body; transport-only, so the sampled tokens
+    # are identical either way
+    stream = payload.get("stream", False)
+    if not isinstance(stream, bool):
+        return None, "stream must be a boolean value"
+    p["stream"] = stream
     return p, None
+
+
+def _validate_stream(params: dict):
+    """The extra constraints a ``"stream": true`` request must meet —
+    streaming multiplexes ONE generation onto the response socket."""
+    if len(params["prompts"]) != 1:
+        return "streaming requires exactly one prompt"
+    if params["beam_width"] is not None:
+        return "beam search cannot stream"
+    if params["tokens_to_generate"] == 0:
+        return "streaming requires tokens_to_generate >= 1"
+    return None
 
 
 class _NullLock:
@@ -154,7 +176,9 @@ class _NullLock:
 class MegatronServer:
     """text_generation_server.MegatronServer analog (:234-241)."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, *, register_url: Optional[str] = None,
+                 register_interval_s: float = 2.0,
+                 advertise_url: Optional[str] = None):
         # the lock-relevant type (the legacy InferenceEngine has no
         # locks): the annotation below lets graftcheck's lock-order
         # graph resolve `with eng._lock:` in health()/metrics_text()
@@ -174,6 +198,15 @@ class MegatronServer:
         self._t_start = time.monotonic()
         self._health_seq = 0  # guarded by _seq_lock
         self._seq_lock = threading.Lock()
+        # elastic discovery (ISSUE 18): with --register_url the replica
+        # POSTs /admin/register heartbeats to the router, so the fleet
+        # learns about it (and a restart on a new port) with no static
+        # config; the router's breaker expires it when it goes silent
+        self.register_url = register_url
+        self.register_interval_s = register_interval_s
+        self.advertise_url = advertise_url
+        self._register_stop = threading.Event()
+        self._register_thread: Optional[threading.Thread] = None
 
     def handle_request(self, payload, trace_id: str = ""):
         """Core PUT /api logic; returns (status_code, response dict).
@@ -254,19 +287,155 @@ class MegatronServer:
                 traceback.print_exc()
                 return 500, {"error": f"internal error: {type(e).__name__}: {e}"}
 
+    def stream_response(self, handler, payload: dict, trace_id: str = ""):
+        """Serve one ``"stream": true`` request as SSE on ``handler``'s
+        socket (serving/streaming/, docs/guide/serving.md "Streaming").
+
+        Returns None when the stream was served (headers + body written
+        here), or ``(status, body)`` for a pre-stream failure — nothing
+        has touched the socket yet, so the caller answers with the
+        ordinary buffered path (same status codes, Retry-After, headers
+        as a non-streamed request).
+
+        The response headers (trace id + ``X-MLT-TTFT-S``) are sent at
+        the moment the FIRST token event arrives — the stamp and the
+        first flushed byte describe the same instant, which is the
+        property the streaming bench gates on."""
+        params, err = _validate(payload)
+        if err is None:
+            err = _validate_stream(params)
+        if err:
+            return 400, {"error": err}
+        eng = self.engine
+        if not self.batching or not hasattr(eng, "submit_stream_request"):
+            return 400, {"error":
+                         "streaming requires the continuous-batching engine"}
+        try:
+            req, q = eng.submit_stream_request(
+                params["prompts"][0], params["tokens_to_generate"],
+                return_output_log_probs=params["logprobs"],
+                top_k_sampling=params["top_k"],
+                top_p_sampling=params["top_p"],
+                temperature=params["temperature"],
+                add_BOS=params["add_BOS"],
+                stop_on_double_eol=params["stop_on_double_eol"],
+                stop_on_eol=params["stop_on_eol"],
+                random_seed=params["random_seed"],
+                priority=params["priority"],
+                ttft_deadline_ms=params["ttft_deadline_ms"],
+                tpot_deadline_ms=params["tpot_deadline_ms"],
+                trace_id=trace_id)
+        except EngineOverloaded as eo:
+            return 503, {"error": str(eo),
+                         "retry_after": getattr(eo, "retry_after", 1.0),
+                         **getattr(eo, "info", {})}
+        except RequestShed as rs:
+            return 503, {"error": str(rs), "shed": True,
+                         "retry_after": getattr(rs, "retry_after", 1.0)}
+        except (ValueError, AssertionError) as ve:
+            return 400, {"error": str(ve.args[0] if ve.args else ve)}
+        first = q.next_event(timeout=600.0)
+        if first is None:
+            q.abandon()
+            return 500, {"error": "stream produced no event within 600s"}
+        if first.kind == "error":
+            # terminal before any byte was written: still a buffered
+            # answer — shed stays retryable (503), failure is a 500
+            data = first.data
+            if data.get("shed"):
+                return 503, {"error": data.get("error", "request shed"),
+                             "shed": True,
+                             "retry_after": data.get("retry_after", 1.0)}
+            return 500, {"error": data.get("error", "generation failed")}
+        headers = {"X-MLT-Trace-Id": trace_id} if trace_id else {}
+        ttft = req.ttft
+        if ttft is not None:
+            headers["X-MLT-TTFT-S"] = str(round(ttft, 6))
+        tok = getattr(eng, "tokenizer", None)
+        try:
+            handler._begin(200, SSE_CONTENT_TYPE, headers)
+            ev = first
+            flushed_first = False
+            while True:
+                if ev.kind == "token":
+                    frame = {"tokens": ev.tokens, "logprobs": ev.log_probs}
+                    if tok is not None:
+                        frame["text"] = tok.detokenize(ev.tokens)
+                    handler._send_chunk(sse_encode("token", frame))
+                    if not flushed_first:
+                        # flight-record event: the instant the first
+                        # token actually left for the client
+                        flushed_first = True
+                        req._flight.event("first_byte_flushed")
+                elif ev.kind == "done":
+                    if ev.data.get("dropped_events"):
+                        # honest drop-to-terminal: the incremental
+                        # events above are incomplete, the done body
+                        # below is not
+                        handler._send_chunk(sse_encode("dropped", {
+                            "dropped_events": ev.data["dropped_events"]}))
+                    texts, segments, log_probs = eng.finalize_stream_request(
+                        req, return_output_log_probs=params["logprobs"])
+                    body = {"text": texts, "segments": segments,
+                            "logprobs": log_probs}
+                    if trace_id:
+                        timing = self.request_timing(trace_id)
+                        if timing is not None:
+                            body["timing"] = timing
+                    handler._send_chunk(sse_encode("done", body))
+                    return None
+                else:  # terminal error after bytes were written:
+                    # structured SSE error frame, never silent truncation
+                    data = dict(ev.data)
+                    data.setdefault("error", "generation failed")
+                    handler._send_chunk(sse_encode("error", data))
+                    return None
+                ev = q.next_event(timeout=600.0)
+                if ev is None:
+                    handler._send_chunk(sse_encode("error", {
+                        "error": "stream stalled (no event within 600s)"}))
+                    return None
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away mid-stream: shed future publishes and let
+            # the generation finish on its own (it may be shared work)
+            q.abandon()
+            return None
+
     def _make_handler(server):  # noqa: N805 — `server` is the enclosing object
         class Handler(BaseHTTPRequestHandler):
+            def _begin(self, code: int, content_type="application/json",
+                       headers=None, length: Optional[int] = None):
+                """THE write-path entry for buffered AND streamed
+                responses: status line + headers.  A streamed response
+                (``length=None``) carries no Content-Length — the body
+                is delimited by EOF (HTTP/1.0 semantics) — and disables
+                Nagle coalescing so each flushed SSE frame hits the wire
+                immediately instead of waiting out the delayed-ACK timer
+                (first-byte latency is the whole point of streaming)."""
+                if length is None:
+                    self.connection.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                if length is not None:
+                    self.send_header("Content-Length", str(length))
+                else:
+                    self.send_header("Connection", "close")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+
             def _send(self, code: int, body, content_type="application/json",
                       headers=None):
                 data = (json.dumps(body) if content_type == "application/json"
                         else body).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
+                self._begin(code, content_type, headers, length=len(data))
                 self.wfile.write(data)
+
+            def _send_chunk(self, data: bytes):
+                """One streamed body write, flushed to the socket."""
+                self.wfile.write(data)
+                self.wfile.flush()
 
             def do_PUT(self):
                 if self.path.rstrip("/") != "/api":
@@ -282,9 +451,21 @@ class MegatronServer:
                 trace_id = (self.headers.get("X-MLT-Trace-Id", "").strip()
                             or uuid.uuid4().hex)
                 try:
-                    with obs_trace.span("serve-api", trace_id=trace_id):
-                        code, body = server.handle_request(
-                            payload, trace_id=trace_id)
+                    if isinstance(payload, dict) and payload.get("stream"):
+                        # SSE path; a None return means the stream was
+                        # served (headers + body already written), else
+                        # fall through to the buffered answer below
+                        with obs_trace.span("serve-api-stream",
+                                            trace_id=trace_id):
+                            fallback = server.stream_response(
+                                self, payload, trace_id=trace_id)
+                        if fallback is None:
+                            return
+                        code, body = fallback
+                    else:
+                        with obs_trace.span("serve-api", trace_id=trace_id):
+                            code, body = server.handle_request(
+                                payload, trace_id=trace_id)
                 except Exception as e:  # last-resort: still a JSON answer
                     code, body = 500, {
                         "error": f"internal error: {type(e).__name__}: {e}"}
@@ -351,6 +532,13 @@ class MegatronServer:
         info = {
             "status": "ok",
             "batching": self.batching,
+            # streaming capability + elastic-discovery mode (ISSUE 18):
+            # the router's ReplicaView parses both, so a fleet can tell
+            # which replicas serve "stream": true and which arrived via
+            # /admin/register heartbeats rather than static config
+            "streaming": bool(self.batching
+                              and hasattr(self.engine, "submit_stream")),
+            "registered": self.register_url is not None,
             "replica_id": self.replica_id,
             "seq": seq,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
@@ -470,6 +658,42 @@ class MegatronServer:
         if self.batching and hasattr(self.engine, "start"):
             self.engine.start()  # background scheduler drives shared ticks
 
+    # ---- elastic discovery (ISSUE 18) -----------------------------------
+
+    def _heartbeat_loop(self, advertised: str) -> None:
+        """POST ``/admin/register`` to the router until stopped.  Every
+        beat carries the advertised url + replica_id; failures are
+        swallowed (the router may be down or restarting — the whole
+        point of heartbeats is that it catches up on the next one)."""
+        import urllib.request
+
+        target = self.register_url.rstrip("/") + "/admin/register"
+        body = json.dumps({"replica": advertised,
+                           "replica_id": self.replica_id}).encode()
+        while True:
+            req = urllib.request.Request(
+                target, data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    resp.read()
+            except Exception:
+                pass
+            if self._register_stop.wait(self.register_interval_s):
+                return
+
+    def _start_heartbeat(self, port: int) -> None:
+        if not self.register_url or self._register_thread is not None:
+            return
+        advertised = self.advertise_url or f"http://127.0.0.1:{port}"
+        self._register_stop.clear()
+        t = threading.Thread(target=self._heartbeat_loop, args=(advertised,),
+                             name="replica-register", daemon=True)
+        self._register_thread = t
+        t.start()
+
+    # ---- lifecycle ------------------------------------------------------
+
     def bind(self, host: str = "0.0.0.0", port: int = 5000) -> int:
         """Bind the listening socket (without serving) and return the bound
         port — with ``port=0`` the OS picks a free one, which is how local
@@ -482,6 +706,7 @@ class MegatronServer:
         """Serve on the socket from ``bind()`` (blocking)."""
         assert self._httpd is not None, "call bind() first"
         self._start_engine()
+        self._start_heartbeat(self._httpd.server_address[1])
         self._httpd.serve_forever()
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
@@ -492,11 +717,16 @@ class MegatronServer:
         """Run in a daemon thread (used by tests); returns the bound port."""
         bound = self.bind(host, port)
         self._start_engine()
+        self._start_heartbeat(bound)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         return bound
 
     def stop(self):
+        self._register_stop.set()
+        if self._register_thread is not None:
+            self._register_thread.join(timeout=5.0)
+            self._register_thread = None
         if self._httpd is not None:
             self._httpd.shutdown()
             # close the listening socket too: new connections must be

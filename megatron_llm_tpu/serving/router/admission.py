@@ -1,0 +1,126 @@
+"""Router-level admission queue (ISSUE 18).
+
+A saturated fleet used to answer a burst with replica 503s (bounded
+proxy retries, then ``fleet_saturated``).  The admission queue puts a
+bounded FIFO *in front of* the forwarding data plane instead: at most
+``limit`` requests are in flight fleet-wide, arrivals beyond that wait
+their turn (deadline-aware — a request carrying ``ttft_deadline_ms``
+never waits past the point where admission alone would blow its
+deadline), and only *queue overflow* is an immediate
+:class:`FleetOverloaded`-style 503.  A short burst therefore drains at
+the fleet's pace with 0 dropped requests (bench_decode.py --mode
+streaming, admission arm).
+
+Fairness is strict FIFO via a deque of per-waiter events; a waiter that
+times out unlinks itself, and the grant path (``release``) hands slots
+to the queue head.  The lock is a leaf: nothing is called while holding
+it (the waiter blocks on its own event OUTSIDE the lock).
+
+Metrics (owned by the RouterServer, which sees the return values):
+``mlt_router_admission_queue_depth`` + ``mlt_router_admission_wait_seconds``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+__all__ = ["AdmissionOverflow", "AdmissionQueue"]
+
+
+class AdmissionOverflow(Exception):
+    """The bounded admission queue is full — the only condition that
+    503s immediately (the router's FleetOverloaded analog)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0, depth: int = 0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.depth = depth
+
+
+class _Waiter:
+    __slots__ = ("event", "granted")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.granted = False
+
+
+class AdmissionQueue:
+    """Bounded-FIFO concurrency gate; see the module docstring."""
+
+    def __init__(self, *, limit: int, depth: int,
+                 timeout_s: float = 10.0):
+        assert limit >= 1 and depth >= 1
+        self.limit = limit          # concurrent in-flight forwards
+        self.depth = depth          # waiters beyond that before overflow
+        self.timeout_s = timeout_s  # default cap on one waiter's wait
+        self._lock = threading.Lock()
+        self._inflight = 0  # guarded by _lock
+        self._waiters = collections.deque()  # guarded by _lock
+        self._timeouts = 0  # guarded by _lock
+        self._overflows = 0  # guarded by _lock
+
+    def try_admit(self, deadline_s: Optional[float] = None
+                  ) -> Optional[float]:
+        """Admit one request, waiting FIFO behind earlier arrivals.
+
+        ``deadline_s`` caps THIS request's wait (deadline-aware: the
+        handler passes ``min(timeout_s, ttft_deadline)``); None uses the
+        queue default.  Returns the seconds waited on admission, or None
+        when the wait timed out (the fleet stayed saturated for the
+        whole window).  Raises :class:`AdmissionOverflow` when the
+        bounded queue itself is full.  Callers MUST ``release()`` after
+        the forward completes iff admission succeeded."""
+        cap = self.timeout_s if deadline_s is None else deadline_s
+        t0 = time.monotonic()
+        with self._lock:
+            if self._inflight < self.limit and not self._waiters:
+                self._inflight += 1
+                return 0.0
+            if len(self._waiters) >= self.depth:
+                self._overflows += 1
+                raise AdmissionOverflow(
+                    f"admission queue full ({self.depth} waiting)",
+                    retry_after=1.0, depth=self.depth)
+            w = _Waiter()
+            self._waiters.append(w)
+        if not w.event.wait(cap):
+            with self._lock:
+                if w.granted:
+                    # granted in the race window between timeout and
+                    # unlink: keep the slot, the caller proceeds
+                    return time.monotonic() - t0
+                try:
+                    self._waiters.remove(w)
+                except ValueError:
+                    pass
+                self._timeouts += 1
+            return None
+        return time.monotonic() - t0
+
+    def release(self) -> None:
+        """One in-flight forward finished: hand its slot to the queue
+        head (strict FIFO)."""
+        with self._lock:
+            self._inflight -= 1
+            assert self._inflight >= 0, "release() without try_admit()"
+            while self._waiters and self._inflight < self.limit:
+                w = self._waiters.popleft()
+                w.granted = True
+                self._inflight += 1
+                w.event.set()
+
+    def queued(self) -> int:
+        with self._lock:
+            return len(self._waiters)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"limit": self.limit, "depth": self.depth,
+                    "inflight": self._inflight,
+                    "queued": len(self._waiters),
+                    "timeouts": self._timeouts,
+                    "overflows": self._overflows}

@@ -89,6 +89,11 @@ class ReplicaView:
     kv_dtype: str = "bf16"
     kv_pool_bytes: int = 0
     kv_scale_bytes: int = 0
+    # streaming serving tier (ISSUE 18): does this replica serve SSE
+    # token streams ("stream": true), and did it start with
+    # --register_url (heartbeat-discovered rather than static config)
+    streaming: bool = False
+    registered: bool = False
     # scheduler control-plane payload (engine.scheduler_stats())
     policy: str = ""
     retry_after_s: Optional[float] = None
@@ -135,6 +140,8 @@ class ReplicaView:
             kv_dtype=str(payload.get("kv_dtype", "bf16")),
             kv_pool_bytes=int(payload.get("kv_pool_bytes", 0)),
             kv_scale_bytes=int(payload.get("kv_scale_bytes", 0)),
+            streaming=bool(payload.get("streaming", False)),
+            registered=bool(payload.get("registered", False)),
             policy=str(sched.get("policy", "")),
             retry_after_s=(None if sched.get("retry_after_s") is None
                            else float(sched["retry_after_s"])),
@@ -201,11 +208,14 @@ class Replica:
     """One fleet member: breaker state + freshest accepted view."""
 
     def __init__(self, url: str, *, suspect_after: int = 1,
-                 eject_after: int = 3):
+                 eject_after: int = 3, registered: bool = False):
         assert 1 <= suspect_after <= eject_after
         self.url = url
         self.suspect_after = suspect_after
         self.eject_after = eject_after
+        # elastic discovery (ISSUE 18): True when this replica joined
+        # via POST /admin/register rather than static --replica urls
+        self.registered = registered
         self._lock = threading.Lock()
         self._state = HEALTHY  # guarded by _lock
         self._draining = False  # guarded by _lock
@@ -295,6 +305,7 @@ class Replica:
             return {
                 "url": self.url,
                 "state": self._state,
+                "registered": self.registered,
                 "consecutive_failures": self._failures,
                 "last_error": self._last_error,
                 "restarts": self._restarts,
@@ -313,10 +324,19 @@ class ReplicaRegistry:
     for the policies and failure reporting for the proxy."""
 
     def __init__(self, urls: List[str], *, suspect_after: int = 1,
-                 eject_after: int = 3, max_staleness_s: float = 10.0):
-        if not urls:
+                 eject_after: int = 3, max_staleness_s: float = 10.0,
+                 allow_empty: bool = False,
+                 on_add: Optional[Callable[["Replica"], None]] = None):
+        if not urls and not allow_empty:
+            # allow_empty is the elastic-discovery mode (ISSUE 18): the
+            # fleet starts empty and fills from /admin/register beats
             raise ValueError("a router needs at least one replica url")
         self.max_staleness_s = max_staleness_s
+        self._suspect_after = suspect_after
+        self._eject_after = eject_after
+        # called (outside _lock) for every dynamically-added replica —
+        # the router hooks it to spawn a poller thread + publish gauges
+        self._on_add = on_add
         self._lock = threading.Lock()
         # url -> Replica; insertion order is the stable fleet order that
         # round_robin and the hash ring key on — guarded by _lock
@@ -332,6 +352,30 @@ class ReplicaRegistry:
     def get(self, url: str) -> Replica:
         with self._lock:
             return self._replicas[url]
+
+    def register(self, url: str) -> Tuple[Replica, bool]:
+        """A replica heartbeat (POST /admin/register): add ``url`` to the
+        fleet if it's new, idempotent otherwise.  Returns ``(replica,
+        added)``.  Registered replicas merge with the static fleet and
+        ride the same breaker ladder — a replica that stops beating AND
+        stops answering polls walks suspect→ejected like any other, and
+        a restart on a new port simply registers the new url (the old
+        one ejects on its own).  ``on_add`` runs outside the registry
+        lock: it spawns a poller thread that immediately takes the
+        replica's own lock."""
+        with self._lock:
+            rep = self._replicas.get(url)
+            if rep is None:
+                rep = Replica(url, suspect_after=self._suspect_after,
+                              eject_after=self._eject_after,
+                              registered=True)
+                self._replicas[url] = rep
+                added = True
+            else:
+                added = False
+        if added and self._on_add is not None:
+            self._on_add(rep)
+        return rep, added
 
     def routable_views(self) -> List[ReplicaView]:
         """Fresh views of every replica currently accepting traffic, in
@@ -400,7 +444,9 @@ class HealthPoller:
         self._fetch = fetch
         self._on_poll = on_poll  # observability hook (router server)
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []  # guarded by _threads_lock
+        self._started = False  # guarded by _threads_lock
 
     def poll_once(self, rep: Replica) -> bool:
         """Scrape one replica now; returns success.  Exposed for tests and
@@ -429,16 +475,32 @@ class HealthPoller:
             if self._stop.wait(wait):
                 return
 
+    def _spawn_locked(self, rep: Replica) -> None:  # holds _threads_lock
+        t = threading.Thread(target=self._loop, args=(rep,),
+                             name=f"health-poll:{rep.url}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
     def start(self) -> None:
-        assert not self._threads, "poller already started"
-        for rep in self.registry.replicas():
-            t = threading.Thread(target=self._loop, args=(rep,),
-                                 name=f"health-poll:{rep.url}", daemon=True)
-            t.start()
-            self._threads.append(t)
+        with self._threads_lock:
+            assert not self._threads, "poller already started"
+            self._started = True
+            for rep in self.registry.replicas():
+                self._spawn_locked(rep)
+
+    def watch(self, rep: Replica) -> None:
+        """Start polling a dynamically-registered replica (ISSUE 18).
+        Before ``start()`` this is a no-op — start() picks up every
+        replica the registry holds at that point."""
+        with self._threads_lock:
+            if not self._started:
+                return
+            self._spawn_locked(rep)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        for t in self._threads:
+        with self._threads_lock:
+            threads, self._threads = self._threads, []
+            self._started = False
+        for t in threads:
             t.join(timeout=timeout)
-        self._threads = []

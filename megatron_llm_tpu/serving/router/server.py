@@ -6,6 +6,19 @@ probes point at either tier identically):
 * ``PUT /api`` — route + forward.  The body is forwarded verbatim; the
   router only *reads* ``prompts[0]``/``priority``/``ttft_deadline_ms``
   for the routing decision, so the wire contract stays the replica's.
+  A ``"stream": true`` body switches to streaming pass-through (ISSUE
+  18): the proxy connects (connect-phase failures still fail over),
+  relays the replica's SSE bytes verbatim as they arrive, and — once
+  the first body byte has been forwarded — NEVER retries.  A replica
+  dying mid-stream yields a structured terminal ``event: error`` frame
+  (plus a breaker failure record), never a silent truncation.
+* ``POST /admin/register`` — elastic replica discovery (ISSUE 18;
+  requires ``allow_registration``): replicas started with
+  ``--register_url`` heartbeat ``{"replica": url}`` here.  A new url
+  is polled synchronously (immediately routable), merged with the
+  static fleet, and expires through the same suspect→eject breaker as
+  everything else; a restarted replica on a new port simply registers
+  the new url.
 * ``GET /health`` — fleet summary (per-replica breaker state, view age,
   queue/pages snapshot, restart counts) + router identity.
 * ``GET /metrics`` — Prometheus text: per-replica up/queue/pages gauges
@@ -37,6 +50,7 @@ scrape (registry.py).
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 import urllib.request
@@ -47,13 +61,20 @@ from urllib.parse import parse_qs
 
 from megatron_llm_tpu.observability.registry import get_registry
 from megatron_llm_tpu.observability.trace import span
+from megatron_llm_tpu.serving.router.admission import (
+    AdmissionOverflow,
+    AdmissionQueue,
+)
 from megatron_llm_tpu.serving.router.policy import (
     FleetOverloaded,
     RouteRequest,
     RouterPolicy,
     get_router_policy,
 )
-from megatron_llm_tpu.serving.router.proxy import ForwardingProxy
+from megatron_llm_tpu.serving.router.proxy import (
+    ForwardingProxy,
+    StreamHandle,
+)
 from megatron_llm_tpu.serving.router.registry import (
     HealthPoller,
     Replica,
@@ -80,12 +101,18 @@ class RouterServer:
                  suspect_after: int = 1,
                  eject_after: int = 3,
                  forward_timeout_s: float = 300.0,
-                 max_retries: int = 2):
+                 max_retries: int = 2,
+                 allow_registration: bool = False,
+                 admission_depth: int = 0,
+                 admission_limit: int = 0,
+                 admission_timeout_s: float = 10.0):
         self.router_id = uuid.uuid4().hex
         self._t_start = time.monotonic()
+        self.allow_registration = allow_registration
         self.registry = ReplicaRegistry(
             replica_urls, suspect_after=suspect_after,
-            eject_after=eject_after, max_staleness_s=max_staleness_s)
+            eject_after=eject_after, max_staleness_s=max_staleness_s,
+            allow_empty=allow_registration)
         self.policy: RouterPolicy = get_router_policy(policy)(
             **(policy_kwargs or {}))
         self.proxy = ForwardingProxy(
@@ -111,6 +138,22 @@ class RouterServer:
             "slo_aware found none feasible)")
         self._poll_failures = reg.counter(
             "mlt_router_poll_failures_total", "failed /health scrapes")
+        # admission queue (ISSUE 18): depth 0 keeps it off entirely.
+        # limit 0 = auto: recomputed from the routable fleet's summed
+        # max_slots before each wait, so an elastic fleet growing
+        # mid-burst widens admission without a restart.
+        self.admission: Optional[AdmissionQueue] = None
+        self._admission_auto = admission_limit == 0
+        if admission_depth > 0:
+            self.admission = AdmissionQueue(
+                limit=admission_limit if admission_limit > 0 else 1,
+                depth=admission_depth, timeout_s=admission_timeout_s)
+        self._m_adm_depth = reg.gauge(
+            "mlt_router_admission_queue_depth",
+            "requests waiting in the router admission queue")
+        self._m_adm_wait = reg.histogram(
+            "mlt_router_admission_wait_seconds",
+            "seconds a request waited for admission before forwarding")
 
     # ---- observability hooks -------------------------------------------
 
@@ -154,6 +197,59 @@ class RouterServer:
             "X-MLT-TTFT-S)",
             labels={"replica": replica_url},
             buckets=_TTFT_BUCKETS).observe(seconds)
+
+    # ---- admission (ISSUE 18) ------------------------------------------
+
+    def admit(self, payload: dict) -> Optional[float]:
+        """Gate one request through the admission queue.
+
+        Returns the seconds waited (0.0 when no queue is configured —
+        the request is always "admitted" then, but ``admitted_release``
+        stays safe to call).  Returns None when the wait timed out, and
+        raises :class:`AdmissionOverflow` when the bounded queue is
+        full — both map to 503 in the handler."""
+        adm = self.admission
+        if adm is None:
+            return 0.0
+        if self._admission_auto:
+            views = self.registry.routable_views()
+            if views:
+                adm.limit = max(1, sum(v.max_slots for v in views))
+        deadline = None
+        v = payload.get("ttft_deadline_ms")
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            # deadline-aware: never wait past the point where admission
+            # alone would blow the caller's TTFT deadline
+            deadline = min(adm.timeout_s, float(v) / 1e3)
+        try:
+            waited = adm.try_admit(deadline)
+        finally:
+            self._m_adm_depth.set(adm.queued())
+        if waited is not None:
+            self._m_adm_wait.observe(waited)
+        return waited
+
+    def admitted_release(self) -> None:
+        adm = self.admission
+        if adm is None:
+            return
+        adm.release()
+        self._m_adm_depth.set(adm.queued())
+
+    # ---- elastic discovery (ISSUE 18) ----------------------------------
+
+    def register_replica(self, url: str):
+        """``POST /admin/register`` backend: merge ``url`` into the
+        fleet.  A first-contact replica is polled synchronously (so it
+        is routable before its next heartbeat lands) and handed to the
+        running poller; a known url is a heartbeat no-op — liveness is
+        the poller's job, not the heartbeat's."""
+        rep, added = self.registry.register(url)
+        if added:
+            self.poller.poll_once(rep)
+            self.poller.watch(rep)
+            self._publish_replica_gauges(rep)
+        return rep, added
 
     # ---- request handling ----------------------------------------------
 
@@ -210,6 +306,76 @@ class RouterServer:
         if out.status == 503 and out.retry_after is not None:
             headers["Retry-After"] = str(max(1, int(out.retry_after)))
         return out.status, out.body, headers
+
+    def route_stream(self, payload: dict, body: bytes, trace_id: str = ""):
+        """Streaming variant of :meth:`route` (ISSUE 18).
+
+        Same decision phase; the proxy stops after the connect phase.
+        Returns a :class:`StreamHandle` (headers arrived, body unread —
+        the handler relays bytes via :meth:`pump`) or the usual
+        ``(status, body_bytes, headers)`` tuple when no stream opened
+        (shed / saturated / terminal replica error)."""
+        request = RouteRequest.from_payload(payload)
+        views = self.registry.routable_views()
+        if not views:
+            self._shed.inc()
+            fleet = self.registry.summary()["fleet"]
+            return 503, json.dumps({
+                "error": "no routable replica (fleet: %s)" % fleet,
+                "retry_after": 1.0, "fleet": fleet,
+            }).encode(), {"Retry-After": "1"}
+        try:
+            with span("router-route", policy=self.policy.name,
+                      trace_id=trace_id):
+                candidates = self.policy.order(request, views)
+        except FleetOverloaded as fo:
+            self._shed.inc()
+            return 503, json.dumps({
+                "error": str(fo), "retry_after": fo.retry_after,
+                "shed": True, **fo.info,
+            }).encode(), {"Retry-After": str(max(1, int(fo.retry_after)))}
+        t0 = time.monotonic()
+        out = self.proxy.forward_stream(
+            [v.url for v in candidates], body,
+            headers={"X-MLT-Trace-Id": trace_id} if trace_id else None)
+        if isinstance(out, StreamHandle):
+            self._routed.inc()
+            if out.failovers:
+                self._failovers.inc(out.failovers)
+            if out.retries:
+                self._retries.inc(out.retries)
+            get_registry().counter(
+                "mlt_router_decisions_total",
+                "forwards that reached a replica, by policy and replica",
+                labels={"policy": self.policy.name,
+                        "replica": out.url}).inc()
+            # the stream's headers carry the replica's first-token
+            # stamp — the client is already receiving bytes by now
+            self._observe_ttft(out.url,
+                               out.ttft_s if out.ttft_s is not None
+                               else time.monotonic() - t0)
+            return out
+        self._routed.inc()
+        if out.failovers:
+            self._failovers.inc(out.failovers)
+        if out.retries:
+            self._retries.inc(out.retries)
+        get_registry().counter(
+            "mlt_router_decisions_total",
+            "forwards that reached a replica, by policy and replica",
+            labels={"policy": self.policy.name,
+                    "replica": out.replica_url or "none"}).inc()
+        headers = {}
+        if trace_id:
+            headers["X-MLT-Trace-Id"] = trace_id
+        if out.status == 503 and out.retry_after is not None:
+            headers["Retry-After"] = str(max(1, int(out.retry_after)))
+        return out.status, out.body, headers
+
+    def pump(self, handle: StreamHandle, write) -> dict:
+        """Relay an accepted stream's body to ``write``; see
+        ``ForwardingProxy.pump_stream`` for the truncation contract."""
+        return self.proxy.pump_stream(handle, write)
 
     def health(self) -> dict:
         info = self.registry.summary()
@@ -281,6 +447,24 @@ class RouterServer:
             def _send_json(self, code: int, body: dict, headers=None):
                 self._send(code, json.dumps(body).encode(), headers=headers)
 
+            def _begin_stream(self, code: int, content_type: str,
+                              headers=None):
+                # streamed write path: no Content-Length (EOF-delimited
+                # via Connection: close) + TCP_NODELAY so each relayed
+                # SSE frame leaves the socket without Nagle batching
+                self.connection.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Connection", "close")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+
+            def _write_chunk(self, data: bytes):
+                self.wfile.write(data)
+                self.wfile.flush()
+
             def do_PUT(self):
                 if self.path.rstrip("/") != "/api":
                     return self._send_json(404, {"error": "not found"})
@@ -295,16 +479,80 @@ class RouterServer:
                         400, {"error": "request body must be a JSON object"})
                 trace_id = (self.headers.get("X-MLT-Trace-Id", "").strip()
                             or uuid.uuid4().hex)
+                admitted = False
                 try:
-                    code, data, headers = router.route(payload, body,
-                                                       trace_id=trace_id)
-                except Exception as e:  # route/forward must answer the client
+                    try:
+                        waited = router.admit(payload)
+                    except AdmissionOverflow as ao:
+                        router._shed.inc()
+                        return self._send_json(503, {
+                            "error": str(ao),
+                            "retry_after": ao.retry_after,
+                            "admission_overflow": True,
+                        }, headers={
+                            "Retry-After": str(max(1, int(ao.retry_after)))})
+                    if waited is None:
+                        router._shed.inc()
+                        return self._send_json(503, {
+                            "error": "admission wait timed out "
+                                     "(fleet saturated)",
+                            "retry_after": 1.0, "shed": True,
+                        }, headers={"Retry-After": "1"})
+                    admitted = True
+                    if payload.get("stream"):
+                        return self._route_stream(payload, body, trace_id)
+                    try:
+                        code, data, headers = router.route(
+                            payload, body, trace_id=trace_id)
+                    except Exception as e:  # must answer the client
+                        return self._send_json(500, {
+                            "error":
+                                f"router error: {type(e).__name__}: {e}"})
+                    return self._send(code, data, headers=headers)
+                finally:
+                    if admitted:
+                        router.admitted_release()
+
+            def _route_stream(self, payload, body, trace_id):
+                try:
+                    out = router.route_stream(payload, body,
+                                              trace_id=trace_id)
+                except Exception as e:  # must answer the client
                     return self._send_json(500, {
                         "error": f"router error: {type(e).__name__}: {e}"})
-                return self._send(code, data, headers=headers)
+                if not isinstance(out, StreamHandle):
+                    code, data, headers = out
+                    return self._send(code, data, headers=headers)
+                hdrs = {"X-MLT-Trace-Id": trace_id}
+                if out.ttft_s is not None:
+                    hdrs["X-MLT-TTFT-S"] = str(out.ttft_s)
+                try:
+                    self._begin_stream(200, out.content_type, headers=hdrs)
+                    router.pump(out, self._write_chunk)
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass  # client gone; pump already avoided breaker blame
 
             def do_POST(self):
                 path = self.path.rstrip("/")
+                if path == "/admin/register":
+                    if not router.allow_registration:
+                        return self._send_json(403, {
+                            "error": "registration disabled (start the "
+                                     "router with --allow_registration)"})
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                        url = payload["replica"]
+                    except (ValueError, KeyError, json.JSONDecodeError):
+                        return self._send_json(
+                            400, {"error": 'body must be {"replica": url}'})
+                    if not isinstance(url, str) or not url.startswith("http"):
+                        return self._send_json(
+                            400, {"error": "replica must be an http url"})
+                    rep, added = router.register_replica(url)
+                    return self._send_json(
+                        200, {"replica": url, "state": rep.state,
+                              "added": added})
                 if path in ("/admin/drain", "/admin/undrain"):
                     try:
                         length = int(self.headers.get("Content-Length", 0))

@@ -1156,3 +1156,94 @@ def test_committed_pipeline_evidence_is_valid():
     stamped = dict(rec)
     stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
     assert not _bench_on_tpu(json.dumps(stamped))
+
+
+def test_streaming_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode streaming (ISSUE 18) reuses the off-TPU
+    contract: headline 0, the streamed-vs-buffered TTFT comparison and
+    the admission-queue burst rows ride under cpu_sanity with budget
+    fields populated, TPU evidence goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric":
+            "serving_stream_first_token_speedup_llama470m_c8_2rep_1chip",
+        "value": 2.4, "unit": "x", "backend": "cpu",
+        "first_token_speedup": 2.4, "stream_ok": True,
+        "stamp_ratio": 1.1, "stamp_ok": True,
+        "buffered_first_byte_is_total": True, "identity_ok": True,
+        "baseline_dropped": 8, "admission_dropped": 0,
+        "compile_time_s": 3.0, "step_time_s": 0.01,
+        "rows": [{"arm": "streamed", "client_ttft_mean_ms": 55.0,
+                  "replica_stamp_mean_ms": 50.0, "total_mean_ms": 170.0},
+                 {"arm": "buffered", "client_ttft_mean_ms": 132.0,
+                  "total_mean_ms": 135.0},
+                 {"admission_queue": False, "requests": 12, "ok": 4,
+                  "dropped": 8},
+                 {"admission_queue": True, "requests": 12, "ok": 12,
+                  "dropped": 0}],
+    }, tag="engine_decode_streaming")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["stream_ok"] is True
+    assert line["cpu_sanity"]["admission_dropped"] == 0
+    assert line["budgets"]["compile_time_s"]["value"] == 3.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "serving_stream", "value": 2.6,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_streaming")
+    assert bench.load_last_tpu(tag="engine_decode_streaming")["value"] == 2.6
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_streaming_bench_in_watch_jobs():
+    """ISSUE 18: the streaming serving-tier bench is in the tunnel-up
+    capture list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_streaming" in by_name
+    cmd, bounded, pred = by_name["bench_decode_streaming"]
+    assert "--mode" in cmd and "streaming" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_streaming_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_streaming_cpu_
+    sanity.json) satisfies the acceptance bar: headline 0 off-TPU, the
+    streamed client's first byte lands within the stamp-honesty gate and
+    strictly before the buffered client's (speedup >= 1), the streamed
+    terminal body matched the buffered response byte-for-byte, the
+    saturation burst 503'd without the admission queue and dropped
+    nothing with it, budgets populated without violations."""
+    from pathlib import Path
+
+    path = (Path(__file__).parent.parent
+            / "BENCH_decode_streaming_cpu_sanity.json")
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["stream_ok"] is True
+    assert sanity["stamp_ok"] is True
+    assert sanity["identity_ok"] is True
+    assert sanity["buffered_first_byte_is_total"] is True
+    assert sanity["first_token_speedup"] >= 1.0
+    by_arm = {r["arm"]: r for r in sanity["rows"] if "arm" in r}
+    assert set(by_arm) == {"streamed", "buffered"}
+    # streaming delivers the first token earlier than the buffered
+    # response delivers anything at all
+    assert (by_arm["streamed"]["client_ttft_mean_ms"]
+            < by_arm["buffered"]["client_ttft_mean_ms"])
+    # every streamed response carried the replica's X-MLT-TTFT-S stamp
+    assert by_arm["streamed"]["stamped"] == sanity["workload"]["concurrency"]
+    bursts = {r["admission_queue"]: r for r in sanity["rows"]
+              if "admission_queue" in r}
+    assert set(bursts) == {False, True}
+    assert bursts[False]["dropped"] > 0  # the burst genuinely saturates
+    assert bursts[True]["dropped"] == 0
+    assert bursts[True]["ok"] == bursts[True]["requests"]
+    assert bursts[True]["admission_stats"]["overflows"] == 0
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+    # an error-stamped line of this shape must be rejected by the watch
+    # evidence predicate, not captured
+    stamped = dict(rec)
+    stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
+    assert not _bench_on_tpu(json.dumps(stamped))

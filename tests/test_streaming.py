@@ -1,0 +1,620 @@
+"""Streaming serving tier tests (serving/streaming/, ISSUE 18).
+
+Four layers, mirroring the subsystem: the bounded per-request emission
+queue and SSE wire helpers in isolation, the engine's submit_stream
+path against the buffered path (token identity), a real 2-replica
+loopback fleet streaming end-to-end through the router (byte/token
+identity, trace propagation, mid-stream death semantics), and the
+elastic-discovery + admission-queue control plane.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+import pytest
+
+from megatron_llm_tpu.serving.router.admission import (
+    AdmissionOverflow,
+    AdmissionQueue,
+)
+from megatron_llm_tpu.serving.streaming import (
+    StreamEvent,
+    StreamQueue,
+    parse_sse,
+    sse_encode,
+    sse_scan_terminal,
+)
+
+# ---------------------------------------------------------------------------
+# StreamQueue: bounded emission with honest drop-to-terminal semantics
+# ---------------------------------------------------------------------------
+
+
+def test_stream_queue_orders_tokens_then_terminal_exactly_once():
+    q = StreamQueue(maxsize=8)
+    assert q.publish_tokens([1, 2], [0.1, 0.2]) == 0
+    assert q.publish_tokens([3], [0.3]) == 0
+    q.publish_terminal(StreamEvent("done", data={"outcome": "ok"}))
+    evs = list(q.iter_events(timeout=1.0))
+    assert [e.kind for e in evs] == ["token", "token", "done"]
+    assert evs[0].tokens == [1, 2] and evs[1].tokens == [3]
+    # terminal is delivered exactly once; afterwards the queue is dry
+    assert q.next_event(timeout=0.01) is None
+
+
+def test_stream_queue_overflow_drops_with_honest_count():
+    q = StreamQueue(maxsize=2)
+    shed = sum(q.publish_tokens([i]) for i in range(5))
+    assert shed == 3 and q.dropped == 3, "publish must never block"
+    q.publish_terminal(StreamEvent("done", data={}))
+    evs = list(q.iter_events(timeout=1.0))
+    # the queued incrementals survive, the terminal carries the count
+    assert [e.kind for e in evs] == ["token", "token", "done"]
+    assert evs[-1].data["dropped_events"] == 3
+
+
+def test_stream_queue_abandon_sheds_future_publishes():
+    q = StreamQueue(maxsize=8)
+    q.publish_tokens([1])
+    q.abandon()
+    assert q.publish_tokens([2]) == 1, "post-abandon publishes are shed"
+    q.publish_terminal(StreamEvent("done", data={}))
+    assert q.next_event(timeout=0.01) is None, "abandoned consumers get nothing"
+
+
+def test_stream_queue_first_terminal_wins():
+    q = StreamQueue(maxsize=8)
+    q.publish_terminal(StreamEvent("error", data={"error": "boom"}))
+    q.publish_terminal(StreamEvent("done", data={}))
+    evs = list(q.iter_events(timeout=1.0))
+    assert [e.kind for e in evs] == ["error"]
+
+
+# ---------------------------------------------------------------------------
+# SSE wire helpers
+# ---------------------------------------------------------------------------
+
+
+def test_sse_encode_parse_roundtrip():
+    raw = (sse_encode("token", {"tokens": [1, 2]})
+           + sse_encode("done", {"text": ["hi\nthere"]}))
+    events = parse_sse(raw)
+    assert [e for e, _ in events] == ["token", "done"]
+    assert events[0][1]["tokens"] == [1, 2]
+    assert events[1][1]["text"] == ["hi\nthere"]
+
+
+def test_sse_terminal_scan_across_chunk_boundaries():
+    raw = sse_encode("token", {"t": 1}) + sse_encode("done", {"ok": 1})
+    for cut in range(1, len(raw)):
+        tail, seen = b"\n", False
+        for chunk in (raw[:cut], raw[cut:]):
+            seen, tail = sse_scan_terminal(tail, chunk)
+            if seen:
+                break
+        assert seen, f"terminal frame missed when split at byte {cut}"
+    # a stream with no terminal frame must never scan as terminated
+    seen, _ = sse_scan_terminal(b"\n", sse_encode("token", {"t": 1}))
+    assert not seen
+
+
+# ---------------------------------------------------------------------------
+# AdmissionQueue
+# ---------------------------------------------------------------------------
+
+
+def test_admission_fifo_grant_and_overflow():
+    adm = AdmissionQueue(limit=1, depth=2, timeout_s=5.0)
+    assert adm.try_admit() == 0.0  # fast path
+    order = []
+
+    def waiter(tag):
+        if adm.try_admit() is not None:
+            order.append(tag)
+
+    t1 = threading.Thread(target=waiter, args=("first",))
+    t1.start()
+    while adm.queued() < 1:
+        time.sleep(0.005)
+    t2 = threading.Thread(target=waiter, args=("second",))
+    t2.start()
+    while adm.queued() < 2:
+        time.sleep(0.005)
+    with pytest.raises(AdmissionOverflow):
+        adm.try_admit()  # bounded queue full -> immediate 503 material
+    adm.release()
+    t1.join(timeout=5)
+    adm.release()
+    t2.join(timeout=5)
+    assert order == ["first", "second"], "grants must be strict FIFO"
+    adm.release()
+    assert adm.stats()["inflight"] == 0 and adm.stats()["overflows"] == 1
+
+
+def test_admission_deadline_timeout_returns_none():
+    adm = AdmissionQueue(limit=1, depth=4, timeout_s=5.0)
+    assert adm.try_admit() == 0.0
+    t0 = time.monotonic()
+    assert adm.try_admit(deadline_s=0.05) is None, "saturated past deadline"
+    assert time.monotonic() - t0 < 2.0
+    assert adm.stats()["timeouts"] == 1 and adm.queued() == 0
+    adm.release()
+    assert adm.try_admit() == 0.0, "timed-out waiter must not leak a slot"
+
+
+# ---------------------------------------------------------------------------
+# Real-engine fleet (module scope: weights shared across tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Two continuous-batching replicas with identical weights behind real
+    MegatronServers, mirroring tests/test_router.py's fixture."""
+    import jax
+
+    from megatron_llm_tpu.generation import ContinuousBatchingEngine
+    from megatron_llm_tpu.generation.server import MegatronServer
+    from megatron_llm_tpu.models import init_model_params, make_config
+    from tests.test_generation import VOCAB, ToyTokenizer
+
+    cfg = make_config(
+        "llama2", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, ffn_hidden_size=128, seq_length=128,
+        max_position_embeddings=256, vocab_size=VOCAB,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        params_dtype="float32", use_flash_attn=False,
+    )
+    params = init_model_params(cfg, jax.random.PRNGKey(0))
+    servers, urls = [], []
+    for _ in range(2):
+        engine = ContinuousBatchingEngine(cfg, params, ToyTokenizer(),
+                                          max_slots=4, max_seq=128)
+        srv = MegatronServer(engine)
+        port = srv.start_background(port=0)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{port}")
+    yield servers, urls
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+
+
+def _put(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _stream_put(base, payload, headers=None, timeout=120):
+    """PUT with incremental SSE reads; returns (status, headers, frames,
+    first_frame_latency_s) where frames is parse_sse's [(event, data)]."""
+    u = urlparse(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    t0 = time.monotonic()
+    conn.request("PUT", "/api", body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    resp = conn.getresponse()
+    hdrs = dict(resp.getheaders())
+    raw, t_first = b"", None
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        if t_first is None:
+            t_first = time.monotonic() - t0
+        raw += chunk
+    conn.close()
+    if resp.status != 200 or not hdrs.get(
+            "Content-Type", "").startswith("text/event-stream"):
+        return resp.status, hdrs, json.loads(raw), t_first
+    return resp.status, hdrs, parse_sse(raw), t_first
+
+
+GEN = dict(tokens_to_generate=12, top_k=1, logprobs=True, random_seed=7)
+
+
+def test_engine_stream_tokens_match_buffered_submit(fleet):
+    """submit_stream's incremental emissions concatenate to exactly the
+    tokens the non-streamed path generates — transport, not sampling."""
+    servers, _ = fleet
+    eng = servers[0].engine
+    prompt = [3, 4, 5, 6, 7]
+    kw = dict(top_k=1, termination_id=10 ** 9, return_log_probs=True)
+    ref = eng.submit(prompt, 10, **kw)
+    eng.run_until_idle()
+    ref_toks, ref_lp = ref.result(timeout=10)
+    req, q = eng.submit_stream(prompt, 10, **kw)
+    eng.run_until_idle()
+    toks, lps = [], []
+    for ev in q.iter_events(timeout=10.0):
+        if ev.kind == "token":
+            toks += ev.tokens
+            lps += ev.log_probs
+        else:
+            assert ev.kind == "done"
+            assert ev.data["timing"]["tokens"] == len(toks)
+            assert ev.data["timing"]["ttft_s"] is not None
+    assert toks == list(ref_toks)[len(prompt):]
+    assert lps == pytest.approx(list(ref_lp))
+
+
+def test_replica_sse_done_body_identical_to_buffered(fleet):
+    """The acceptance bar, replica-direct: a "stream": true request's
+    terminal done frame carries the byte-identical generation payload of
+    the buffered request, headers (trace id + TTFT stamp) precede the
+    body, and the incremental frames actually stream tokens."""
+    _, urls = fleet
+    payload = {"prompts": ["stream me please"], **GEN}
+    code, buffered = _put(urls[0] + "/api", payload)
+    assert code == 200
+    tid = "stream-identity-test"
+    code, hdrs, frames, _ = _stream_put(
+        urls[0], {**payload, "stream": True},
+        headers={"X-MLT-Trace-Id": tid})
+    assert code == 200
+    assert hdrs["X-MLT-Trace-Id"] == tid
+    assert float(hdrs["X-MLT-TTFT-S"]) > 0.0
+    kinds = [e for e, _ in frames]
+    assert kinds[-1] == "done" and kinds.count("done") == 1
+    token_frames = [d for e, d in frames if e == "token"]
+    assert token_frames, "no incremental token frames streamed"
+    assert all(d["tokens"] for d in token_frames)
+    done = frames[-1][1]
+    # timing is per-serve metadata (ISSUE 12); the generation is not
+    assert done.pop("timing", None) is not None
+    buffered.pop("timing", None)
+    assert done == buffered, "streaming changed the tokens"
+
+
+def test_replica_health_advertises_streaming_and_registered(fleet):
+    _, urls = fleet
+    with urllib.request.urlopen(urls[0] + "/health", timeout=10) as resp:
+        info = json.loads(resp.read())
+    assert info["streaming"] is True
+    assert info["registered"] is False  # no --register_url on this fixture
+
+
+def test_stream_validation_rejects_unstreamable_requests(fleet):
+    _, urls = fleet
+    bad = [
+        ({"prompts": ["a", "b"], "tokens_to_generate": 4, "stream": True},
+         "exactly one prompt"),
+        ({"prompts": ["a"], "tokens_to_generate": 4, "beam_width": 2,
+          "stream": True}, "beam"),
+        ({"prompts": ["a"], "tokens_to_generate": 0, "stream": True},
+         "tokens_to_generate"),
+        ({"prompts": ["a"], "tokens_to_generate": 4, "stream": "yes"},
+         "boolean"),
+    ]
+    for payload, needle in bad:
+        code, body = _put(urls[0] + "/api", payload)
+        assert code == 400 and needle in body["error"], (payload, body)
+
+
+def test_router_stream_passthrough_identical_and_traced(fleet):
+    """Streamed through the router == streamed direct == buffered, with
+    one trace id spanning both tiers (echoed header + replica timing)."""
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    _, urls = fleet
+    router = RouterServer(urls, policy="round_robin", poll_interval=30.0)
+    try:
+        port = router.start_background()
+        base = f"http://127.0.0.1:{port}"
+        payload = {"prompts": ["route the stream"], **GEN, "stream": True}
+        tid = "router-stream-trace"
+        code, hdrs, frames, _ = _stream_put(
+            base, payload, headers={"X-MLT-Trace-Id": tid})
+        assert code == 200
+        assert hdrs["X-MLT-Trace-Id"] == tid
+        assert float(hdrs["X-MLT-TTFT-S"]) > 0.0
+        assert [e for e, _ in frames][-1] == "done"
+        routed_done = frames[-1][1]
+        # the replica resolved the SAME trace id (its flight record
+        # produced the timing block under that id)
+        assert routed_done.pop("timing", None) is not None
+        for u in urls:
+            code, _, direct, _ = _stream_put(u, payload)
+            assert code == 200
+            d = direct[-1][1]
+            d.pop("timing", None)
+            assert routed_done == d, "routing changed the streamed tokens"
+        code, buffered = _put(base + "/api",
+                              {k: v for k, v in payload.items()
+                               if k != "stream"})
+        assert code == 200
+        buffered.pop("timing", None)
+        assert routed_done == buffered
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Mid-stream death + admission + discovery (programmable fake replicas)
+# ---------------------------------------------------------------------------
+
+
+class _FakeStreamReplica:
+    """Minimal /api + /health replica; ``mode`` picks the PUT behavior:
+    'ok' buffered JSON, 'sse' a well-terminated stream, 'die_mid_stream'
+    two token frames then FIN with no terminal frame, 'slow_ok' buffered
+    after ``delay`` (capacity 1 — concurrent requests get 503)."""
+
+    def __init__(self, mode="ok", delay=0.2):
+        self.mode = mode
+        self.delay = delay
+        self.requests = 0
+        self.health_polls = 0
+        self._busy = threading.Semaphore(1)
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                outer.requests += 1
+                if outer.mode in ("sse", "die_mid_stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Connection", "close")
+                    self.send_header("X-MLT-TTFT-S", "0.001")
+                    self.end_headers()
+                    self.wfile.write(sse_encode("token", {"tokens": [1]}))
+                    self.wfile.write(sse_encode("token", {"tokens": [2]}))
+                    self.wfile.flush()
+                    if outer.mode == "sse":
+                        self.wfile.write(sse_encode(
+                            "done", {"text": ["ok"], "served_by": outer.url}))
+                        self.wfile.flush()
+                    else:
+                        self.connection.shutdown(socket.SHUT_WR)
+                    return
+                if outer.mode == "slow_ok":
+                    if not outer._busy.acquire(blocking=False):
+                        body = json.dumps({"error": "queue full",
+                                           "retry_after": 0.05}).encode()
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    try:
+                        time.sleep(outer.delay)
+                    finally:
+                        outer._busy.release()
+                body = json.dumps({"text": ["ok"],
+                                   "served_by": outer.url}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                outer.health_polls += 1
+                body = json.dumps({
+                    "status": "ok", "replica_id": outer.url,
+                    "seq": outer.health_polls, "uptime_s": 1.0,
+                    "active_slots": 0, "max_slots": 1, "queued": 0,
+                    "streaming": True, "registered": True,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_router_midstream_death_is_structured_never_silent():
+    """Once the first body byte is forwarded the request is committed:
+    a replica dying mid-stream yields a terminal SSE error frame (the
+    client can tell completion from truncation), feeds the breaker, and
+    is never retried — the healthy twin sees zero requests."""
+    from megatron_llm_tpu.serving.router import SUSPECT
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    dying = _FakeStreamReplica(mode="die_mid_stream")
+    healthy = _FakeStreamReplica(mode="sse")
+    router = RouterServer([dying.url, healthy.url], policy="round_robin",
+                          poll_interval=30.0)
+    try:
+        port = router.start_background()
+        payload = {"prompts": ["x"], "tokens_to_generate": 4,
+                   "stream": True}
+        for _ in range(2):  # round_robin: one request lands on each
+            code, _, frames, _ = _stream_put(
+                f"http://127.0.0.1:{port}", payload)
+            assert code == 200
+            kinds = [e for e, _ in frames]
+            assert kinds[-1] in ("done", "error"), (
+                f"silent truncation: stream ended with {kinds}")
+            if kinds[-1] == "error":
+                data = frames[-1][1]
+                assert data["truncated"] is True
+                assert data["replica"] == dying.url
+                assert "not retried" in data["error"]
+        assert dying.requests == 1 and healthy.requests == 1, (
+            "a mid-stream death must never be retried")
+        assert router.registry.get(dying.url).state == SUSPECT
+        assert router.registry.get(healthy.url).state != SUSPECT
+    finally:
+        router.stop()
+        dying.stop()
+        healthy.stop()
+
+
+def test_router_admission_queue_absorbs_burst():
+    """A saturation burst against a capacity-1 replica: without the
+    admission queue (and no proxy retries) some requests eat 503s; with
+    it, arrivals wait their turn and 0 requests are dropped."""
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    def burst(port, n=6):
+        codes = [None] * n
+
+        def worker(i):
+            codes[i] = _put(f"http://127.0.0.1:{port}/api",
+                            {"prompts": ["b"], "tokens_to_generate": 1})[0]
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return codes
+
+    rep = _FakeStreamReplica(mode="slow_ok", delay=0.15)
+    baseline = RouterServer([rep.url], poll_interval=30.0, max_retries=0)
+    try:
+        port = baseline.start_background()
+        codes = burst(port)
+        assert 503 in codes, "burst too small to saturate the baseline"
+    finally:
+        baseline.stop()
+        rep.stop()
+
+    rep = _FakeStreamReplica(mode="slow_ok", delay=0.15)
+    gated = RouterServer([rep.url], poll_interval=30.0, max_retries=0,
+                         admission_depth=16, admission_limit=1,
+                         admission_timeout_s=30.0)
+    try:
+        port = gated.start_background()
+        codes = burst(port)
+        assert codes == [200] * len(codes), (
+            f"admission queue dropped requests: {codes}")
+        # the handler releases AFTER writing the body — give the last
+        # server thread a beat to reach its finally block
+        deadline = time.monotonic() + 5
+        while (gated.admission.stats()["inflight"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        stats = gated.admission.stats()
+        assert stats["overflows"] == 0 and stats["inflight"] == 0
+    finally:
+        gated.stop()
+        rep.stop()
+
+
+def test_elastic_registration_lifecycle():
+    """A router started with zero static replicas admits a registering
+    replica (immediately routable), expires it through the breaker when
+    it dies, and re-admits its restart on a new port."""
+    from megatron_llm_tpu.serving.router import EJECTED, HEALTHY
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    router = RouterServer([], allow_registration=True, poll_interval=30.0,
+                          eject_after=2)
+    rep = _FakeStreamReplica(mode="ok")
+    try:
+        port = router.start_background()
+        base = f"http://127.0.0.1:{port}"
+        code, body = _put(base + "/api",
+                          {"prompts": ["x"], "tokens_to_generate": 1})
+        assert code == 503, "an empty elastic fleet sheds, it can't route"
+
+        def register(url):
+            req = urllib.request.Request(
+                base + "/admin/register",
+                data=json.dumps({"replica": url}).encode(), method="POST")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        ack = register(rep.url)
+        assert ack["added"] is True and ack["state"] == HEALTHY
+        # registration polled synchronously: routable with no extra wait
+        code, body = _put(base + "/api",
+                          {"prompts": ["x"], "tokens_to_generate": 1})
+        assert code == 200 and body["served_by"] == rep.url
+        assert register(rep.url)["added"] is False  # heartbeat no-op
+        # /health marks the replica as discovered, not statically configured
+        with urllib.request.urlopen(base + "/health", timeout=10) as resp:
+            rows = json.loads(resp.read())["replicas"]
+        assert [r["registered"] for r in rows] == [True]
+
+        # kill it; drive the breaker the way the poll loop would
+        dead_url = rep.url
+        rep.stop()
+        replica = router.registry.get(dead_url)
+        for _ in range(2):
+            router.poller.poll_once(replica)
+        assert replica.state == EJECTED
+
+        # restart on a new port: a fresh registration re-enters the fleet
+        rep = _FakeStreamReplica(mode="ok")
+        assert register(rep.url)["added"] is True
+        code, body = _put(base + "/api",
+                          {"prompts": ["x"], "tokens_to_generate": 1})
+        assert code == 200 and body["served_by"] == rep.url
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_register_endpoint_403_when_registration_disabled():
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    rep = _FakeStreamReplica(mode="ok")
+    router = RouterServer([rep.url], poll_interval=30.0)
+    try:
+        port = router.start_background()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/register",
+            data=json.dumps({"replica": "http://127.0.0.1:1"}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 403
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_run_router_allows_empty_fleet_only_with_registration(monkeypatch):
+    """tools/run_router.py: --allow_registration lifts the static-replica
+    requirement (argparse-level contract, no sockets)."""
+    import tools.run_router as rr
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    with pytest.raises(SystemExit):
+        rr.main(["--policy", "least_loaded"])  # still required without it
+
+    seen = {}
+
+    def fake_bind(self, host, port):
+        seen["registration"] = self.allow_registration
+        seen["admission"] = self.admission
+        return 0
+
+    monkeypatch.setattr(RouterServer, "bind", fake_bind)
+    monkeypatch.setattr(RouterServer, "serve", lambda self: None)
+    rr.main(["--allow_registration", "--admission_queue_depth", "8",
+             "--port", "0"])
+    assert seen["registration"] is True
+    assert seen["admission"] is not None and seen["admission"].depth == 8

@@ -80,13 +80,31 @@ def main(argv=None):
     ap.add_argument("--slo_margin", type=float, default=0.8,
                     help="slo_aware: fraction of the TTFT deadline the "
                          "predicted wait must fit in")
+    ap.add_argument("--allow_registration", action="store_true",
+                    help="accept POST /admin/register heartbeats from "
+                         "replicas started with --register_url; the "
+                         "fleet may then start empty and grow "
+                         "elastically")
+    ap.add_argument("--admission_queue_depth", type=int, default=0,
+                    help="bounded router-level admission queue: requests "
+                         "beyond the in-flight limit wait FIFO (up to "
+                         "this many) instead of eating replica 503s; "
+                         "0 disables the queue")
+    ap.add_argument("--admission_limit", type=int, default=0,
+                    help="concurrent in-flight forwards before arrivals "
+                         "queue; 0 = auto (summed max_slots of the "
+                         "routable fleet, recomputed as it changes)")
+    ap.add_argument("--admission_timeout", type=float, default=10.0,
+                    help="max seconds one request waits for admission "
+                         "(capped further by its own ttft_deadline_ms)")
     args = ap.parse_args(argv)
 
     urls = list(args.replica)
     if args.replicas:
         urls += [u.strip() for u in args.replicas.split(",") if u.strip()]
-    if not urls:
-        ap.error("at least one --replica url is required")
+    if not urls and not args.allow_registration:
+        ap.error("at least one --replica url is required "
+                 "(or pass --allow_registration for an elastic fleet)")
 
     policy_kwargs = {}
     if args.policy == "prefix_affinity":
@@ -101,10 +119,15 @@ def main(argv=None):
         max_staleness_s=args.max_staleness,
         suspect_after=args.suspect_after, eject_after=args.eject_after,
         forward_timeout_s=args.forward_timeout,
-        max_retries=args.max_retries)
+        max_retries=args.max_retries,
+        allow_registration=args.allow_registration,
+        admission_depth=args.admission_queue_depth,
+        admission_limit=args.admission_limit,
+        admission_timeout_s=args.admission_timeout)
     # bind BEFORE printing so --port 0 reports the real ephemeral port
     port = router.bind(args.host, args.port)
-    print(f"routing (policy={args.policy}, {len(urls)} replicas) on "
+    print(f"routing (policy={args.policy}, {len(urls)} replicas"
+          f"{', registration open' if args.allow_registration else ''}) on "
           f"http://{args.host}:{port}/api", flush=True)
     try:
         router.serve()

@@ -59,6 +59,16 @@ def main():
     ap.add_argument("--legacy_engine", action="store_true",
                     help="serve the dense single-stream InferenceEngine "
                          "instead of the continuous-batching engine")
+    ap.add_argument("--register_url",
+                    help="router base url to heartbeat POST "
+                         "/admin/register at (elastic discovery: the "
+                         "router needs --allow_registration; no static "
+                         "--replica entry required)")
+    ap.add_argument("--register_interval", type=float, default=2.0,
+                    help="seconds between registration heartbeats")
+    ap.add_argument("--advertise_url",
+                    help="url the router should reach this replica at "
+                         "(default http://127.0.0.1:<bound port>)")
     args, extra = ap.parse_known_args()
 
     import jax
@@ -116,7 +126,9 @@ def main():
             set_global_mesh(mesh)
             print(f"engine mesh: {dict(mesh.shape)}", flush=True)
         engine = ContinuousBatchingEngine(cfg, params, tokenizer, mesh=mesh)
-    server = MegatronServer(engine)
+    server = MegatronServer(engine, register_url=args.register_url,
+                            register_interval_s=args.register_interval,
+                            advertise_url=args.advertise_url)
     kind = "legacy" if args.legacy_engine else "continuous-batching"
     if not args.legacy_engine:
         kind += f", sched={engine.policy.name}"

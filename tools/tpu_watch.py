@@ -209,6 +209,14 @@ JOBS = [
     ("bench_decode_pipeline",
      [sys.executable, "bench_decode.py", "--mode", "pipeline"],
      False, _bench_on_tpu),
+    # ISSUE 18: streaming serving tier — client-observed TTFT streamed vs
+    # buffered through a 2-replica fleet + router (stamp-honesty gate on
+    # X-MLT-TTFT-S), plus the router admission-queue burst arm: baseline
+    # 503s vs zero drops with the bounded FIFO (bench_decode.py --mode
+    # streaming, engine_decode_streaming evidence)
+    ("bench_decode_streaming",
+     [sys.executable, "bench_decode.py", "--mode", "streaming"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
