@@ -86,6 +86,18 @@ Two modes:
   prefix_affinity beats round_robin on BOTH fleet hit rate and mean TTFT,
   and the failover drops nothing.
 
+* ``--mode disagg`` (ISSUE 19): disaggregated prefill/decode serving — a
+  mixed workload (saturated short-prompt decode class + long-prompt
+  prefill class) through a unified 2-replica fleet vs a 1-prefill +
+  1-decode split at equal chip count, both behind the ``disagg`` router
+  policy.  Long prompts in the split arm take the
+  prefill→KV-push→decode path (serving/handoff/); the decode replica's
+  tick stream then stays pure decode.  Rows report per-class TTFT,
+  decode-class TPOT, and client latency for both arms; the in-bench
+  identity assert pins every text byte-equal across arms.  Headline:
+  decode-class p99 TPOT speedup, split over unified.  Gate: > 1x with
+  zero handoff failures.
+
 Same tunnel-hardening contract as bench.py: backend probed in a bounded
 subprocess; off-TPU the headline is 0 with the run riding under
 ``cpu_sanity`` (a CPU timing is not a TPU measurement); TPU measurements
@@ -120,6 +132,7 @@ METRIC_ROUTER = "router_prefix_affinity_ttft_speedup_llama470m_2rep_1chip"
 METRIC_MIXED = "engine_ragged_launch_reduction_llama470m_mixed_1chip"
 METRIC_PIPELINE = "engine_pipeline_decode_speedup_llama470m_c8_1chip"
 METRIC_STREAMING = "serving_stream_first_token_speedup_llama470m_c8_2rep_1chip"
+METRIC_DISAGG = "serving_disagg_decode_p99_tpot_speedup_llama470m_2rep_1chip"
 
 # every mode decodes greedily with termination disabled: runs are
 # workload-shaped, never content-shaped
@@ -1199,6 +1212,203 @@ def bench_streaming(cfg, params, n_replicas: int, concurrency: int,
     }
 
 
+def bench_disagg(cfg, params, prompt_short: int, gen_short: int,
+                 prompt_long: int, gen_long: int, n_short: int,
+                 n_long: int, short_reqs: int, long_reqs: int, vocab: int,
+                 slots: int, long_prompt_chars: int) -> dict:
+    """Disaggregated prefill/decode (ISSUE 19, serving/handoff/): a mixed
+    workload — a saturated short-prompt decode class + a long-prompt
+    prefill class — through two fleets at EQUAL chip count:
+
+    * **unified**: 2 unified replicas behind the ``disagg`` router
+      (role-less fleet, so the policy degrades to least_loaded — the
+      pre-disagg baseline).  Long prefill chunks share each replica's
+      tick stream with the decode batch, so every long arrival stretches
+      the decode class's inter-token times.
+    * **split**: 1 prefill-role + 1 decode-role replica behind the same
+      router.  Long prompts go prefill→KV push→decode; the decode
+      replica sees them trie-hot (prefill collapses to the refeed
+      token), so its tick stream stays pure decode.
+
+    Per class: client latency, server-stamped TTFT, and decode-class
+    TPOT ((latency - ttft) / (gen - 1)) from each replica's own flight
+    timing.  The in-bench identity assert pins every request's text
+    byte-equal across arms — the handoff is lossless, not approximate.
+    Headline: decode-class p99 TPOT speedup, split over unified.
+    Gate: > 1x (decode isolation must actually protect the decode
+    class) with all texts identical and every long split request
+    actually handed off."""
+    import random
+    import string
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from megatron_llm_tpu.generation.server import MegatronServer
+    from megatron_llm_tpu.serving.router.server import RouterServer
+
+    rng = random.Random(11)
+    letters = string.ascii_letters + string.digits
+
+    def text(n):
+        return "".join(rng.choice(letters) for _ in range(n))
+
+    # distinct prompts everywhere: prefix-cache hits would let the
+    # unified arm skip prefill work the split arm is designed to absorb
+    shorts = [[text(prompt_short) for _ in range(short_reqs)]
+              for _ in range(n_short)]
+    longs = [[text(prompt_long) for _ in range(long_reqs)]
+             for _ in range(n_long)]
+
+    ps = cfg.inference.page_size
+    pages_per_seq = -(-(prompt_long + max(gen_short, gen_long) + 1) // ps)
+    pool_pages = (slots + n_long * long_reqs + 2) * (pages_per_seq + 1) + 16
+    max_seq = prompt_long + max(gen_short, gen_long) + 1
+
+    def put(base_url: str, prompt: str, gen: int):
+        req = urllib.request.Request(
+            base_url + "/api",
+            data=json.dumps({"prompts": [prompt],
+                             "tokens_to_generate": gen,
+                             "top_k": 1, "random_seed": 5}).encode(),
+            headers={"Content-Type": "application/json"}, method="PUT")
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            body = json.loads(resp.read())
+            code = resp.status
+        wall = time.perf_counter() - t0
+        assert code == 200, f"request failed: {code} {body}"
+        t = body.get("timing") or {}
+        return {"text": body["text"][0], "wall_s": wall,
+                "ttft_s": t.get("ttft_s"), "latency_s": t.get("latency_s")}
+
+    def spawn_fleet(roles):
+        servers, urls = [], []
+        for role in roles:
+            eng = make_engine(cfg, params, tokenizer=_CharTok(vocab),
+                              max_slots=slots, num_pages=pool_pages,
+                              max_seq=max_seq)
+            srv = MegatronServer(eng, role=role)
+            port = srv.start_background(port=0)
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{port}")
+        router = RouterServer(
+            urls, policy="disagg",
+            policy_kwargs={"long_prompt_chars": long_prompt_chars},
+            poll_interval=0.25, forward_timeout_s=600.0)
+        rport = router.start_background()
+        return servers, router, f"http://127.0.0.1:{rport}"
+
+    def run_arm(roles) -> dict:
+        servers, router, base = spawn_fleet(roles)
+        try:
+            # warm both request shapes (compiles ride the first ones)
+            t0 = time.perf_counter()
+            put(base, text(prompt_short), gen_short)
+            put(base, text(prompt_long), gen_long)
+            compile_s = time.perf_counter() - t0
+
+            def short_client(i):
+                return [put(base, p, gen_short) for p in shorts[i]]
+
+            def long_client(i):
+                return [put(base, p, gen_long) for p in longs[i]]
+
+            with ThreadPoolExecutor(max_workers=n_short + n_long) as ex:
+                sf = [ex.submit(short_client, i) for i in range(n_short)]
+                lf = [ex.submit(long_client, i) for i in range(n_long)]
+                srows = [r for f in sf for r in f.result()]
+                lrows = [r for f in lf for r in f.result()]
+            handoffs = router._handoffs.value
+            handoff_failures = router._handoff_failures.value
+        finally:
+            router.stop()
+            for srv in servers:
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+
+        def klass(rows, gen):
+            ttfts = [r["ttft_s"] for r in rows if r["ttft_s"] is not None]
+            tpots = [(r["latency_s"] - r["ttft_s"]) / max(gen - 1, 1)
+                     for r in rows
+                     if r["ttft_s"] is not None
+                     and r["latency_s"] is not None]
+            walls = [r["wall_s"] for r in rows]
+            return {
+                "requests": len(rows),
+                "ttft_mean_ms": round(1e3 * sum(ttfts)
+                                      / max(len(ttfts), 1), 2),
+                "ttft_p99_ms": round(1e3 * _percentile(ttfts, 99), 2),
+                "tpot_mean_ms": round(1e3 * sum(tpots)
+                                      / max(len(tpots), 1), 3),
+                "tpot_p99_ms": round(1e3 * _percentile(tpots, 99), 3),
+                "client_latency_mean_ms": round(
+                    1e3 * sum(walls) / max(len(walls), 1), 2),
+                "_tpots": tpots,
+            }
+
+        return {
+            "arm": "+".join(roles),
+            "short": klass(srows, gen_short),
+            "long": klass(lrows, gen_long),
+            "handoffs": handoffs,
+            "handoff_failures": handoff_failures,
+            "compile_time_s": compile_s,
+            "_texts": ([r["text"] for r in srows]
+                       + [r["text"] for r in lrows]),
+        }
+
+    unified = run_arm(("unified", "unified"))
+    split = run_arm(("prefill", "decode"))
+
+    # losslessness: the handoff path must not change a single token
+    assert unified["_texts"] == split["_texts"], (
+        "disagg texts diverged from the unified fleet")
+    # the split arm must actually have migrated every long request
+    n_long_total = n_long * long_reqs + 1  # + the long warm-up request
+    assert split["handoffs"] >= n_long_total, (
+        f"only {split['handoffs']} handoffs for {n_long_total} long "
+        f"requests")
+    assert unified["handoffs"] == 0, "role-less fleet must never hand off"
+
+    u99 = unified["short"]["tpot_p99_ms"]
+    s99 = split["short"]["tpot_p99_ms"]
+    tpot_speedup = u99 / max(s99, 1e-9)
+    rows = []
+    for arm in (unified, split):
+        for klass_name in ("short", "long"):
+            k = dict(arm[klass_name])
+            k.pop("_tpots", None)
+            rows.append({"arm": arm["arm"], "class": klass_name, **k})
+    return {
+        "n_replicas": 2,
+        "slots": slots,
+        "prompt_short": prompt_short, "gen_short": gen_short,
+        "prompt_long": prompt_long, "gen_long": gen_long,
+        "n_short": n_short, "n_long": n_long,
+        "short_reqs": short_reqs, "long_reqs": long_reqs,
+        "long_prompt_chars": long_prompt_chars,
+        "decode_tpot_p99_speedup": round(tpot_speedup, 3),
+        "decode_tpot_mean_speedup": round(
+            unified["short"]["tpot_mean_ms"]
+            / max(split["short"]["tpot_mean_ms"], 1e-9), 3),
+        "long_ttft_mean_ms": {
+            "unified": unified["long"]["ttft_mean_ms"],
+            "split": split["long"]["ttft_mean_ms"]},
+        "handoffs": split["handoffs"],
+        "handoff_failures": split["handoff_failures"],
+        "identity_ok": True,  # asserted above
+        "disagg_ok": (tpot_speedup > 1.0
+                      and split["handoff_failures"] == 0),
+        "compile_time_s": round(unified["compile_time_s"]
+                                + split["compile_time_s"], 1),
+        "step_time_s": round(
+            split["short"]["tpot_mean_ms"] / 1e3, 6),
+        "rows": rows,
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
@@ -1210,6 +1420,7 @@ def _run(args, finished):
     cap_mode = args.mode == "capacity"
     pipe_mode = args.mode == "pipeline"
     stream_mode = args.mode == "streaming"
+    disagg_mode = args.mode == "disagg"
     pipe_depths = (0, 1, 2, 8)
     burst = 12  # admission-arm clients (streaming mode section 2)
     draft_layers = 2
@@ -1223,6 +1434,12 @@ def _run(args, finished):
     # prompts) measures the hit-rate dividend at the same bytes
     cap = dict(n_requests=32, ref_slots=8, groups=8, per_group=4,
                shared=256, tail=32, gen_cache=32)
+    # disagg-mode workload shape (ISSUE 19): a saturated short-prompt
+    # decode class + a long-prompt prefill class, unified fleet vs
+    # 1-prefill + 1-decode split at equal chip count
+    dg = dict(slots=8, n_short=6, n_long=4, short_reqs=4, long_reqs=2,
+              prompt_short=64, gen_short=64, prompt_long=1536, gen_long=32,
+              long_chars=512)
     if probe_backend(args.probe_timeout) == "cpu":
         from megatron_llm_tpu.utils.platform import pin_cpu_platform
 
@@ -1278,6 +1495,16 @@ def _run(args, finished):
             # the int8 one — both gates are real capacity measurements
             cap = dict(n_requests=12, ref_slots=3, groups=4, per_group=4,
                        shared=64, tail=8, gen_cache=8)
+        if disagg_mode:
+            # the short class OVER-saturates the fleet (8 clients on 4
+            # slots/replica) so the per-tick decode batch is identical in
+            # both arms — queueing lands in TTFT, never TPOT — and the
+            # TPOT comparison isolates tick COMPOSITION: 512-token
+            # prefill chunks sharing the decode ticks (unified) vs pure
+            # decode ticks behind the handoff (split)
+            dg = dict(slots=4, n_short=8, n_long=3, short_reqs=3,
+                      long_reqs=2, prompt_short=24, gen_short=24,
+                      prompt_long=512, gen_long=8, long_chars=128)
 
     import jax
 
@@ -1289,7 +1516,9 @@ def _run(args, finished):
                    args.prompt + args.gen_lo,
                    mx["prompt_long"] + mx["gen_short"],
                    8 + mx["gen_long"],
-                   cap["shared"] + cap["tail"] + cap["gen_cache"])
+                   cap["shared"] + cap["tail"] + cap["gen_cache"],
+                   dg["prompt_long"] + max(dg["gen_short"],
+                                           dg["gen_long"]) + 1)
     cfg = make_config(
         "llama2", num_layers=layers, hidden_size=hidden,
         num_attention_heads=heads, num_attention_heads_kv=heads,
@@ -1308,6 +1537,12 @@ def _run(args, finished):
             row = bench_streaming(cfg, params, args.replicas, levels[-1],
                                   args.prompt, args.gen, vocab, args.slots,
                                   burst)
+        elif disagg_mode:
+            row = bench_disagg(cfg, params, dg["prompt_short"],
+                               dg["gen_short"], dg["prompt_long"],
+                               dg["gen_long"], dg["n_short"], dg["n_long"],
+                               dg["short_reqs"], dg["long_reqs"], vocab,
+                               dg["slots"], dg["long_chars"])
         elif router_mode:
             row = bench_router(cfg, params, args.replicas, args.groups,
                                args.per_group, args.shared, args.tail,
@@ -1388,6 +1623,31 @@ def _run(args, finished):
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
         }
         tag = "engine_decode_streaming"
+    elif disagg_mode:
+        result = {
+            "metric": METRIC_DISAGG,
+            "value": row["decode_tpot_p99_speedup"],
+            "unit": "x",
+            "decode_tpot_p99_speedup": row["decode_tpot_p99_speedup"],
+            "decode_tpot_mean_speedup": row["decode_tpot_mean_speedup"],
+            "disagg_ok": row["disagg_ok"],
+            "identity_ok": row["identity_ok"],
+            "handoffs": row["handoffs"],
+            "handoff_failures": row["handoff_failures"],
+            "long_ttft_mean_ms": row["long_ttft_mean_ms"],
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in
+                         ("n_replicas", "slots", "prompt_short",
+                          "gen_short", "prompt_long", "gen_long",
+                          "n_short", "n_long", "short_reqs", "long_reqs",
+                          "long_prompt_chars")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_disagg"
     elif router_mode:
         result = {
             "metric": METRIC_ROUTER,
@@ -1552,7 +1812,7 @@ def main():
     ap.add_argument("--mode",
                     choices=("occupancy", "shared_prefix", "slo", "spec",
                              "router", "mixed", "capacity", "pipeline",
-                             "streaming"),
+                             "streaming", "disagg"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
@@ -1594,10 +1854,11 @@ def main():
               "spec": METRIC_SPEC, "router": METRIC_ROUTER,
               "mixed": METRIC_MIXED, "pipeline": METRIC_PIPELINE,
               "capacity": METRIC_CAPACITY,
-              "streaming": METRIC_STREAMING}.get(args.mode, METRIC)
+              "streaming": METRIC_STREAMING,
+              "disagg": METRIC_DISAGG}.get(args.mode, METRIC)
     unit = ("x" if args.mode in ("shared_prefix", "slo", "spec", "router",
                                  "mixed", "capacity", "pipeline",
-                                 "streaming")
+                                 "streaming", "disagg")
             else "tok/s")
     finished = threading.Event()
 

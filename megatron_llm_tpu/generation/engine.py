@@ -345,6 +345,84 @@ class PagedKVPool:
             if self.refcounts[p] == 0 and p not in self.cached:
                 self._free.append(p)
 
+    # ---- cross-replica page transfer (ISSUE 19, serving/handoff/) ----
+
+    def _leaf_items(self) -> List[Tuple[str, object]]:
+        """(wire name, device array) pairs of every storage leaf, in
+        wire order: plain pools contribute one leaf per cache, quantized
+        pools their value bytes AND per-page scale rows, draft caches
+        (speculation) ride along under their own names — exactly the
+        set a receiving pool must install for a migrated page to be
+        bit-identical to a locally prefilled one."""
+        items: List[Tuple[str, object]] = []
+        for name, pool in (("k", self.k), ("v", self.v),
+                           ("draft_k", self.draft_k),
+                           ("draft_v", self.draft_v)):
+            if pool is None:
+                continue
+            if kv_quant.is_quantized(pool):
+                items.append((name + ".q", pool.q))
+                items.append((name + ".scale", pool.scale))
+            else:
+                items.append((name, pool))
+        return items
+
+    def export_pages(self, pages: Sequence[int]) -> Dict[str, np.ndarray]:
+        """Gather ``pages`` from every storage leaf to the host: ONE
+        batched ``device_get`` over all leaves (k/v values, scale rows,
+        draft caches), so a multi-page export pays one transfer sync.
+        The caller must hold page refs on ``pages`` and serialize
+        against tick dispatch (the engine's ``_drive_lock``) — ticks
+        rebind the pool arrays with donated buffers."""
+        ids = np.asarray(list(pages), np.int32)
+        names, gathers = [], []
+        for name, arr in self._leaf_items():
+            names.append(name)
+            gathers.append(arr[:, ids])
+        host = jax.device_get(gathers)
+        return dict(zip(names, host))
+
+    def import_pages(self, pages: Sequence[int],
+                     leaves: Dict[str, np.ndarray]) -> None:
+        """Install exported leaf bytes into freshly allocated ``pages``
+        VERBATIM — quantized leaves set ``q`` and ``scale`` directly,
+        never re-quantizing, so the imported page is byte-identical to
+        the sender's (tests/test_handoff.py round-trip).  Leaf names,
+        dtypes and shapes must match this pool exactly (a bf16 pool
+        cannot install an int8 export; a speculating sender's draft
+        leaves need a speculating receiver).  Caller serializes against
+        tick dispatch, same as :meth:`export_pages`."""
+        ids = np.asarray(list(pages), np.int32)
+        mine = dict(self._leaf_items())
+        if sorted(mine) != sorted(leaves):
+            raise ValueError(
+                f"handoff leaves {sorted(leaves)} do not match this "
+                f"pool's storage leaves {sorted(mine)} "
+                f"(kv_dtype={self.kv_dtype!r}, "
+                f"draft={'yes' if self.draft_k is not None else 'no'})")
+        for name, arr in mine.items():
+            val = leaves[name]
+            want_shape = arr.shape[:1] + (len(ids),) + arr.shape[2:]
+            if tuple(val.shape) != want_shape or val.dtype != arr.dtype:
+                raise ValueError(
+                    f"handoff leaf {name!r} is {val.dtype}{val.shape}, "
+                    f"pool needs {arr.dtype}{want_shape}")
+
+        def _install(pool, name):
+            if kv_quant.is_quantized(pool):
+                return kv_quant.QuantPagedKV(
+                    q=pool.q.at[:, ids].set(
+                        jnp.asarray(leaves[name + ".q"])),
+                    scale=pool.scale.at[:, ids].set(
+                        jnp.asarray(leaves[name + ".scale"])))
+            return pool.at[:, ids].set(jnp.asarray(leaves[name]))
+
+        self.k = _install(self.k, "k")
+        self.v = _install(self.v, "v")
+        if self.draft_k is not None:
+            self.draft_k = _install(self.draft_k, "draft_k")
+            self.draft_v = _install(self.draft_v, "draft_v")
+
 
 class _TrieNode:
     __slots__ = ("key", "page", "parent", "children", "last_use")
@@ -471,6 +549,11 @@ class EngineRequest:
     # caller minted; correlates this request across router spans,
     # replica spans and flight records ("" = untraced direct submit)
     trace_id: str = ""
+    # disaggregated serving (ISSUE 19): stop after chunked prefill and
+    # park in the `handoff` phase with page refs held — the export path
+    # (prefill_and_export) ships the pages and retires the request; the
+    # request never takes a decode tick
+    prefill_only: bool = False
 
     # engine-filled state
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -953,6 +1036,22 @@ class ContinuousBatchingEngine:
                   help="configured chained-ticks-per-launch depth "
                        "(--tick_pipeline_depth; 0 = unpipelined)"
                   ).set(self.pipeline_depth)
+        # cross-replica KV handoff (ISSUE 19, serving/handoff/): pages
+        # and wire bytes this engine exported (prefill role) / imported
+        # (decode role, /admin/kv_push)
+        self._m_kv_export_pages = reg.counter(
+            "mlt_engine_kv_export_pages_total",
+            help="KV pool pages exported for cross-replica handoff")
+        self._m_kv_export_bytes = reg.counter(
+            "mlt_engine_kv_export_bytes_total",
+            help="wire bytes of exported KV handoff blobs")
+        self._m_kv_import_pages = reg.counter(
+            "mlt_engine_kv_import_pages_total",
+            help="KV pool pages installed from pushed handoff blobs "
+                 "(deduped pages excluded)")
+        self._m_kv_import_bytes = reg.counter(
+            "mlt_engine_kv_import_bytes_total",
+            help="wire bytes of imported KV handoff blobs")
         # speculative-decoding instruments, registered only when the spec
         # path can run (mlt_engine_spec_* stays absent from scrapes of
         # non-speculating engines)
@@ -1775,8 +1874,9 @@ class ContinuousBatchingEngine:
                 if obs_registry.publishing():
                     self._m_cow.inc()
             if req._fill_pos >= len(req.seq_tokens):
-                # fully served from cache: straight to decode
-                self._activate(req, req._slot)
+                # fully served from cache: straight to decode (or, for
+                # a prefill_only request, straight to handoff)
+                self._activate_or_handoff(req, req._slot)
             else:
                 req._phase = "prefill"
                 self._prefill_q.append(req)
@@ -1827,7 +1927,7 @@ class ContinuousBatchingEngine:
             if obs_registry.publishing():
                 self._m_miss_tokens.inc(prompt_len)
                 self._m_prefill_tokens.inc(s_pre)
-            self._activate(req, req._slot)
+            self._activate_or_handoff(req, req._slot)
 
     # ---- shared lifecycle tail ----
 
@@ -1858,6 +1958,60 @@ class ContinuousBatchingEngine:
         req._phase = "decode"
         req._flight.set_phase("decode", pos=len(seq) - 1)
         self._dirty = True
+
+    def _activate_or_handoff(self, req: EngineRequest,
+                             slot: int) -> None:  # holds _lock
+        """Prefill-completion dispatch: normal requests activate into
+        decode; ``prefill_only`` requests (disaggregated serving, ISSUE
+        19) park for export instead — they never take a decode tick."""
+        if req.prefill_only:
+            self._handoff_ready_locked(req, slot)
+        else:
+            self._activate(req, slot)
+
+    def _handoff_ready_locked(self, req: EngineRequest,
+                              slot: int) -> None:  # holds _lock
+        """Prefill finished for a ``prefill_only`` request: free the
+        slot (the scheduler is done with it), KEEP the page refs (the
+        export must read stable bytes), return the never-needed decode
+        commitment, and wake the exporter waiting on ``_done``.  The
+        flight record enters the ``handoff`` phase bucket here, so the
+        migrated request's latency decomposition still provably sums
+        (PR 12 invariant across the hop)."""
+        self._slots[slot] = None
+        self._block_tables[slot] = NULL_PAGE
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+        self._top_k[slot] = 1
+        self._top_p[slot] = 0.0
+        self._temperature[slot] = 1.0
+        self._dirty = True
+        # a handoff request never decodes: its worst-case decode-page
+        # commitment returns to the ledger now
+        self._committed -= max(0, req._max_pages - len(req._pages))
+        req._max_pages = len(req._pages)
+        req._slot = -1
+        req._phase = "handoff"
+        req._flight.set_phase("handoff", pages=len(req._pages))
+        req._done.set()
+
+    def _finish_handoff_locked(self, req: EngineRequest,
+                               **args) -> None:  # holds _lock
+        """Retire a handoff-phase request after (attempted) export:
+        release every held page — trie-registered prompt pages go
+        cached-idle, exactly like a preemption park, so a later local
+        request (or a second export) still hits them."""
+        if req._phase != "handoff":
+            return  # failed/shed earlier; _fail/_shed already cleaned up
+        pages, req._pages = req._pages, []
+        self._committed -= max(0, req._max_pages - len(pages))
+        self.pool.release(pages)
+        req._phase = "finished"
+        req.finished = True
+        req._t_done = time.monotonic()
+        req._flight.finish("handoff", **args)
+        self.flight.close(req._flight)
+        req._done.set()
 
     def _fail(self, req: EngineRequest, e: Exception) -> None:
         with self._lock:
@@ -2188,7 +2342,7 @@ class ContinuousBatchingEngine:
                     # immutable from birth
                     self.cache.insert(seq, req._pages,
                                       (prompt_len - 1) // ps)
-                self._activate(req, req._slot)
+                self._activate_or_handoff(req, req._slot)
         return True
 
     # -- the tick ----------------------------------------------------------
@@ -2812,7 +2966,7 @@ class ContinuousBatchingEngine:
                 if self.cache is not None:
                     self.cache.insert(seq, req._pages,
                                       (len(seq) - 1) // ps)
-                self._activate(req, req._slot)
+                self._activate_or_handoff(req, req._slot)
 
     def _step_ragged(self) -> int:
         """One fused ragged tick: decode slots + verify blocks + packed
@@ -3116,6 +3270,179 @@ class ContinuousBatchingEngine:
         else:
             log_probs = None
         return texts, segments, log_probs
+
+    # -- cross-replica KV handoff (ISSUE 19, serving/handoff/) -------------
+
+    def prefill_and_export(self, prompt, *, add_BOS: bool = False,
+                           trace_id: str = "", timeout_s: float = 600.0):
+        """Prefill ``prompt`` (str — tokenized exactly like
+        ``generate_and_post_process`` — or token ids) WITHOUT decoding,
+        and export its full KV pages as a handoff wire blob.
+
+        The request runs the normal admission/chunked-prefill path
+        (trie hits included) but parks in the ``handoff`` phase instead
+        of activating into decode; the export reads its pages under
+        ``_drive_lock`` (serialized against tick dispatch — ticks
+        donate the pool buffers) while the request's refs keep the
+        bytes stable, then retires it — prompt pages stay in the trie
+        cached-idle, so repeated long prompts skip recompute on the
+        prefill tier too.  Only FULL pages the refeed tick never writes
+        are exported (``(len(prompt) - 1) // page_size``, the exact
+        ``PrefixCache.insert`` rule), so the receiving trie can share
+        them as immutable from birth.
+
+        Returns ``(blob, info)`` — ``info`` has ``tokens`` / ``pages``
+        / ``bytes`` / ``hit_tokens`` for the migration receipt."""
+        from megatron_llm_tpu.serving.handoff import wire
+
+        tok = self.tokenizer
+        if isinstance(prompt, str):
+            bos = (getattr(tok, "bos_token_id", None)
+                   or getattr(tok, "bos", None))
+            ids = tok.tokenize(prompt)
+            if add_BOS:
+                ids = [bos if bos is not None else tok.eod] + ids
+        else:
+            ids = [int(t) for t in prompt]
+        req = self.submit(ids, 1, top_k=1, use_eod_for_termination=False,
+                          prefill_only=True, trace_id=trace_id)
+        if self._thread is None:
+            self.run_until_idle()
+        if not req._done.wait(timeout_s):
+            raise TimeoutError("handoff prefill did not finish in time")
+        if req.shed:
+            raise RequestShed(req.error or "request shed",
+                              retry_after=req.shed_retry_after)
+        if req.error:
+            raise RuntimeError(req.error)
+        ps = self.page_size
+        n = (len(ids) - 1) // ps
+        blob = None
+        pages: List[int] = []
+        try:
+            with self._drive_lock:
+                with self._lock:
+                    pages = list(req._pages[:n])
+                leaves = self.pool.export_pages(pages)
+            blob = wire.encode_pages(ids[: len(pages) * ps], ps,
+                                     self.kv_dtype, leaves)
+        finally:
+            with self._lock:
+                if blob is not None:
+                    req._flight.event("kv_export", pages=len(pages),
+                                      bytes=len(blob))
+                    if obs_registry.publishing():
+                        self._m_kv_export_pages.inc(len(pages))
+                        self._m_kv_export_bytes.inc(len(blob))
+                self._finish_handoff_locked(req, pages=len(pages))
+        return blob, {"tokens": len(pages) * ps, "pages": len(pages),
+                      "bytes": len(blob), "hit_tokens": req._hit_tokens}
+
+    def export_cached_kv(self, tokens, *, trace_id: str = ""):
+        """Export the longest trie-cached prefix of ``tokens`` (ids) as
+        a handoff blob — the migration path for state that is already
+        parked in the prefix cache (e.g. a preempted request's finished
+        pages).  Returns ``(blob, n_pages)``; ``n_pages`` may be 0 when
+        nothing is cached."""
+        from megatron_llm_tpu.serving.handoff import wire
+
+        if self.cache is None:
+            raise ValueError("prefix cache disabled; nothing to export")
+        tokens = [int(t) for t in tokens]
+        ps = self.page_size
+        with self._drive_lock:
+            with self._lock:
+                matched = self.cache.match(tokens, len(tokens) // ps)
+            try:
+                leaves = self.pool.export_pages(matched)
+            finally:
+                with self._lock:
+                    self.pool.release(matched)
+        blob = wire.encode_pages(tokens[: len(matched) * ps], ps,
+                                 self.kv_dtype, leaves)
+        if matched and obs_registry.publishing():
+            with self._lock:
+                self._m_kv_export_pages.inc(len(matched))
+                self._m_kv_export_bytes.inc(len(blob))
+        return blob, len(matched)
+
+    def import_kv(self, blob: bytes, *, trace_id: str = "") -> dict:
+        """Install a pushed handoff blob: decode the wire format,
+        allocate pages for the UNCACHED suffix (trie incumbents win —
+        dedup is free), upload the exact bytes, and register the pages
+        via ``PrefixCache.insert`` + release — they end cached-idle,
+        indistinguishable from a locally prefilled-then-parked prefix,
+        so COW/refcount/eviction invariants hold unchanged.  Raises
+        :class:`EngineOverloaded` (→ 503 + Retry-After) when the pool
+        cannot hold the pages.  Returns the import receipt."""
+        from megatron_llm_tpu.serving.handoff import wire
+
+        payload = wire.decode_pages(blob)
+        if self.cache is None:
+            raise ValueError("prefix cache disabled; cannot import KV pages")
+        if payload.page_size != self.page_size:
+            raise ValueError(
+                f"handoff page_size {payload.page_size} != engine "
+                f"page_size {self.page_size}")
+        if payload.kv_dtype != self.kv_dtype:
+            raise ValueError(
+                f"handoff kv_dtype {payload.kv_dtype!r} != engine "
+                f"kv_dtype {self.kv_dtype!r}")
+        n = payload.n_pages
+        rec = self.flight.open(trace_id, kind="kv_import", pages=n)
+        try:
+            if n == 0:
+                return {"pages": 0, "installed": 0, "deduped": 0,
+                        "tokens": 0}
+            with obs_trace.span("kv-import", pages=n, trace_id=trace_id):
+                with self._drive_lock:
+                    with self._lock:
+                        matched = self.cache.match(payload.tokens, n)
+                        covered = len(matched)
+                        fresh = (self.pool.alloc(n - covered)
+                                 if covered < n else [])
+                        if fresh is None:
+                            self.pool.release(matched)
+                            raise EngineOverloaded(
+                                f"KV pool cannot hold {n - covered} "
+                                f"pushed pages",
+                                retry_after=self._drain_eta(
+                                    len(self._queue)),
+                                info=self._overload_info())
+                    try:
+                        if fresh:
+                            # device upload outside _lock: the fresh
+                            # pages are refcount-1 and unshared, and
+                            # _drive_lock serializes vs tick dispatch
+                            self.pool.import_pages(fresh, {
+                                name: arr[:, covered:]
+                                for name, arr in payload.leaves.items()})
+                    except Exception:
+                        with self._lock:
+                            self.pool.release(matched)
+                            self.pool.release(fresh)
+                        raise
+                    with self._lock:
+                        installed = self.cache.insert(
+                            payload.tokens, matched + fresh, n)
+                        # inserted pages go cached-idle; duplicates
+                        # (trie incumbents won the position) go free
+                        self.pool.release(matched)
+                        self.pool.release(fresh)
+                        if obs_registry.publishing():
+                            self._m_kv_import_pages.inc(installed)
+                            self._m_kv_import_bytes.inc(len(blob))
+            receipt = {"pages": n, "installed": installed,
+                       "deduped": n - installed,
+                       "tokens": len(payload.tokens)}
+            rec.event("kv_import", bytes=len(blob), **receipt)
+            rec.finish("ok")
+            return receipt
+        except Exception as e:  # noqa: BLE001 — record then surface
+            rec.finish("error", error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self.flight.close(rec)
 
     def _legacy(self):
         """A dense-path InferenceEngine view over the SAME (already

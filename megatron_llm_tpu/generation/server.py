@@ -178,7 +178,8 @@ class MegatronServer:
 
     def __init__(self, engine, *, register_url: Optional[str] = None,
                  register_interval_s: float = 2.0,
-                 advertise_url: Optional[str] = None):
+                 advertise_url: Optional[str] = None,
+                 role: str = "unified"):
         # the lock-relevant type (the legacy InferenceEngine has no
         # locks): the annotation below lets graftcheck's lock-order
         # graph resolve `with eng._lock:` in health()/metrics_text()
@@ -207,6 +208,15 @@ class MegatronServer:
         self.advertise_url = advertise_url
         self._register_stop = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
+        # disaggregated prefill/decode (ISSUE 19, serving/handoff/): the
+        # advertised serving role.  Roles steer the router's ``disagg``
+        # policy; /api stays fully functional on every role (a role-less
+        # or mixed fleet degrades to unified serving), but a prefill-role
+        # replica refuses /admin/kv_push — it is a KV sender, not a sink.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode', got {role!r}")
+        self.role = role
 
     def handle_request(self, payload, trace_id: str = ""):
         """Core PUT /api logic; returns (status_code, response dict).
@@ -219,6 +229,10 @@ class MegatronServer:
         decomposition the router's honest TTFT metric reads."""
         if not isinstance(payload, dict):
             return 400, {"error": "request body must be a JSON object"}
+        if payload.get("handoff_to") is not None:
+            # disaggregated prefill (ISSUE 19): prefill + export + push
+            # instead of decoding; returns a migration receipt
+            return self._prefill_handoff(payload, trace_id=trace_id)
         params, err = _validate(payload)
         if err:
             return 400, {"error": err}
@@ -286,6 +300,102 @@ class MegatronServer:
 
                 traceback.print_exc()
                 return 500, {"error": f"internal error: {type(e).__name__}: {e}"}
+
+    def _prefill_handoff(self, payload: dict, trace_id: str = ""):
+        """Serve a ``"handoff_to": url`` request (ISSUE 19): run chunked
+        prefill locally, export the prompt's full KV pages and push them
+        to the decode replica at ``url``; the 200 answer is a migration
+        receipt, not a generation.  The router sends these for long
+        prompts (``disagg`` policy) and then forwards the original
+        request to the decode replica, which finds the pushed pages in
+        its prefix cache.  A failed push is a 502 so the router can fall
+        back to unified serving — the request is never half-served."""
+        from megatron_llm_tpu.serving.handoff.transfer import (
+            KVPushError, push_pages)
+
+        target = payload.get("handoff_to")
+        if not isinstance(target, str) or not target.strip():
+            return 400, {"error": "handoff_to must be a replica base URL"}
+        if not self.batching or not hasattr(self.engine, "prefill_and_export"):
+            return 400, {"error":
+                         "handoff requires the continuous-batching engine"}
+        params, err = _validate(
+            {k: v for k, v in payload.items() if k != "handoff_to"})
+        if err:
+            return 400, {"error": err}
+        if len(params["prompts"]) != 1:
+            return 400, {"error": "handoff requires exactly one prompt"}
+        if params["beam_width"] is not None:
+            return 400, {"error": "beam search cannot hand off"}
+        if params["logprobs"]:
+            # logprobs requests bypass the prefix trie on the decode
+            # side, so pushed pages could never be used — refuse rather
+            # than do the work for nothing
+            return 400, {"error": "handoff cannot serve logprobs requests"}
+        try:
+            blob, info = self.engine.prefill_and_export(
+                params["prompts"][0], add_BOS=params["add_BOS"],
+                trace_id=trace_id)
+        except EngineOverloaded as eo:
+            return 503, {"error": str(eo),
+                         "retry_after": getattr(eo, "retry_after", 1.0),
+                         **getattr(eo, "info", {})}
+        except RequestShed as rs:
+            return 503, {"error": str(rs), "shed": True,
+                         "retry_after": getattr(rs, "retry_after", 1.0)}
+        except (ValueError, AssertionError) as ve:
+            return 400, {"error": str(ve.args[0] if ve.args else ve)}
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            return 500, {"error": f"internal error: {type(e).__name__}: {e}"}
+        receipt = {"target": target, "pages": info["pages"],
+                   "bytes": info["bytes"], "tokens": info["tokens"],
+                   "hit_tokens": info["hit_tokens"],
+                   "replica_id": self.replica_id, "pushed": False}
+        if info["pages"] == 0:
+            # prompt shorter than one full page: nothing worth shipping
+            return 200, {"handoff": receipt}
+        try:
+            receipt["receipt"] = push_pages(target, blob, trace_id=trace_id)
+        except KVPushError as ke:
+            body = {"error": str(ke), "handoff_failed": True}
+            if ke.retry_after is not None:
+                body["retry_after"] = ke.retry_after
+            return 502, body
+        receipt["pushed"] = True
+        return 200, {"handoff": receipt}
+
+    def kv_push(self, blob: bytes, trace_id: str = ""):
+        """Core ``POST /admin/kv_push`` logic: install a handoff blob
+        into this replica's pool/prefix cache (engine.import_kv) and
+        answer with the import receipt.  Pool pressure is a structured
+        503 + retry hint (the sender degrades to unified serving), a
+        malformed or incompatible blob is a 400."""
+        if self.role == "prefill":
+            return 400, {"error":
+                         "prefill-role replica does not accept KV pushes"}
+        if not self.batching or not hasattr(self.engine, "import_kv"):
+            return 400, {"error":
+                         "kv_push requires the continuous-batching engine"}
+        if not blob:
+            return 400, {"error": "empty kv_push body"}
+        try:
+            receipt = self.engine.import_kv(blob, trace_id=trace_id)
+        except EngineOverloaded as eo:
+            return 503, {"error": str(eo),
+                         "retry_after": getattr(eo, "retry_after", 1.0),
+                         **getattr(eo, "info", {})}
+        except ValueError as ve:
+            return 400, {"error": str(ve.args[0] if ve.args else ve)}
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            return 500, {"error": f"internal error: {type(e).__name__}: {e}"}
+        receipt["replica_id"] = self.replica_id
+        return 200, receipt
 
     def stream_response(self, handler, payload: dict, trace_id: str = ""):
         """Serve one ``"stream": true`` request as SSE on ``handler``'s
@@ -483,7 +593,36 @@ class MegatronServer:
                     headers["X-MLT-TTFT-S"] = str(body["timing"]["ttft_s"])
                 return self._send(code, body, headers=headers)
 
-            do_POST = do_PUT  # convenience; reference is PUT-only
+            def do_POST(self):
+                # replica admin plane (ISSUE 19): the cross-replica KV
+                # push lands here as raw octet-stream; everything else
+                # keeps the reference's PUT semantics (POST /api works
+                # as a convenience; reference is PUT-only)
+                if self.path.rstrip("/") == "/admin/kv_push":
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        return self._send(
+                            400, {"error": "invalid Content-Length"})
+                    blob = self.rfile.read(length)
+                    trace_id = (self.headers.get("X-MLT-Trace-Id", "").strip()
+                                or uuid.uuid4().hex)
+                    try:
+                        with obs_trace.span("serve-kv-push",
+                                            trace_id=trace_id):
+                            code, body = server.kv_push(
+                                blob, trace_id=trace_id)
+                    except Exception as e:
+                        code, body = 500, {
+                            "error":
+                            f"internal error: {type(e).__name__}: {e}"}
+                    headers = {"X-MLT-Trace-Id": trace_id}
+                    if code == 503 and isinstance(body, dict) \
+                            and "retry_after" in body:
+                        headers["Retry-After"] = str(
+                            max(1, int(body["retry_after"])))
+                    return self._send(code, body, headers=headers)
+                return self.do_PUT()
 
             def do_GET(self):
                 path, _, query = self.path.partition("?")
@@ -539,6 +678,10 @@ class MegatronServer:
             "streaming": bool(self.batching
                               and hasattr(self.engine, "submit_stream")),
             "registered": self.register_url is not None,
+            # disaggregated serving (ISSUE 19): the advertised role the
+            # router's disagg policy steers by; "unified" replicas serve
+            # both phases (the pre-disagg behavior, byte for byte)
+            "role": self.role,
             "replica_id": self.replica_id,
             "seq": seq,
             "uptime_s": round(time.monotonic() - self._t_start, 3),

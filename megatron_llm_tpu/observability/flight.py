@@ -61,8 +61,10 @@ __all__ = [
 #: Phase buckets of the latency decomposition.  A request is in exactly
 #: one at any instant: ``queued`` (submitted, no slot yet), ``prefill``
 #: (admitted, prompt K/V filling), ``decode`` (emitting tokens),
-#: ``preempted`` (pages released, waiting to re-admit).
-PHASES = ("queued", "prefill", "decode", "preempted")
+#: ``preempted`` (pages released, waiting to re-admit), ``handoff``
+#: (prefill done on a prefill-role replica, pages being exported to
+#: the decode target — ISSUE 19; such requests never enter ``decode``).
+PHASES = ("queued", "prefill", "decode", "preempted", "handoff")
 
 
 class RequestRecord:
@@ -240,6 +242,7 @@ class RequestRecord:
                 "prefill_s": round(live.get("prefill", 0.0), 6),
                 "decode_s": round(live.get("decode", 0.0), 6),
                 "preempted_s": round(live.get("preempted", 0.0), 6),
+                "handoff_s": round(live.get("handoff", 0.0), 6),
             },
             "prefill_compute_s": round(self.prefill_compute_s, 6),
             "hit_tokens": self.hit_tokens,
@@ -257,6 +260,8 @@ class RequestRecord:
                 "decode_s": round(self.ttft_phase_s.get("decode", 0.0), 6),
                 "preempted_s": round(
                     self.ttft_phase_s.get("preempted", 0.0), 6),
+                "handoff_s": round(
+                    self.ttft_phase_s.get("handoff", 0.0), 6),
             }
         if self.spec_drafted:
             d["spec"] = {"drafted": self.spec_drafted,

@@ -1,0 +1,111 @@
+"""Push client for cross-replica KV page transfer.
+
+The prefill replica calls :func:`push_pages` after exporting a
+request's pages: one ``POST {target}/admin/kv_push`` carrying the wire
+blob (serving/handoff/wire.py), the trace id riding the same
+``X-MLT-Trace-Id`` header every other tier uses.  The decode replica
+answers with a JSON import receipt (pages installed / deduped), or an
+error status this module maps onto :class:`KVPushError` — a 503 keeps
+the replica's ``Retry-After`` so the caller can degrade to unified
+serving with an honest backoff.
+
+Lock discipline matches the rest of the serving tier (graftcheck's
+lock rules + lockorder.json): :class:`HandoffStats` is a leaf lock —
+it never calls out while held, so it can be taken under any engine or
+server lock without ordering risk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+__all__ = ["HandoffStats", "KVPushError", "STATS", "push_pages"]
+
+
+class KVPushError(RuntimeError):
+    """A KV push that did not install pages on the target.
+
+    ``status`` is the HTTP status when the target answered (None for
+    connect/transport failures); ``retry_after`` carries the target's
+    backoff hint when it said 503 (pool pressure is transient — the
+    router falls back to unified serving rather than queueing the
+    hop)."""
+
+    def __init__(self, msg: str, status: Optional[int] = None,
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.status = status
+        self.retry_after = retry_after
+
+
+class HandoffStats:
+    """Process-wide push accounting (a leaf lock; see module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pushes = 0        # completed pushes — guarded by _lock
+        self.failures = 0      # raised pushes — guarded by _lock
+        self.pages_sent = 0    # pages installed or deduped — guarded by _lock
+        self.bytes_sent = 0    # wire bytes shipped — guarded by _lock
+
+    def note_push(self, pages: int, nbytes: int) -> None:
+        with self._lock:
+            self.pushes += 1
+            self.pages_sent += int(pages)
+            self.bytes_sent += int(nbytes)
+
+    def note_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "pushes": self.pushes,
+                "failures": self.failures,
+                "pages_sent": self.pages_sent,
+                "bytes_sent": self.bytes_sent,
+            }
+
+
+STATS = HandoffStats()
+
+
+def push_pages(target_url: str, blob: bytes, *, trace_id: str = "",
+               timeout_s: float = 60.0,
+               stats: Optional[HandoffStats] = None) -> dict:
+    """POST a handoff blob to ``{target_url}/admin/kv_push``.
+
+    Returns the decode replica's import receipt (parsed JSON).  Raises
+    :class:`KVPushError` on any failure; the caller decides whether to
+    fall back (router) or surface it (tests)."""
+    stats = STATS if stats is None else stats
+    url = target_url.rstrip("/") + "/admin/kv_push"
+    req = urllib.request.Request(
+        url, data=blob, method="POST",
+        headers={"Content-Type": "application/octet-stream",
+                 "X-MLT-Trace-Id": trace_id})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            receipt = json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        stats.note_failure()
+        retry_after = None
+        try:
+            body = json.loads(e.read().decode("utf-8"))
+            retry_after = body.get("retry_after")
+            detail = body.get("error") or body.get("message") or ""
+        except Exception:  # noqa: BLE001 — error body is best-effort
+            detail = ""
+        raise KVPushError(
+            f"kv_push to {url} failed: HTTP {e.code} {detail}".rstrip(),
+            status=e.code, retry_after=retry_after) from e
+    except Exception as e:  # noqa: BLE001 — transport/connect failures
+        stats.note_failure()
+        raise KVPushError(f"kv_push to {url} failed: {e}") from e
+    stats.note_push(int(receipt.get("pages", 0)), len(blob))
+    return receipt

@@ -4,8 +4,9 @@ Four parts, one module each:
 
 * registry.py — ReplicaView parsing, circuit-breaker lifecycle
   (healthy/suspect/ejected/draining), background /health pollers
-* policy.py + policies.py — the RouterPolicy interface and the four
-  policies: round_robin, least_loaded, prefix_affinity, slo_aware
+* policy.py + policies.py — the RouterPolicy interface and the five
+  policies: round_robin, least_loaded, prefix_affinity, slo_aware,
+  disagg (phase-aware prefill/decode steering, serving/handoff/)
 * proxy.py — the forwarding data plane: timeouts, failover, bounded
   Retry-After-honoring retries, never-retry-partial-streams
 * server.py — the HTTP tier: PUT /api, GET /health (fleet summary),
@@ -15,6 +16,7 @@ Guide: docs/guide/serving.md "Cross-replica routing".
 """
 
 from megatron_llm_tpu.serving.router.policies import (  # noqa: F401
+    DisaggPolicy,
     LeastLoadedPolicy,
     PrefixAffinityPolicy,
     RoundRobinPolicy,
@@ -50,6 +52,7 @@ __all__ = [
     "EJECTED",
     "HEALTHY",
     "SUSPECT",
+    "DisaggPolicy",
     "FleetOverloaded",
     "ForwardOutcome",
     "ForwardingProxy",

@@ -1,5 +1,5 @@
-"""The four routing policies: round_robin, least_loaded, prefix_affinity,
-slo_aware.
+"""The five routing policies: round_robin, least_loaded, prefix_affinity,
+slo_aware, disagg.
 
 Each consumes :class:`ReplicaView` snapshots only (serving/router/
 registry.py) and returns a preference-ordered candidate list; the proxy
@@ -23,6 +23,7 @@ from megatron_llm_tpu.serving.router.policy import (
 from megatron_llm_tpu.serving.router.registry import ReplicaView
 
 __all__ = [
+    "DisaggPolicy",
     "LeastLoadedPolicy",
     "PrefixAffinityPolicy",
     "RoundRobinPolicy",
@@ -51,12 +52,25 @@ class RoundRobinPolicy(RouterPolicy):
         return list(views[k:]) + list(views[:k])
 
 
+def _kv_headroom(v: ReplicaView) -> float:
+    """Capacity tie-break signal in BYTES (ISSUE 13 / ISSUE 19): an int8
+    replica's free page holds half a bf16 replica's, so mixed-dtype
+    fleets must compare byte headroom, never raw page counts.  Falls back
+    to the page count only when the replica predates the byte budget
+    (pre-ISSUE-13 /health payloads)."""
+    b = v.free_kv_bytes
+    return b if b is not None else float(v.free_pages)
+
+
 def _drain_order(views: Sequence[ReplicaView]) -> List[ReplicaView]:
     """Ascending predicted-backlog order: queue-depth x drain-EMA, ties
-    broken by occupancy then stable fleet order (enumerate keeps the sort
-    deterministic when scores tie exactly)."""
-    return [v for _, _, _, v in sorted(
-        (v.drain_score(), v.load, i, v) for i, v in enumerate(views))]
+    broken by occupancy, then by KV byte headroom descending (the
+    dtype-honest capacity signal — see :func:`_kv_headroom`), then stable
+    fleet order (enumerate keeps the sort deterministic when everything
+    ties exactly)."""
+    return [v for _, _, _, _, v in sorted(
+        (v.drain_score(), v.load, -_kv_headroom(v), i, v)
+        for i, v in enumerate(views))]
 
 
 @register_router_policy
@@ -190,3 +204,53 @@ class SloAwarePolicy(RouterPolicy):
                       "ttft_deadline_ms": request.ttft_deadline_ms})
         infeasible = [(w, i, v) for w, i, v in ranked if w > budget_s]
         return [v for _, _, v in feasible + infeasible]
+
+
+@register_router_policy
+class DisaggPolicy(RouterPolicy):
+    """Phase-aware routing for disaggregated prefill/decode fleets
+    (ISSUE 19, serving/handoff/).
+
+    ``order`` answers where the request should *decode*: decode-role
+    replicas first (drain order), then unified, then prefill-role as the
+    last-resort failover tier — a fleet with no decode-role replicas
+    degrades to plain least_loaded, so the policy is safe as a default
+    on role-less fleets.
+
+    ``prefill_candidates`` answers whether the request should take the
+    prefill→handoff→decode path first: only single-prompt, non-logprobs
+    requests with at least ``long_prompt_chars`` characters of prompt
+    (short prompts' prefill is cheaper than the hop), and only when the
+    fleet has BOTH a prefill-role and a decode-role replica.  An empty
+    list means "skip the hop" — the router then forwards exactly like
+    least_loaded would."""
+
+    name = "disagg"
+
+    def __init__(self, *, long_prompt_chars: int = 2048):
+        if long_prompt_chars < 1:
+            raise ValueError("long_prompt_chars must be >= 1")
+        self.long_prompt_chars = long_prompt_chars
+
+    def order(self, request: RouteRequest,
+              views: Sequence[ReplicaView]) -> List[ReplicaView]:
+        decode = [v for v in views if v.role == "decode"]
+        unified = [v for v in views if v.role == "unified"]
+        prefill = [v for v in views if v.role == "prefill"]
+        ordered = (_drain_order(decode) + _drain_order(unified)
+                   + _drain_order(prefill))
+        # roles the parser doesn't know stay routable, at the back
+        known = set(ordered)
+        return ordered + _drain_order([v for v in views if v not in known])
+
+    def prefill_candidates(self, request: RouteRequest,
+                           views: Sequence[ReplicaView]
+                           ) -> List[ReplicaView]:
+        if request.n_prompts != 1 or request.logprobs:
+            return []
+        if len(request.prefix_text) < self.long_prompt_chars:
+            return []
+        prefill = [v for v in views if v.role == "prefill"]
+        if not prefill or not any(v.role == "decode" for v in views):
+            return []
+        return _drain_order(prefill)

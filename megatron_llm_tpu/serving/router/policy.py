@@ -61,6 +61,10 @@ class RouteRequest:
     n_prompts: int = 1
     priority: int = 1
     ttft_deadline_ms: Optional[float] = None
+    # disaggregated serving (ISSUE 19): logprobs requests bypass the
+    # decode replica's prefix trie, so the disagg policy never spends a
+    # prefill hop on them
+    logprobs: bool = False
 
     @staticmethod
     def from_payload(payload: dict) -> "RouteRequest":
@@ -76,6 +80,7 @@ class RouteRequest:
             priority=pri if isinstance(pri, int) else 1,
             ttft_deadline_ms=(float(ttft) if isinstance(ttft, (int, float))
                               and not isinstance(ttft, bool) else None),
+            logprobs=payload.get("logprobs") is True,
         )
 
 

@@ -94,6 +94,11 @@ class ReplicaView:
     # --register_url (heartbeat-discovered rather than static config)
     streaming: bool = False
     registered: bool = False
+    # disaggregated prefill/decode (ISSUE 19): the replica's advertised
+    # serving role; the disagg policy steers long prompts prefill-first
+    # when the fleet has both roles, and degrades to least_loaded when
+    # it doesn't ("unified" is the pre-disagg default)
+    role: str = "unified"
     # scheduler control-plane payload (engine.scheduler_stats())
     policy: str = ""
     retry_after_s: Optional[float] = None
@@ -142,6 +147,7 @@ class ReplicaView:
             kv_scale_bytes=int(payload.get("kv_scale_bytes", 0)),
             streaming=bool(payload.get("streaming", False)),
             registered=bool(payload.get("registered", False)),
+            role=str(payload.get("role", "unified")),
             policy=str(sched.get("policy", "")),
             retry_after_s=(None if sched.get("retry_after_s") is None
                            else float(sched["retry_after_s"])),

@@ -53,6 +53,7 @@ import json
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -138,6 +139,16 @@ class RouterServer:
             "slo_aware found none feasible)")
         self._poll_failures = reg.counter(
             "mlt_router_poll_failures_total", "failed /health scrapes")
+        # disaggregated prefill/decode (ISSUE 19): KV handoff hops the
+        # disagg policy inserted before the decode forward, and the ones
+        # that failed (the request then fell back to unified serving)
+        self._handoffs = reg.counter(
+            "mlt_router_handoffs_total",
+            "prefill-to-decode KV handoffs completed before forwarding")
+        self._handoff_failures = reg.counter(
+            "mlt_router_handoff_failures_total",
+            "KV handoff attempts that failed; the request fell back to "
+            "unified serving on the decode candidate")
         # admission queue (ISSUE 18): depth 0 keeps it off entirely.
         # limit 0 = auto: recomputed from the routable fleet's summed
         # max_slots before each wait, so an elastic fleet growing
@@ -253,6 +264,69 @@ class RouterServer:
 
     # ---- request handling ----------------------------------------------
 
+    def _maybe_handoff(self, request: RouteRequest, views, candidates,
+                       payload: dict, trace_id: str = "") -> bool:
+        """Phase-aware prefill hop (ISSUE 19, serving/handoff/).
+
+        Asks the policy's ``prefill_candidates`` hook (only the disagg
+        policy has one) whether this request should be prefilled on a
+        prefill-role replica first.  When it should, the router sends the
+        request there with ``"handoff_to": <decode url>`` — the prefill
+        replica runs chunked prefill, exports the KV pages and pushes
+        them to the decode candidate — and then lets the normal forward
+        proceed: the decode replica finds the prompt trie-hot, so its
+        prefill collapses to the refeed token.  The SAME trace id rides
+        the hop, the push and the decode forward, and the streamed
+        response (with its ``X-MLT-TTFT-S`` stamp) comes from the decode
+        replica via the ordinary proxy path — honesty preserved end to
+        end.  Any failure is metered and swallowed: the request falls
+        back to unified serving on the decode candidate, never half-
+        served.  Returns True when the hop completed."""
+        picker = getattr(self.policy, "prefill_candidates", None)
+        if picker is None or not candidates:
+            return False
+        try:
+            prefill = picker(request, views)
+        except Exception:
+            return False
+        if not prefill:
+            return False
+        decode_url = candidates[0].url
+        pre = next((p for p in prefill if p.url != decode_url), None)
+        if pre is None:
+            return False  # the decode target IS the only prefill replica
+        hop = dict(payload)
+        hop.pop("stream", None)
+        hop["handoff_to"] = decode_url
+        data = json.dumps(hop).encode()
+        req = urllib.request.Request(
+            pre.url.rstrip("/") + "/api", data=data, method="PUT",
+            headers={"Content-Type": "application/json",
+                     "X-MLT-Trace-Id": trace_id})
+        try:
+            with span("router-handoff", trace_id=trace_id,
+                      prefill=pre.url, decode=decode_url):
+                with urllib.request.urlopen(
+                        req, timeout=self.proxy.timeout_s) as resp:
+                    receipt = json.loads(resp.read())
+        except Exception as e:
+            # 5xx from the prefill replica (including a failed push to
+            # the decode side) and transport failures land here; the
+            # decode forward below still serves the request unified
+            self._handoff_failures.inc()
+            if not isinstance(e, urllib.error.HTTPError):
+                # transport-level failure rides the same breaker as a
+                # failed forward, so a dead prefill replica ejects
+                # promptly; an HTTP error is an *answer*, not deadness
+                self.registry.record_forward_failure(
+                    pre.url, f"handoff: {type(e).__name__}: {e}")
+            return False
+        if not isinstance(receipt, dict) or "handoff" not in receipt:
+            self._handoff_failures.inc()
+            return False
+        self._handoffs.inc()
+        return True
+
     def route(self, payload: dict, body: bytes, trace_id: str = ""):
         """Decide + forward.  Returns (status, body_bytes, headers).
 
@@ -280,6 +354,8 @@ class RouterServer:
                 "error": str(fo), "retry_after": fo.retry_after,
                 "shed": True, **fo.info,
             }).encode(), {"Retry-After": str(max(1, int(fo.retry_after)))}
+        self._maybe_handoff(request, views, candidates, payload,
+                            trace_id=trace_id)
         t0 = time.monotonic()
         out = self.proxy.forward(
             [v.url for v in candidates], body,
@@ -334,6 +410,8 @@ class RouterServer:
                 "error": str(fo), "retry_after": fo.retry_after,
                 "shed": True, **fo.info,
             }).encode(), {"Retry-After": str(max(1, int(fo.retry_after)))}
+        self._maybe_handoff(request, views, candidates, payload,
+                            trace_id=trace_id)
         t0 = time.monotonic()
         out = self.proxy.forward_stream(
             [v.url for v in candidates], body,

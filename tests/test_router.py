@@ -23,6 +23,7 @@ from megatron_llm_tpu.serving.router import (
     EJECTED,
     HEALTHY,
     SUSPECT,
+    DisaggPolicy,
     FleetOverloaded,
     ForwardingProxy,
     HealthPoller,
@@ -85,6 +86,55 @@ def test_least_loaded_scores_depth_times_drain_ema():
 def test_least_loaded_without_timing_falls_back_to_depth():
     views = [_view("http://r0", queued=3), _view("http://r1", queued=1)]
     assert LeastLoadedPolicy().order(REQ, views)[0].url == "http://r1"
+
+
+def test_least_loaded_ties_break_on_kv_byte_headroom():
+    """Mixed-dtype fleets compare BYTE headroom, not page counts: an
+    int8 replica's free page holds half a bf16 replica's (ISSUE 13/19).
+    Here the int8 replica has MORE free pages but FEWER free bytes."""
+    bf16 = _view("http://bf16", free_pages=10, total_pages=20,
+                 kv_pool_bytes=40 << 20)   # 2 MB/page -> 20 MB free
+    int8 = _view("http://int8", free_pages=15, total_pages=20,
+                 kv_pool_bytes=20 << 20)   # 1 MB/page -> 15 MB free
+    order = LeastLoadedPolicy().order(REQ, [int8, bf16])
+    assert [v.url for v in order] == ["http://bf16", "http://int8"]
+    # replicas predating the byte budget tie-break on raw page counts
+    old = [_view("http://a", free_pages=3), _view("http://b", free_pages=9)]
+    assert LeastLoadedPolicy().order(REQ, old)[0].url == "http://b"
+
+
+def test_disagg_orders_decode_then_unified_then_prefill():
+    views = [_view("http://p", role="prefill"), _view("http://u"),
+             _view("http://d", role="decode")]
+    assert [v.url for v in DisaggPolicy().order(REQ, views)] == \
+        ["http://d", "http://u", "http://p"]
+
+
+def test_disagg_degrades_to_least_loaded_on_roleless_fleet():
+    views = [_view("http://r0", queued=3), _view("http://r1", queued=1)]
+    assert [v.url for v in DisaggPolicy().order(REQ, views)] == \
+        ["http://r1", "http://r0"]
+
+
+def test_disagg_prefill_candidates_gates():
+    """The prefill hop is spent only on single-prompt, non-logprobs
+    requests past the length threshold, and only when the fleet holds
+    BOTH roles — every other shape routes exactly like least_loaded."""
+    pol = DisaggPolicy(long_prompt_chars=64)
+    long_req = RouteRequest(prefix_text="x" * 100)
+    pre = _view("http://p", role="prefill")
+    dec = _view("http://d", role="decode")
+    assert [v.url for v in pol.prefill_candidates(long_req, [pre, dec])] \
+        == ["http://p"]
+    assert pol.prefill_candidates(
+        RouteRequest(prefix_text="short"), [pre, dec]) == []
+    assert pol.prefill_candidates(
+        RouteRequest(prefix_text="x" * 100, logprobs=True),
+        [pre, dec]) == []
+    assert pol.prefill_candidates(
+        RouteRequest(prefix_text="x" * 100, n_prompts=2), [pre, dec]) == []
+    assert pol.prefill_candidates(long_req, [pre, _view("http://u")]) == []
+    assert pol.prefill_candidates(long_req, [dec, _view("http://u")]) == []
 
 
 def test_prefix_affinity_is_stable_and_order_independent():

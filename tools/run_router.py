@@ -80,6 +80,11 @@ def main(argv=None):
     ap.add_argument("--slo_margin", type=float, default=0.8,
                     help="slo_aware: fraction of the TTFT deadline the "
                          "predicted wait must fit in")
+    ap.add_argument("--disagg_long_prompt_chars", type=int, default=2048,
+                    help="disagg: minimum prompt characters before a "
+                         "request takes the prefill->handoff->decode "
+                         "path; shorter prompts go straight to a decode-"
+                         "capable replica")
     ap.add_argument("--allow_registration", action="store_true",
                     help="accept POST /admin/register heartbeats from "
                          "replicas started with --register_url; the "
@@ -112,6 +117,9 @@ def main(argv=None):
                              load_factor=args.affinity_load_factor)
     elif args.policy == "slo_aware":
         policy_kwargs = dict(margin=args.slo_margin)
+    elif args.policy == "disagg":
+        policy_kwargs = dict(
+            long_prompt_chars=args.disagg_long_prompt_chars)
 
     router = RouterServer(
         urls, policy=args.policy, policy_kwargs=policy_kwargs,

@@ -69,6 +69,15 @@ def main():
     ap.add_argument("--advertise_url",
                     help="url the router should reach this replica at "
                          "(default http://127.0.0.1:<bound port>)")
+    ap.add_argument("--serving_role", default="unified",
+                    choices=("unified", "prefill", "decode"),
+                    help="disaggregated prefill/decode role advertised in "
+                         "/health (serving/handoff/): the router's disagg "
+                         "policy sends long-prompt prefills to prefill-"
+                         "role replicas, which push the KV pages to a "
+                         "decode-role replica over POST /admin/kv_push; "
+                         "unified (default) serves both phases exactly "
+                         "as before")
     args, extra = ap.parse_known_args()
 
     import jax
@@ -128,8 +137,11 @@ def main():
         engine = ContinuousBatchingEngine(cfg, params, tokenizer, mesh=mesh)
     server = MegatronServer(engine, register_url=args.register_url,
                             register_interval_s=args.register_interval,
-                            advertise_url=args.advertise_url)
+                            advertise_url=args.advertise_url,
+                            role=args.serving_role)
     kind = "legacy" if args.legacy_engine else "continuous-batching"
+    if args.serving_role != "unified":
+        kind += f", role={args.serving_role}"
     if not args.legacy_engine:
         kind += f", sched={engine.policy.name}"
         if engine.spec_k:

@@ -217,6 +217,14 @@ JOBS = [
     ("bench_decode_streaming",
      [sys.executable, "bench_decode.py", "--mode", "streaming"],
      False, _bench_on_tpu),
+    # ISSUE 19: disaggregated prefill/decode — short-class decode p99 TPOT
+    # through a unified 2-replica fleet vs a prefill+decode split fleet
+    # behind the disagg router, with the token-identity assert and the
+    # zero-handoff-failure gate (bench_decode.py --mode disagg,
+    # engine_decode_disagg evidence)
+    ("bench_decode_disagg",
+     [sys.executable, "bench_decode.py", "--mode", "disagg"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
