@@ -133,6 +133,7 @@ METRIC_MIXED = "engine_ragged_launch_reduction_llama470m_mixed_1chip"
 METRIC_PIPELINE = "engine_pipeline_decode_speedup_llama470m_c8_1chip"
 METRIC_STREAMING = "serving_stream_first_token_speedup_llama470m_c8_2rep_1chip"
 METRIC_DISAGG = "serving_disagg_decode_p99_tpot_speedup_llama470m_2rep_1chip"
+METRIC_PP = "engine_pp_decode_tok_s_ratio_llama470m_c4_eqchip"
 
 # every mode decodes greedily with termination disabled: runs are
 # workload-shaped, never content-shaped
@@ -1409,6 +1410,155 @@ def bench_disagg(cfg, params, prompt_short: int, gen_short: int,
     }
 
 
+def bench_pp(cfg, params, pps, concurrency: int, prompt: int, gen: int,
+             vocab: int, reps: int) -> dict:
+    """Pipeline-parallel serving tick (ISSUE 20, parallel/pp_serve.py):
+    the same greedy decode workload through three engine layouts at
+    EQUAL chip count per comparison:
+
+    * **pp=1** (tp=N, pp=1): the tp-only engine on N chips — the
+      pre-pp baseline whose executables a pp engine must never reuse.
+    * **pp=N** (tp=1, pp=N): N pipeline stages, each holding L/N layers
+      of params AND KV pool, ragged rows microbatched through the stage
+      scan with the boundary ppermutes riding between adjacent GEMMs.
+
+    A flat single-chip arm runs first as the token-identity reference
+    (and, under jax 0.4.37, to keep every GSPMD compile ahead of the
+    shardy flip a pp engine holds for its lifetime).  In-bench gates:
+    greedy tokens identical across ALL arms (log-probs within 5e-6),
+    per-stage KV bytes exactly kv_pool_bytes/pp, and the stage-permute
+    mechanism machine-asserted in the compiled tick HLO — the ppermute
+    chain under the ``stage-permute`` scope, not assumed.  Headline:
+    decode tok/s of the largest pp arm over its equal-chip pp=1 arm
+    (gate: >= 0.85, i.e. pipelining the tick costs < 15% decode
+    throughput while cutting per-chip KV residency to 1/pp)."""
+    import copy
+
+    import jax
+    import numpy as np
+
+    from megatron_llm_tpu.core.parallel_state import build_mesh
+    from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
+
+    prompts = _requests(concurrency, prompt, gen, vocab)
+
+    def run_arm(pp, tp):
+        devs = jax.devices()
+        mesh = (None if pp * tp == 1 else build_mesh(
+            tensor_model_parallel_size=tp,
+            pipeline_model_parallel_size=pp,
+            data_parallel_size=1, devices=devs[:pp * tp]))
+
+        def once():
+            eng = make_engine(copy.deepcopy(cfg), params,
+                              max_slots=concurrency,
+                              max_seq=prompt + gen, mesh=mesh)
+            reqs = run_workload(
+                eng, [(p, gen, dict(GREEDY_KW, seed=11 + i))
+                      for i, p in enumerate(prompts)])
+            return eng, reqs
+
+        t0 = time.perf_counter()
+        eng, reqs = once()  # warm: compiles ride this run
+        compile_s = time.perf_counter() - t0
+        outs = [(r.result()[0], list(r.log_probs)) for r in reqs]
+        best, ticks, ttfts = float("inf"), 0, []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            eng, reqs = once()
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best, ticks = dt, eng.ticks
+                ttfts = [r.ttft for r in reqs if r.ttft is not None]
+        total = concurrency * gen
+        return eng, outs, {
+            "pp": pp, "tp": tp, "chips": max(pp * tp, 1),
+            "engine_s": round(best, 4),
+            "decode_tok_s": round(total / best, 1),
+            "tick_ms": round(best / max(ticks, 1) * 1e3, 3),
+            "ticks": ticks,
+            "ttft_mean_ms": round(
+                1e3 * sum(ttfts) / max(len(ttfts), 1), 2),
+            "compile_time_s": round(compile_s, 1),
+            "kv_pool_bytes": eng.pool.kv_pool_bytes(),
+            "kv_stage_bytes": eng.pool.kv_stage_bytes(),
+        }
+
+    # flat identity reference, then every GSPMD (pp=1) arm, THEN the pp
+    # arms — a pp engine flips the partitioner for the process lifetime
+    _, ref_outs, flat_row = run_arm(1, 1)
+    rows, pairs = [flat_row], []
+    identity_ok, stage_bytes_ok, hlo = True, True, ""
+    for pp in pps:
+        _, base_outs, base_row = run_arm(1, pp)  # tp=pp: equal chips
+        rows.append(base_row)
+        pairs.append((pp, base_row))
+        for (t0, l0), (t1, l1) in zip(ref_outs, base_outs):
+            identity_ok &= (t0 == t1) and bool(
+                np.allclose(l0, l1, atol=5e-6))
+    for i, pp in enumerate(pps):
+        eng, outs, row = run_arm(pp, 1)
+        rows.append(row)
+        for (t0, l0), (t1, l1) in zip(ref_outs, outs):
+            identity_ok &= (t0 == t1) and bool(
+                np.allclose(l0, l1, atol=5e-6))
+        stage_bytes_ok &= (row["kv_stage_bytes"]
+                           == row["kv_pool_bytes"] // pp)
+        pairs[i] = pairs[i] + (row,)
+        if not hlo:
+            # mechanism, not vibes: the stage-boundary ppermutes run
+            # under the stage-permute scope in the compiled tick forward
+            from megatron_llm_tpu.generation.engine import PagedState
+            from megatron_llm_tpu.models.language_model import (
+                make_rope_cache, model_forward,
+            )
+
+            bt = np.zeros((eng.max_slots, eng.pages_per_seq), np.int32)
+            pos = np.zeros((eng.max_slots,), np.int32)
+            toks = np.full((eng.max_slots,), 2, np.int32)
+            ppc, acfg = eng._ppc, eng.cfg
+
+            def tickish(p, pk, pv):
+                import jax.numpy as jnp
+
+                rope = make_rope_cache(acfg)
+                with pp_serve_mod.activate(ppc):
+                    logits, _ = model_forward(
+                        acfg, p, jnp.asarray(toks)[:, None],
+                        position_ids=jnp.asarray(pos)[:, None],
+                        rope_cache=rope, kv_caches=(pk, pv),
+                        paged=PagedState(jnp.asarray(bt),
+                                         jnp.asarray(pos)))
+                return logits
+
+            hlo = jax.jit(tickish).lower(
+                eng.params, eng.pool.k, eng.pool.v).compile().as_text()
+    mechanism_ok = (pp_serve_mod.STAGE_PERMUTE_SCOPE in hlo
+                    and "collective-permute" in hlo)
+    ratios = {f"pp{pp}": round(
+        pprow["decode_tok_s"] / max(base["decode_tok_s"], 1e-9), 3)
+        for pp, base, pprow in pairs}
+    headline_pp = max(pps)
+    headline = ratios[f"pp{headline_pp}"]
+    return {
+        "concurrency": concurrency, "prompt_len": prompt, "gen_len": gen,
+        "pps": list(pps),
+        "decode_tok_s_ratio": headline,
+        "ratios_vs_equal_chip_pp1": ratios,
+        "identity_ok": identity_ok,
+        "stage_bytes_ok": stage_bytes_ok,
+        "mechanism_ok": mechanism_ok,
+        "stage_bytes_ratio": round(
+            rows[-1]["kv_stage_bytes"]
+            / max(rows[-1]["kv_pool_bytes"], 1), 4),
+        "pp_ok": (identity_ok and stage_bytes_ok and mechanism_ok
+                  and min(ratios.values()) >= 0.85),
+        "compile_time_s": round(sum(r["compile_time_s"] for r in rows), 1),
+        "step_time_s": round(rows[-1]["tick_ms"] / 1e3, 6),
+        "rows": rows,
+    }
+
+
 def _run(args, finished):
     layers, hidden, heads, ffn, vocab = 24, 1024, 16, 4096, 32000
     levels = [int(x) for x in args.concurrency.split(",")]
@@ -1421,6 +1571,7 @@ def _run(args, finished):
     pipe_mode = args.mode == "pipeline"
     stream_mode = args.mode == "streaming"
     disagg_mode = args.mode == "disagg"
+    pp_mode = args.mode == "pp"
     pipe_depths = (0, 1, 2, 8)
     burst = 12  # admission-arm clients (streaming mode section 2)
     draft_layers = 2
@@ -1443,7 +1594,8 @@ def _run(args, finished):
     if probe_backend(args.probe_timeout) == "cpu":
         from megatron_llm_tpu.utils.platform import pin_cpu_platform
 
-        pin_cpu_platform()
+        # pp mode shards engines over pp x tp virtual chips
+        pin_cpu_platform(n_devices=8 if pp_mode else None)
         # CPU sanity shape: small enough for tier-1 time, big enough that
         # the >=3x batching / >=2x prefill-reuse / >=2x slo-TTFT / >=1.3x
         # spec gates are real measurements, not noise
@@ -1505,6 +1657,20 @@ def _run(args, finished):
             dg = dict(slots=4, n_short=8, n_long=3, short_reqs=3,
                       long_reqs=2, prompt_short=24, gen_short=24,
                       prompt_long=512, gen_long=8, long_chars=128)
+        if pp_mode:
+            # GEMM-dominated shape: with the fill/drain cond-skip the pp
+            # arms run the SAME valid GEMM work as the flat tick, so the
+            # honest comparison needs per-layer compute large enough
+            # that the stage-scan structure (ppermute + psum + cond per
+            # scan tick) is small against it — exactly the TPU regime,
+            # where the stage-boundary transfer hides behind real GEMM
+            # time.  4 layers split evenly over pp in {2, 4}; heads=4 so
+            # the tp=4 equal-chip baseline shards the heads dim; long
+            # decode streams keep prefill to the first ticks; c=4 rows
+            # microbatch M=pp.
+            layers, hidden, heads, ffn, vocab = 4, 128, 4, 256, 256
+            args.prompt, args.gen, args.reps = 16, 48, 3
+            levels = [8]
 
     import jax
 
@@ -1553,6 +1719,13 @@ def _run(args, finished):
                                  vocab, cap["groups"], cap["per_group"],
                                  cap["shared"], cap["tail"],
                                  cap["gen_cache"])
+        elif pp_mode:
+            pps = [p for p in (2, 4)
+                   if p <= len(jax.devices())
+                   and cfg.model.num_layers % p == 0]
+            assert pps, "pp mode needs >= 2 devices"
+            row = bench_pp(cfg, params, pps, levels[-1], args.prompt,
+                           args.gen, vocab, args.reps)
         elif pipe_mode:
             row = bench_pipeline(cfg, params, levels, pipe_depths,
                                  args.prompt, args.gen, vocab, args.reps)
@@ -1771,6 +1944,28 @@ def _run(args, finished):
             "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
         }
         tag = "engine_decode_pipeline"
+    elif pp_mode:
+        result = {
+            "metric": METRIC_PP.replace(
+                "_c4_", f"_c{row['concurrency']}_"),
+            "value": row["decode_tok_s_ratio"],
+            "unit": "x",
+            "pp_ok": row["pp_ok"],
+            "identity_ok": row["identity_ok"],
+            "stage_bytes_ok": row["stage_bytes_ok"],
+            "mechanism_ok": row["mechanism_ok"],
+            "stage_bytes_ratio": row["stage_bytes_ratio"],
+            "ratios_vs_equal_chip_pp1": row["ratios_vs_equal_chip_pp1"],
+            "compile_time_s": row["compile_time_s"],
+            "step_time_s": row["step_time_s"],
+            "n_params": n_params,
+            "rows": row["rows"],
+            "workload": {k: row[k] for k in
+                         ("concurrency", "prompt_len", "gen_len", "pps")},
+            "backend": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        tag = "engine_decode_pp"
     elif prefix_mode:
         result = {
             "metric": METRIC_PREFIX.replace(
@@ -1812,7 +2007,7 @@ def main():
     ap.add_argument("--mode",
                     choices=("occupancy", "shared_prefix", "slo", "spec",
                              "router", "mixed", "capacity", "pipeline",
-                             "streaming", "disagg"),
+                             "streaming", "disagg", "pp"),
                     default="occupancy")
     ap.add_argument("--concurrency", default="1,4,8",
                     help="comma-separated occupancy levels (requests); "
@@ -1855,10 +2050,11 @@ def main():
               "mixed": METRIC_MIXED, "pipeline": METRIC_PIPELINE,
               "capacity": METRIC_CAPACITY,
               "streaming": METRIC_STREAMING,
-              "disagg": METRIC_DISAGG}.get(args.mode, METRIC)
+              "disagg": METRIC_DISAGG,
+              "pp": METRIC_PP}.get(args.mode, METRIC)
     unit = ("x" if args.mode in ("shared_prefix", "slo", "spec", "router",
                                  "mixed", "capacity", "pipeline",
-                                 "streaming", "disagg")
+                                 "streaming", "disagg", "pp")
             else "tok/s")
     finished = threading.Event()
 

@@ -164,6 +164,14 @@ class ParallelConfig:
     # Only meaningful with --tp_overlap ring; error bound documented in
     # docs/guide/quantization.md.
     quantized_tp_collectives: bool = False
+    # Vocab-parallel head ring (parallel/overlap.py:vocab_parallel, ISSUE
+    # 20): decompose the serving head GEMM's logits all-gather into an
+    # all-gather matmul ring — each rank GEMMs one column sub-chunk of
+    # its vocab shard while previously computed sub-chunks ppermute
+    # around the ring, so the wire hides behind the MXU work that decode
+    # pays EVERY tick.  Runs outside the pp stage region, so it composes
+    # with pipeline-parallel serving.  Silently inert at tp == 1.
+    vocab_ring: bool = False
     # declares that cp batches follow the STANDARD zigzag layout
     # (parallel/ring.py:apply_zigzag) — lets causal ring attention use the
     # striped Pallas kernels instead of the jnp fallback; set it alongside

@@ -104,7 +104,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from megatron_llm_tpu.core.parallel_state import TP_AXIS
+from megatron_llm_tpu.core.parallel_state import PP_AXIS, TP_AXIS
 from megatron_llm_tpu.generation import generation as gen
 from megatron_llm_tpu.generation.sampling import sample_per_slot
 from megatron_llm_tpu.generation.scheduling import (
@@ -202,16 +202,31 @@ class PagedKVPool:
         # Quantized pools shard the scale leaf over the same heads dim
         # ([L, P, nkv] -> tp on nkv), so a page's values and its scales
         # always live on the same shard.
+        # Pipeline parallelism (ISSUE 20) additionally shards the pool
+        # over the LAYER dim: each pp stage holds only its own L/pp
+        # layers' pages — per-stage pool bytes are 1/pp of the tp-only
+        # pool (the servable-model-size multiplier).  Page ids address
+        # the same slot of every stage's slice, so block tables, the
+        # trie, the allocator and the commitment ledger below stay
+        # host-side and stage-agnostic, untouched.
         self.mesh = mesh
         tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
-        if tp > 1:
-            assert m.num_attention_heads_kv % tp == 0, (
-                f"kv heads {m.num_attention_heads_kv} not divisible by "
-                f"tp {tp}")
+        pp = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
+        self.pp = pp
+        if pp > 1:
+            assert m.num_layers % pp == 0, (
+                f"num_layers {m.num_layers} not divisible by pp {pp}")
+        if tp > 1 or pp > 1:
+            if tp > 1:
+                assert m.num_attention_heads_kv % tp == 0, (
+                    f"kv heads {m.num_attention_heads_kv} not divisible by "
+                    f"tp {tp}")
+            layer_ax = PP_AXIS if pp > 1 else None
+            heads_ax = TP_AXIS if tp > 1 else None
             self.kv_sharding = NamedSharding(
-                mesh, P(None, None, None, TP_AXIS, None))
+                mesh, P(layer_ax, None, None, heads_ax, None))
             self._scale_sharding = NamedSharding(
-                mesh, P(None, None, TP_AXIS))
+                mesh, P(layer_ax, None, heads_ax))
             self.k = self._place(_make(shape))
             self.v = self._place(_make(shape))
         else:
@@ -231,10 +246,15 @@ class PagedKVPool:
             def _make_d(shp):
                 return kv_quant.make_pool(shp, kv_dtype, ddtype)
 
-            if tp > 1:
-                assert dm.num_attention_heads_kv % tp == 0, (
-                    f"draft kv heads {dm.num_attention_heads_kv} not "
-                    f"divisible by tp {tp}")
+            if pp > 1:
+                assert dm.num_layers % pp == 0, (
+                    f"draft num_layers {dm.num_layers} not divisible by "
+                    f"pp {pp}")
+            if tp > 1 or pp > 1:
+                if tp > 1:
+                    assert dm.num_attention_heads_kv % tp == 0, (
+                        f"draft kv heads {dm.num_attention_heads_kv} not "
+                        f"divisible by tp {tp}")
                 self.draft_k = self._place(_make_d(dshape))
                 self.draft_v = self._place(_make_d(dshape))
             else:
@@ -290,6 +310,13 @@ class PagedKVPool:
             n += (kv_quant.pool_nbytes(self.draft_k)
                   + kv_quant.pool_nbytes(self.draft_v))
         return n
+
+    def kv_stage_bytes(self) -> int:
+        """Per-stage device bytes of the KV value storage: the layer dim
+        is sharded over pp, so each stage holds ``kv_pool_bytes / pp`` —
+        the number a pp=N replica's HBM budget actually pays (published
+        as ``mlt_engine_kv_stage_bytes``; bench --mode pp evidence)."""
+        return self.kv_pool_bytes() // self.pp
 
     def kv_scale_bytes(self) -> int:
         """Per-page scale overhead bytes (0 for bf16)."""
@@ -664,15 +691,43 @@ class ContinuousBatchingEngine:
         # engine, byte for byte.
         self.mesh = mesh
         self._tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+        # Pipeline-parallel serving (ISSUE 20, parallel/pp_serve.py): a
+        # pp>1 mesh runs the tick's layer stack as pp stages over
+        # microbatched rows, with the paged pool sharded per stage over
+        # its own layers.  pp == 1 (or no mesh) resolves the context to
+        # None — the flag is inert and every program is byte-for-byte
+        # today's TP-only engine.
+        self._pp = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
         # --tp_overlap ring (parallel/overlap.py): the decode/ragged-tick
         # forwards route their row-parallel projections through the
         # chunked collective-matmul ring.  None = off (byte-for-byte
         # today's implicitly-inserted collectives); resolves to None at
         # tp == 1 regardless of the flag (single-chip degradation).
+        # --vocab_ring rides in the same context: the head GEMM's logits
+        # all-gather becomes an all-gather matmul ring (ISSUE 20).
         from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+        from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
         self._overlap = tp_overlap_mod.overlap_params(cfg, mesh)
-        self._overlap_mode = "ring" if self._overlap is not None else "off"
+        self._overlap_mode = ("ring" if self._overlap is not None
+                              and self._overlap.ring_rows else "off")
+        self._vocab_ring = bool(self._overlap is not None
+                                and self._overlap.vocab_ring)
+        self._ppc = pp_serve_mod.serve_params(cfg, mesh)
+        if self._pp > 1:
+            # pp stages own contiguous layer slices of params AND pool —
+            # checked before param placement so the friendly assert wins
+            # over the sharding divisibility ValueError
+            assert cfg.model.num_layers % self._pp == 0, (
+                f"num_layers {cfg.model.num_layers} not divisible by "
+                f"pp {self._pp}")
+            # ppermute inside a partial-manual region crashes the GSPMD
+            # partitioner on jax 0.4.37 — hold the shardy flag for the
+            # engine's lifetime (it participates in jit trace keys, so
+            # flat-mesh executables are never reused; compat.py story).
+            from megatron_llm_tpu.parallel import compat as compat_mod
+
+            compat_mod.enable_partitioner_for(mesh)
         if mesh is not None:
             from megatron_llm_tpu.parallel.tp import param_shardings
 
@@ -809,6 +864,17 @@ class ContinuousBatchingEngine:
         self.pipeline_depth = max(0, int(
             tick_pipeline_depth if tick_pipeline_depth is not None
             else getattr(inf, "tick_pipeline_depth", 0)))
+        if self._pp > 1:
+            # the monolithic dense prefill (init_kv_caches + cache_index)
+            # has no stage decomposition — pp serving requires the
+            # block-table chunked prefill path
+            assert self.prefill_chunk, (
+                "pipeline-parallel serving requires chunked prefill "
+                "(prefill_chunk > 0)")
+            if self.draft_cfg is not None:
+                assert self.draft_cfg.model.num_layers % self._pp == 0, (
+                    f"draft num_layers {self.draft_cfg.model.num_layers} "
+                    f"not divisible by pp {self._pp}")
         self.pool = PagedKVPool(cfg, num_pages, self.page_size, mesh=mesh,
                                 draft_cfg=self.draft_cfg,
                                 kv_dtype=self.kv_dtype)
@@ -1097,6 +1163,17 @@ class ContinuousBatchingEngine:
         reg.gauge("mlt_engine_kv_dtype_info",
                   help="KV storage mode (value always 1)",
                   labels={"kv_dtype": self.kv_dtype}).set(1)
+        # pipeline-parallel serving telemetry (ISSUE 20): stage count of
+        # the compiled tick (1 = flat TP-only engine) and the per-stage
+        # slice of the pool byte budget — the number a pp replica's HBM
+        # actually holds (the servable-model-size multiplier)
+        reg.gauge("mlt_engine_pp_stages",
+                  help="pipeline stages in the serving tick "
+                       "(pp mesh axis; 1 = unpipelined)").set(self._pp)
+        reg.gauge("mlt_engine_kv_stage_bytes",
+                  help="per-stage device bytes of KV value storage "
+                       "(kv_pool_bytes / pp)"
+                  ).set(self.pool.kv_stage_bytes())
         if mesh is not None:
             for ax, size in dict(mesh.shape).items():
                 reg.gauge("mlt_mesh_axis_size", help="mesh axis size",
@@ -1126,24 +1203,41 @@ class ContinuousBatchingEngine:
         is off, so plain engines emit nothing new."""
         import contextlib
 
-        if self._overlap is None:
+        if self._overlap is None or not self._overlap.ring_rows:
             return contextlib.nullcontext()
         from megatron_llm_tpu.parallel.overlap import overlap_scope_name
 
         return obs_trace.span(overlap_scope_name(self._tp), mode="ring",
                               tp=self._tp)
 
+    def _pp_span(self):
+        """Tracer span marking a pipeline-parallel tick dispatch
+        (``engine-pp-tick`` with pp/stages/tp attrs — the observable the
+        ISSUE 20 satellite asserts in trace dumps); a no-op context on
+        flat engines, so pp=1 dispatch emits nothing new."""
+        import contextlib
+
+        if self._pp <= 1:
+            return contextlib.nullcontext()
+        return obs_trace.span("engine-pp-tick", pp=self._pp,
+                              stages=self._pp, tp=self._tp)
+
     @property
     def _mesh_statics(self) -> Tuple:
         """Compiled-program cache key extension: engines on different mesh
         layouts must not share executables (gen.cached_jit is process-wide).
-        The EFFECTIVE overlap mode rides in the key too — an overlap
-        engine's ring programs and a plain engine's GSPMD programs have
-        identical signatures, and the fingerprint alone cannot separate
-        engines whose cfg matches but whose mesh makes the flag inert."""
+        The EFFECTIVE overlap modes ride in the key too — an overlap (or
+        vocab-ring) engine's ring programs and a plain engine's GSPMD
+        programs have identical signatures, and the fingerprint alone
+        cannot separate engines whose cfg matches but whose mesh makes the
+        flag inert.  pp geometry needs no extra component: build_mesh
+        always materializes the pp axis, so a pp=2 engine's shape tuple
+        (("cp",1),("dp",1),("ep",1),("pp",2),("tp",1)) already diverges
+        from every flat engine's — pinned by tests/test_pp_serve.py."""
         if self.mesh is None:
-            return ("mesh", None, "tp_overlap", "off")
+            return ("mesh", None, "vocab_ring", "off", "tp_overlap", "off")
         return ("mesh", tuple(sorted(dict(self.mesh.shape).items())),
+                "vocab_ring", "ring" if self._vocab_ring else "off",
                 "tp_overlap", self._overlap_mode)
 
     # -- compiled programs -------------------------------------------------
@@ -1163,13 +1257,16 @@ class ContinuousBatchingEngine:
         scope = ("decode-fwd" if self._tp == 1
                  else f"decode-fwd-tp{self._tp}")
         from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+        from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
         ovl = self._overlap
+        ppc = self._ppc
 
         def tick(params, pool_k, pool_v, block_tables, positions, tokens,
                  req_keys, steps, temperature, top_k, top_p):
             rope = make_rope_cache(cfg)
-            with jax.named_scope(scope), tp_overlap_mod.activate(ovl):
+            with jax.named_scope(scope), tp_overlap_mod.activate(ovl), \
+                    pp_serve_mod.activate(ppc):
                 logits, (pool_k, pool_v) = model_forward(
                     cfg, params, tokens[:, None],
                     position_ids=positions[:, None],
@@ -1355,11 +1452,13 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         draft_cfg = self.draft_cfg
         from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+        from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
         ovl = self._overlap
+        ppc = self._ppc
 
         def chunk(params, tokens, start, bt, pool_k, pool_v, targets):
-            with tp_overlap_mod.activate(ovl):
+            with tp_overlap_mod.activate(ovl), pp_serve_mod.activate(ppc):
                 out, (pool_k, pool_v) = model_forward(
                     cfg, params, tokens,
                     position_ids=start[:, None] + jnp.arange(rows)[None, :],
@@ -1380,7 +1479,7 @@ class ContinuousBatchingEngine:
             # for every prefilled page, so trie-matched pages (prefix hits,
             # preemption resume) carry valid draft K/V too
             res = chunk(params, tokens, start, bt, pool_k, pool_v, targets)
-            with tp_overlap_mod.activate(ovl):
+            with tp_overlap_mod.activate(ovl), pp_serve_mod.activate(ppc):
                 _, (draft_k, draft_v) = model_forward(
                     draft_cfg, draft_params, tokens,
                     position_ids=start[:, None] + jnp.arange(rows)[None, :],
@@ -2740,7 +2839,7 @@ class ContinuousBatchingEngine:
                             chain=C, tp=self._tp,
                             host_gap_ms=(None if gap is None
                                          else round(gap * 1e3, 4))), \
-                self._overlap_span():
+                self._overlap_span(), self._pp_span():
             (self.pool.k, self.pool.v, ctoks, clogps, new_pos, new_tok,
              new_steps, new_done, new_rem) = self._chained_tick()(
                 self.params, self.pool.k, self.pool.v, bt, pos, toks,
@@ -2803,7 +2902,7 @@ class ContinuousBatchingEngine:
             with obs_trace.span("engine-spec-tick", active=len(active),
                                 k=self.spec_k, tp=self._tp,
                                 host_gap_ms=gap_ms), \
-                    self._overlap_span():
+                    self._overlap_span(), self._pp_span():
                 (self.pool.k, self.pool.v, self.pool.draft_k,
                  self.pool.draft_v, emit, emit_lp, acc, cnt,
                  new_pos, next_tok, new_steps) = self._spec_tick()(
@@ -2819,7 +2918,7 @@ class ContinuousBatchingEngine:
         else:
             with obs_trace.span("engine-tick", active=len(active),
                                 tp=self._tp, host_gap_ms=gap_ms), \
-                    self._overlap_span():
+                    self._overlap_span(), self._pp_span():
                 (self.pool.k, self.pool.v, next_tok, logp,
                  new_pos, new_steps) = self._tick()(
                     self.params, self.pool.k, self.pool.v,
@@ -3017,7 +3116,7 @@ class ContinuousBatchingEngine:
                             k=self.spec_k, tp=self._tp,
                             host_gap_ms=(None if gap is None
                                          else round(gap * 1e3, 4))), \
-                self._overlap_span():
+                self._overlap_span(), self._pp_span():
             pre_args = () if not n_bucket else (
                 self._asarray(pre_tok[:n_bucket]),
                 self._asarray(pre_pos[:n_bucket]),

@@ -126,8 +126,10 @@ def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
     executable.
     """
     from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+    from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
     ovl = tp_overlap_mod.overlap_params(cfg, mesh)
+    ppc = pp_serve_mod.serve_params(cfg, mesh)
     K = spec_k
     vocab = cfg.model.vocab_size
     scope_t = ("ragged-fwd" if tp == 1 else f"ragged-fwd-tp{tp}") \
@@ -305,14 +307,15 @@ def make_ragged_tick_fn(cfg, draft_cfg, spec_k: int, prefill_rows: int,
                 positions + 1, steps + 1)
 
     base_fn = spec_tick if K else tick
-    if ovl is None:
+    if ovl is None and ppc is None:
         return base_fn
 
     def overlapped(*args, **kw):
-        # trace-time context: every model_forward in the tick — target,
+        # trace-time contexts: every model_forward in the tick — target,
         # draft scan, prefill rows — routes its row-parallel projections
-        # through the ring while this builder's closure is being traced
-        with tp_overlap_mod.activate(ovl):
+        # through the ring and/or its layer stack through the pp stage
+        # pipeline while this builder's closure is being traced
+        with tp_overlap_mod.activate(ovl), pp_serve_mod.activate(ppc):
             return base_fn(*args, **kw)
 
     return overlapped
@@ -364,8 +367,10 @@ def make_chained_tick_fn(cfg, chain: int, *, tp: int = 1, mesh=None):
     the block-table operand.
     """
     from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+    from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
     ovl = tp_overlap_mod.overlap_params(cfg, mesh)
+    ppc = pp_serve_mod.serve_params(cfg, mesh)
     vocab = cfg.model.vocab_size
     scope_t = "decode-fwd" if tp == 1 else f"decode-fwd-tp{tp}"
 
@@ -429,11 +434,11 @@ def make_chained_tick_fn(cfg, chain: int, *, tp: int = 1, mesh=None):
         return (pool_k, pool_v, toks, logps, new_pos, new_tok,
                 new_steps, new_done, new_rem)
 
-    if ovl is None:
+    if ovl is None and ppc is None:
         return chained
 
     def overlapped_chain(*args, **kw):
-        with tp_overlap_mod.activate(ovl):
+        with tp_overlap_mod.activate(ovl), pp_serve_mod.activate(ppc):
             return chained(*args, **kw)
 
     return overlapped_chain

@@ -722,6 +722,12 @@ class MegatronServer:
             info["mesh"] = ({str(k): int(v) for k, v in dict(mesh.shape).items()}
                             if mesh is not None else {})
             info["tp"] = getattr(eng, "_tp", 1)
+            # pipeline-parallel serving (ISSUE 20): stage count of the
+            # compiled tick; "stages" aliases "pp" for dashboards that
+            # speak stage language.  1 = flat TP-only replica.
+            info["pp"] = getattr(eng, "_pp", 1)
+            info["stages"] = getattr(eng, "_pp", 1)
+            info["kv_stage_bytes"] = eng.pool.kv_stage_bytes()
             if hasattr(eng, "scheduler_stats"):
                 # control-plane view: policy, per-priority queue depths,
                 # preemption/shed/deadline-miss totals, drain EMAs

@@ -28,6 +28,8 @@ from megatron_llm_tpu.ops.cross_entropy import (
 )
 from megatron_llm_tpu.ops.norms import init_norm_params, norm
 from megatron_llm_tpu.ops.rope import precompute_freqs
+from megatron_llm_tpu.parallel import overlap as tp_overlap_mod
+from megatron_llm_tpu.parallel import pp_serve as pp_serve_mod
 
 Params = Dict[str, Any]
 
@@ -215,8 +217,14 @@ def head_weight(cfg, params: Params) -> jax.Array:
 
 
 def compute_logits(cfg, params: Params, hidden: jax.Array) -> jax.Array:
-    """parallel_lm_logits analog (language_model.py:24-53): tied or untied head."""
-    return hidden @ head_weight(cfg, params).astype(hidden.dtype)
+    """parallel_lm_logits analog (language_model.py:24-53): tied or untied head.
+
+    With ``--vocab_ring`` active (parallel/overlap.py:vocab_parallel) the
+    head GEMM + logits all-gather run as an all-gather matmul ring;
+    inactive/ineligible calls take the plain fallback byte for byte."""
+    return tp_overlap_mod.vocab_parallel(
+        cfg, head_weight(cfg, params), hidden,
+        lambda w, x: x @ w.astype(x.dtype))
 
 
 def _compute_dtype(cfg):
@@ -269,14 +277,27 @@ def model_forward(
     if rope_cache is None:
         rope_cache = make_rope_cache(cfg)
 
-    hidden, new_caches, moe_aux = transformer_forward(
-        cfg, params["layers"], hidden,
-        rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
-        token_idx=token_idx,
-        dropout_key=dropout_key, deterministic=deterministic,
-        kv_caches=kv_caches, cache_index=cache_index, paged=paged,
-        sp_constraint=sp_constraint,
-    )
+    ppc = pp_serve_mod.current()
+    if ppc is not None and paged is not None and kv_caches is not None:
+        # Pipeline-parallel serving tick (parallel/pp_serve.py, ISSUE 20):
+        # the layer stack runs as pp stages over microbatched rows, each
+        # stage reading/writing only its own layers' slice of the paged
+        # pool.  MoE aux is not plumbed (deterministic inference).
+        hidden, new_caches = pp_serve_mod.pipelined_transformer(
+            cfg, ppc, params["layers"], hidden,
+            rope=rope_cache, position_ids=position_ids,
+            kv_caches=kv_caches, paged=paged,
+        )
+        moe_aux = jnp.zeros((2,), jnp.float32)
+    else:
+        hidden, new_caches, moe_aux = transformer_forward(
+            cfg, params["layers"], hidden,
+            rope=rope_cache, position_ids=position_ids, segment_ids=segment_ids,
+            token_idx=token_idx,
+            dropout_key=dropout_key, deterministic=deterministic,
+            kv_caches=kv_caches, cache_index=cache_index, paged=paged,
+            sp_constraint=sp_constraint,
+        )
 
     hidden = norm(hidden, params["final_norm"], cfg.model.layernorm_epsilon,
                   cfg.model.use_rms_norm)

@@ -102,7 +102,9 @@ __all__ = [
     "current",
     "row_parallel",
     "column_parallel",
+    "vocab_parallel",
     "overlap_scope_name",
+    "vocab_scope_name",
 ]
 
 OVERLAP_MODES = ("off", "ring")
@@ -115,19 +117,28 @@ class OverlapParams:
     ring builders need, captured once so traced closures never re-read
     config state."""
 
-    __slots__ = ("mesh", "tp", "data", "sequence_parallel", "quantized")
+    __slots__ = ("mesh", "tp", "data", "sequence_parallel", "quantized",
+                 "ring_rows", "vocab_ring")
 
     def __init__(self, mesh: Mesh, tp: int, data: int,
-                 sequence_parallel: bool, quantized: bool):
+                 sequence_parallel: bool, quantized: bool,
+                 ring_rows: bool = True, vocab_ring: bool = False):
         self.mesh = mesh
         self.tp = tp
         self.data = data  # dp * ep (batch-dim divisor inside the region)
         self.sequence_parallel = sequence_parallel
         self.quantized = quantized
+        # which rings this context enables (ISSUE 20): row/column layer
+        # rings need a pp==cp==1 mesh (they nest no other manual region);
+        # the vocab head ring runs OUTSIDE the pp region and so composes
+        # with pipeline-parallel serving.
+        self.ring_rows = ring_rows
+        self.vocab_ring = vocab_ring
 
     def __repr__(self):
         return (f"OverlapParams(tp={self.tp}, sp={self.sequence_parallel}, "
-                f"quantized={self.quantized})")
+                f"quantized={self.quantized}, ring_rows={self.ring_rows}, "
+                f"vocab_ring={self.vocab_ring})")
 
 
 def overlap_mode(cfg) -> str:
@@ -143,27 +154,43 @@ def overlap_scope_name(tp: int) -> str:
     return f"forward-tp{tp}-overlap"
 
 
+def vocab_scope_name(tp: int) -> str:
+    """Named scope stamped on the vocab head ring's HLO:
+    ``vocab-ring-tp{N}`` — the ppermute chain the bench and tests assert
+    lives under this scope (mechanism checked, not assumed)."""
+    return f"vocab-ring-tp{tp}"
+
+
 def overlap_params(cfg, mesh: Optional[Mesh]) -> Optional["OverlapParams"]:
     """Resolve (cfg, mesh) to ring parameters, or None when overlap does
-    not apply: mode off, no mesh, tp == 1 (single-chip degradation — the
-    flag is silently inert), an fp8 forward (its GEMMs carry their own
-    scaling protocol), or a pp/cp layout (those own manual regions the
-    full-manual ring must not nest inside)."""
-    if mesh is None or overlap_mode(cfg) == "off":
+    not apply: no mesh, tp == 1 (single-chip degradation — the flags are
+    silently inert), an fp8 forward (its GEMMs carry their own scaling
+    protocol), or nothing enabled.  The row/column layer rings
+    (``--tp_overlap ring``) additionally require a pp == cp == 1 layout
+    (pipeline/ring-attention own manual regions the full-manual ring must
+    not nest inside); the vocab head ring (``--vocab_ring``, ISSUE 20)
+    runs outside the pp region so pp > 1 is allowed — only cp (which
+    wraps the whole forward) excludes it."""
+    if mesh is None:
         return None
     shape = dict(mesh.shape)
     tp = shape.get(TP_AXIS, 1)
     if tp <= 1:
         return None
-    if shape.get(PP_AXIS, 1) > 1 or shape.get(CP_AXIS, 1) > 1:
-        return None
     if getattr(cfg.model, "fp8", None) is not None:
+        return None
+    flat = shape.get(PP_AXIS, 1) == 1 and shape.get(CP_AXIS, 1) == 1
+    ring_rows = overlap_mode(cfg) == "ring" and flat
+    vocab_ring = (bool(getattr(cfg.parallel, "vocab_ring", False))
+                  and shape.get(CP_AXIS, 1) == 1)
+    if not (ring_rows or vocab_ring):
         return None
     data = shape.get(DP_AXIS, 1) * shape.get(EP_AXIS, 1)
     return OverlapParams(
         mesh, tp, data,
         bool(getattr(cfg.parallel, "sequence_parallel", False)),
         bool(getattr(cfg.parallel, "quantized_tp_collectives", False)),
+        ring_rows=ring_rows, vocab_ring=vocab_ring,
     )
 
 
@@ -207,6 +234,9 @@ def current() -> Optional[OverlapParams]:
 
 
 def _eligible_common(ovl: OverlapParams, p, x) -> bool:
+    # a vocab_ring-only context does not intercept the layer projections
+    if not ovl.ring_rows:
+        return False
     # int8 weight-only trees carry kernel_q/kernel_scale (ops/quant.py) —
     # their dequant-inside-GEMM contract stays on the plain path
     if "kernel" not in p or getattr(x, "ndim", 0) != 3:
@@ -422,3 +452,102 @@ def column_parallel(cfg, p, x, fallback: Callable[[Any, Any], Any]):
     if "bias" in p:
         y = y + p["bias"].astype(y.dtype)
     return y
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel head ring (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _vocab_eligible(ovl: OverlapParams, w, x) -> bool:
+    if not ovl.vocab_ring:
+        return False
+    if getattr(x, "ndim", 0) != 3 or getattr(w, "ndim", 0) != 2:
+        return False
+    if x.shape[-1] != w.shape[0]:
+        return False
+    # each rank's vocab shard splits into tp sub-chunks: V % tp**2 == 0
+    # (padded_vocab_size pads to a multiple of 128 * tp, so this holds
+    # for every practical tp; tiny toy vocabs fall back)
+    if w.shape[1] % (ovl.tp * ovl.tp):
+        return False
+    # never nest inside another manual region (the pp stage region in
+    # particular: the head runs AFTER pipelined_transformer returns)
+    if not compat.get_abstract_mesh().empty:
+        return False
+    return True
+
+
+def vocab_parallel(cfg, w, x, fallback: Callable[[Any, Any], Any]):
+    """Vocab-parallel head projection ([R, s, h] @ [h, V], V tp-sharded):
+    the all-gather matmul ring when ``--vocab_ring`` is active, else
+    ``fallback(w, x)`` (the plain GEMM + XLA-inserted all-gather).
+
+    At serving time the head GEMM is the single largest collective per
+    tick — the logits all-gather moves ``R * V`` elements EVERY decode
+    step.  The ring decomposes each rank's ``[h, V/tp]`` shard into
+    ``tp`` column sub-chunks: at step ``t`` the rank GEMMs sub-chunk
+    ``t`` while the previously computed sub-chunks travel one hop
+    (``ppermute``) — compute and wire are data-independent, so the
+    latency-hiding scheduler overlaps them.  After ``2*tp - 2`` hops
+    every rank holds all ``tp**2`` (owner, sub) blocks and assembles the
+    replicated ``[R, s, V]`` logits.
+
+    Unlike the row ring this does NOT reassociate any floating-point
+    sum — the split is along output columns, the contraction dim stays
+    intact, and the wire is never quantized — but XLA may still tile the
+    sub-GEMMs differently from the fused one, so the contract is the
+    tolerance one (greedy tokens identical, log-probs <= 5e-6), not
+    bitwise.
+    """
+    ovl = current()
+    if ovl is None or not _vocab_eligible(ovl, w, x):
+        return fallback(w, x)
+    mesh, tp = ovl.mesh, ovl.tp
+    R, s, h = x.shape
+    V = w.shape[1]
+    u = V // (tp * tp)  # sub-chunk width (vc = V/tp per rank, tp subs)
+    perm = _ring_perm(tp)
+
+    def body(xl, wl):
+        # xl [R, s, h] replicated, wl [h, V/tp] this rank's column shard.
+        wl = wl.astype(xl.dtype)
+        r = compat.axis_index(TP_AXIS)
+        rows = R * s
+        xf = xl.reshape(rows, h)
+        # y4[o, j] = owner o's sub-chunk j — assembled as blocks arrive.
+        y4 = jnp.zeros((tp, tp, rows, u), xl.dtype)
+        live = {}  # sub index -> in-flight block (computed at step j)
+        for t in range(2 * tp - 1):
+            # 1) hop everything in flight: ONE ppermute on the stacked
+            #    payload (sub j has hopped t - j times after this)
+            if live:
+                js = sorted(live)
+                payload = jnp.stack([live[j] for j in js])
+                payload = jax.lax.ppermute(payload, TP_AXIS, perm)
+                for i, j in enumerate(js):
+                    live[j] = payload[i]
+            # 2) GEMM sub-chunk t locally — data-independent of the hop
+            #    above, so the DMA hides behind this MXU work
+            if t < tp:
+                live[t] = xf @ jax.lax.dynamic_slice_in_dim(
+                    wl, t * u, u, axis=1)
+            # 3) place every in-flight block: after ``t - j`` hops rank r
+            #    holds owner ``(r - (t - j)) mod tp``'s sub j
+            for j in list(live):
+                hops = t - j
+                o = _mod(r - hops, tp)
+                y4 = jax.lax.dynamic_update_slice(
+                    y4, live[j][None, None], (o, jnp.int32(j), 0, 0))
+                if hops == tp - 1:  # visited every rank — done
+                    del live[j]
+        # owner-major (o, j, u) block order == global column order
+        return y4.transpose(2, 0, 1, 3).reshape(R, s, V)
+
+    with jax.named_scope(vocab_scope_name(tp)):
+        return compat.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, TP_AXIS)),
+            out_specs=P(),
+            axis_names=set(mesh.axis_names), check_vma=False,
+        )(x, w)

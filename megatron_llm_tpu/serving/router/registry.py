@@ -99,6 +99,12 @@ class ReplicaView:
     # when the fleet has both roles, and degrades to least_loaded when
     # it doesn't ("unified" is the pre-disagg default)
     role: str = "unified"
+    # pipeline-parallel serving (ISSUE 20): the replica's stage count —
+    # a pp=4 replica spans 4 chips but drops into the fleet as one
+    # opaque /health endpoint; the fields are informational (dashboards,
+    # capacity math), not a routing input.  "stages" mirrors "pp".
+    pp: int = 1
+    stages: int = 1
     # scheduler control-plane payload (engine.scheduler_stats())
     policy: str = ""
     retry_after_s: Optional[float] = None
@@ -148,6 +154,8 @@ class ReplicaView:
             streaming=bool(payload.get("streaming", False)),
             registered=bool(payload.get("registered", False)),
             role=str(payload.get("role", "unified")),
+            pp=max(int(payload.get("pp", 1)), 1),
+            stages=max(int(payload.get("stages", 1)), 1),
             policy=str(sched.get("policy", "")),
             retry_after_s=(None if sched.get("retry_after_s") is None
                            else float(sched["retry_after_s"])),

@@ -1335,3 +1335,84 @@ def test_committed_disagg_evidence_is_valid():
     stamped = dict(rec)
     stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
     assert not _bench_on_tpu(json.dumps(stamped))
+
+
+def test_pp_bench_cpu_contract(evidence_dir):
+    """bench_decode.py --mode pp (ISSUE 20) reuses the off-TPU contract:
+    headline 0, the pp-vs-equal-chip-tp decode ratio, the stage-bytes
+    check and the HLO mechanism verdict ride under cpu_sanity with
+    budget fields populated, TPU evidence goes to its own tagged file."""
+    line = bench.cpu_contract_line({
+        "metric": "engine_pp_decode_tok_s_ratio_llama470m_c8_eqchip",
+        "value": 0.96, "unit": "x", "backend": "cpu",
+        "pp_ok": True, "identity_ok": True, "stage_bytes_ok": True,
+        "mechanism_ok": True, "stage_bytes_ratio": 0.25,
+        "ratios_vs_equal_chip_pp1": {"pp2": 0.96, "pp4": 0.94},
+        "compile_time_s": 19.0, "step_time_s": 0.01,
+        "rows": [{"pp": 1, "tp": 1, "chips": 1, "decode_tok_s": 2300.0},
+                 {"pp": 2, "tp": 1, "chips": 2, "decode_tok_s": 1170.0}],
+    }, tag="engine_decode_pp")
+    assert line["value"] == 0.0 and line["unit"] == "x"
+    assert line["cpu_sanity"]["pp_ok"] is True
+    assert line["cpu_sanity"]["mechanism_ok"] is True
+    assert line["budgets"]["compile_time_s"]["value"] == 19.0
+    assert "error" not in line
+    bench.persist_tpu_result({"metric": "engine_pp", "value": 0.97,
+                              "backend": "tpu"}, {},
+                             tag="engine_decode_pp")
+    assert bench.load_last_tpu(tag="engine_decode_pp")["value"] == 0.97
+    assert bench.load_last_tpu() is None  # headline untouched
+
+
+def test_pp_bench_in_watch_jobs():
+    """ISSUE 20: the pipeline-parallel serving bench is in the tunnel-up
+    capture list (own watchdog, bench evidence predicate)."""
+    from tools.tpu_watch import JOBS
+
+    by_name = {name: (cmd, bounded, pred) for name, cmd, bounded, pred in JOBS}
+    assert "bench_decode_pp" in by_name
+    cmd, bounded, pred = by_name["bench_decode_pp"]
+    assert "--mode" in cmd and "pp" in cmd
+    assert bounded is False and pred is _bench_on_tpu
+
+
+def test_committed_pp_evidence_is_valid():
+    """The committed CPU-sanity evidence (BENCH_decode_pp_cpu_sanity.
+    json) satisfies the acceptance bar: headline 0 off-TPU, greedy
+    tokens identical across every arm, per-stage KV bytes exactly
+    kv_pool_bytes/pp (the servable-model-size multiplier), the
+    stage-permute ppermute chain machine-asserted in the compiled tick
+    HLO, and every pp arm's decode tok/s within 15% of the equal-chip
+    pp=1 (tp-only) arm, budgets populated without violations."""
+    from pathlib import Path
+
+    path = (Path(__file__).parent.parent
+            / "BENCH_decode_pp_cpu_sanity.json")
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 0.0 and rec["backend"] == "cpu"
+    sanity = rec["cpu_sanity"]
+    assert sanity["pp_ok"] is True
+    assert sanity["identity_ok"] is True
+    assert sanity["stage_bytes_ok"] is True
+    assert sanity["mechanism_ok"] is True
+    # the acceptance bar: <= 15% decode tok/s cost at equal chips for
+    # EVERY pipelined arm, with per-stage KV residency cut to 1/pp
+    assert all(r >= 0.85
+               for r in sanity["ratios_vs_equal_chip_pp1"].values())
+    by_arm = {(r["pp"], r["tp"]): r for r in sanity["rows"]}
+    wl = sanity["workload"]
+    for pp in wl["pps"]:
+        base, arm = by_arm[(1, pp)], by_arm[(pp, 1)]
+        assert base["chips"] == arm["chips"] == pp  # equal-chip pairing
+        assert arm["kv_stage_bytes"] == arm["kv_pool_bytes"] // pp
+        assert base["kv_stage_bytes"] == base["kv_pool_bytes"]
+        assert (arm["decode_tok_s"]
+                >= 0.85 * base["decode_tok_s"])
+    assert by_arm[(1, 1)]["chips"] == 1  # flat identity reference ran
+    assert "compile_time_s" in rec["budgets"]
+    assert "error" not in rec
+    # an error-stamped line of this shape must be rejected by the watch
+    # evidence predicate, not captured
+    stamped = dict(rec)
+    stamped["error"] = "watchdog: engine decode bench exceeded 1500s"
+    assert not _bench_on_tpu(json.dumps(stamped))

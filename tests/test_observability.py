@@ -595,8 +595,13 @@ def test_generation_server_metrics_endpoint():
                       # ISSUE 17: pipelined-dispatch telemetry
                       "mlt_engine_host_gap_seconds",
                       "mlt_engine_inflight_ticks",
-                      "mlt_engine_tick_pipeline_depth"):
+                      "mlt_engine_tick_pipeline_depth",
+                      # ISSUE 20: pipeline-parallel serving geometry
+                      "mlt_engine_pp_stages",
+                      "mlt_engine_kv_stage_bytes"):
             assert field in body, f"missing {field}"
+        # an unpipelined engine reports one stage and a full-pool stage
+        assert "mlt_engine_pp_stages 1" in body
         assert "mlt_engine_max_slots 4" in body
         assert 'mlt_engine_kv_dtype_info{kv_dtype="bf16"} 1' in body
         # a no-mesh engine reports the off mode at tp=1
@@ -612,6 +617,10 @@ def test_generation_server_metrics_endpoint():
         assert health["peak_active_slots"] == 0
         # ISSUE 17: /health names the configured pipeline depth
         assert health["tick_pipeline_depth"] == 0
+        # ISSUE 20: /health names the serving pipeline geometry — an
+        # unpipelined engine reports one stage owning the whole pool
+        assert health["pp"] == 1 and health["stages"] == 1
+        assert health["kv_stage_bytes"] == health["kv_pool_bytes"]
     finally:
         srv.stop()
 

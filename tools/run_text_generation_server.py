@@ -119,17 +119,23 @@ def main():
         # named mesh: params by the parallel/tp.py rules, the KV pool over
         # the heads dim — one engine then serves a model larger than a
         # single chip's HBM. tp=1 keeps the single-chip engine unchanged.
+        # --pp N (--pipeline_model_parallel_size) additionally runs the
+        # tick as pp pipeline stages (parallel/pp_serve.py): each stage
+        # holds L/pp layers of params AND pool, multiplying the servable
+        # model size again — tp*pp chips per replica. --pp 1 builds no
+        # mesh axis work at all (byte-for-byte the flat engine).
         mesh = None
-        if cfg.parallel.tensor_model_parallel_size > 1:
+        if (cfg.parallel.tensor_model_parallel_size > 1
+                or cfg.parallel.pipeline_model_parallel_size > 1):
             from megatron_llm_tpu.core.parallel_state import (
                 build_mesh, set_global_mesh,
             )
 
-            assert cfg.parallel.pipeline_model_parallel_size == 1, (
-                "serving supports tensor parallelism only (pp must be 1)")
             mesh = build_mesh(
                 tensor_model_parallel_size=(
                     cfg.parallel.tensor_model_parallel_size),
+                pipeline_model_parallel_size=(
+                    cfg.parallel.pipeline_model_parallel_size),
                 data_parallel_size=1,
             )
             set_global_mesh(mesh)

@@ -225,6 +225,13 @@ JOBS = [
     ("bench_decode_disagg",
      [sys.executable, "bench_decode.py", "--mode", "disagg"],
      False, _bench_on_tpu),
+    # ISSUE 20: pipeline-parallel serving tick — pp=2/4 vs the equal-chip
+    # tp-only engine: decode tok/s ratio, token-identity assert, per-stage
+    # KV bytes = pool/pp, stage-permute mechanism in HLO (bench_decode.py
+    # --mode pp, engine_decode_pp evidence)
+    ("bench_decode_pp",
+     [sys.executable, "bench_decode.py", "--mode", "pp"],
+     False, _bench_on_tpu),
     # ISSUE 2: host/device overlap in the training driver — overlapped vs
     # blocking loop steps/sec with simulated data latency (own watchdog,
     # bench contract; evidence in BENCH_LAST_TPU_train_loop.json)
